@@ -1,0 +1,27 @@
+"""Test configuration.
+
+* Forces JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+  anywhere, so multi-chip sharding paths (dp/fsdp/tp/sp meshes, collectives)
+  are exercised without TPU hardware — the testing strategy SURVEY.md §7.4
+  calls for ("testing multi-host without TPUs").
+* Runs `async def` tests on a fresh asyncio loop (no pytest-asyncio in the
+  image).
+"""
+
+import asyncio
+import inspect
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=120))
+        return True
+    return None

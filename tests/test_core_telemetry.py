@@ -1,0 +1,56 @@
+"""Tests for telemetry (nexus-core ConfigureLogger/WithStatsd parity)."""
+
+import io
+import json
+import socket
+
+from tpu_nexus.core.signals import setup_signal_context
+from tpu_nexus.core.telemetry import StatsdClient, Timer, RecordingMetrics, configure_logger
+
+
+def test_json_logger_structure_and_tags():
+    buf = io.StringIO()
+    log = configure_logger(tags={"environment": "units"}, level="info", verbosity=1, stream=buf)
+    log.info("decision made", decision="ToRunning", request_id="abc")
+    log.v(4).info("firehose suppressed")  # verbosity 1 < 4 -> dropped
+    log.v(1).info("kept")
+    lines = [json.loads(line) for line in buf.getvalue().strip().splitlines()]
+    assert lines[0]["message"] == "decision made"
+    assert lines[0]["decision"] == "ToRunning"
+    assert lines[0]["tags"] == {"environment": "units"}
+    assert [l["message"] for l in lines] == ["decision made", "kept"]
+
+
+def test_statsd_udp_datagram_format():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2)
+    port = sock.getsockname()[1]
+    client = StatsdClient("tpu_nexus", address=f"udp://127.0.0.1:{port}", static_tags={"ctx": "nexus_supervisor"})
+    client.count("events", 3, tags={"kind": "Job"})
+    data, _ = sock.recvfrom(4096)
+    assert data.decode() == "tpu_nexus.events:3|c|#ctx:nexus_supervisor,kind:Job"
+    client.gauge("queue_depth", 7)
+    data, _ = sock.recvfrom(4096)
+    assert data.decode() == "tpu_nexus.queue_depth:7|g|#ctx:nexus_supervisor"
+    sock.close()
+
+
+def test_statsd_unreachable_never_raises():
+    client = StatsdClient("ns", address="unix:///nonexistent/path.sock")
+    client.count("x")  # must not raise
+    client.timing("y", 0.5)
+
+
+def test_timer_records():
+    m = RecordingMetrics()
+    with Timer(m, "op"):
+        pass
+    assert len(m.timings["op"]) == 1
+
+
+def test_signal_context_manual_cancel():
+    ctx = setup_signal_context(install=False)
+    assert not ctx.cancelled
+    ctx.cancel()
+    assert ctx.cancelled

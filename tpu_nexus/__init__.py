@@ -1,0 +1,25 @@
+"""tpu-nexus: a TPU-native job-supervision framework.
+
+A brand-new framework with the capabilities of SneaksAndData/nexus-supervisor
+(reference surveyed in SURVEY.md): a Kubernetes control-plane service that
+watches algorithm-run resources (Events/Pods/Jobs and Cloud TPU JobSets),
+classifies failure modes into a decision taxonomy (the reference's classes
+plus TPU-specific ones: ICI link down, XLA compile abort, TPU preemption,
+HBM OOM), and commits run lifecycle state + failure cause + trace refs to a
+Cassandra/Scylla checkpoint ledger.  Unlike the Go reference, the launched
+algorithm jobs are first-class `jax.distributed` JAX programs on TPU slices,
+and the framework ships the workload harness (mesh-sharded training loop,
+ring attention, pallas kernels) alongside the control plane.
+
+Layout (mirrors SURVEY.md §7.2 build order):
+  core/        platform lib: config, signals, telemetry, pipeline actor
+               (equivalent of the consumed nexus-core surface, SURVEY §2.3)
+  checkpoint/  run-metadata ledger: models + CQL/SQLite/in-memory stores
+  k8s/         kube client interface, fake client, shared informers
+  supervisor/  the supervision service: classification + decision execution
+  launcher/    JobSet composition for jax.distributed TPU jobs
+  workload/    JAX training harness: models/ ops/ parallel/ (TPU compute path)
+  app/         dependency-injection builder + typed app config
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,15 @@
+"""Platform library: the surface the Go reference consumed from nexus-core.
+
+Reconstructed API contract (SURVEY.md §2.3, call sites in
+reference services/supervisor.go + main.go):
+
+  configurations.LoadConfig  -> tpu_nexus.core.config.load_config
+  signals.SetupSignalHandler -> tpu_nexus.core.signals.setup_signal_context
+  telemetry.ConfigureLogger  -> tpu_nexus.core.telemetry.configure_logger
+  telemetry.WithStatsd       -> tpu_nexus.core.telemetry.StatsdClient
+  pipeline.DefaultPipelineStageActor -> tpu_nexus.core.pipeline.PipelineStageActor
+  util.CoalescePointer       -> tpu_nexus.core.util.coalesce
+  buildmeta.AppVersion       -> tpu_nexus.core.buildmeta
+"""
+
+from tpu_nexus.core.util import coalesce  # noqa: F401

@@ -1,0 +1,233 @@
+"""Structured logging + metrics.
+
+Equivalent of nexus-core `telemetry.ConfigureLogger` / `telemetry.WithStatsd`
+(reference main.go:15-20; SURVEY.md §5.5):
+
+  * `configure_logger(tags, level)` — JSON structured logs on stderr with
+    static tags (the slog+Datadog analogue) and klog-style V-levels via
+    `logger.v(n)` gating (reference uses V(0)/V(1)/V(4),
+    services/supervisor.go:138,173,256);
+  * `StatsdClient` — dependency-free DogStatsD emitter over UDP or UDS,
+    fire-and-forget (never raises into the hot path), plus an in-memory
+    `RecordingMetrics` for tests.
+
+Shipping to Datadog/Cloud Monitoring is a deployment concern (socket mount /
+sidecar), matching the reference's Helm plumbing.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import sys
+import time
+from typing import Dict, Iterable, Mapping, Optional, Sequence
+
+
+class JsonFormatter(logging.Formatter):
+    def __init__(self, static_tags: Optional[Mapping[str, str]] = None) -> None:
+        super().__init__()
+        self._tags = dict(static_tags or {})
+
+    def format(self, record: logging.LogRecord) -> str:
+        payload: Dict[str, object] = {
+            "ts": round(record.created, 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if self._tags:
+            payload["tags"] = self._tags
+        extra = getattr(record, "fields", None)
+        if extra:
+            payload.update(extra)
+        if record.exc_info and record.exc_info[0] is not None:
+            payload["exception"] = self.formatException(record.exc_info)
+        return json.dumps(payload, default=str)
+
+
+class VLogger:
+    """klog-style verbosity wrapper around a stdlib logger.
+
+    `log.v(0)` is always-on info, `log.v(4)` is firehose — enabled when the
+    configured verbosity >= n.  Structured fields go in as kwargs.
+    """
+
+    def __init__(self, logger: logging.Logger, verbosity: int = 0) -> None:
+        self._logger = logger
+        self.verbosity = verbosity
+
+    def _emit(self, level: int, msg: str, fields: Mapping[str, object]) -> None:
+        if self._logger.isEnabledFor(level):
+            self._logger.log(level, msg, extra={"fields": dict(fields)} if fields else {})
+
+    def v(self, n: int) -> "_LeveledProxy":
+        return _LeveledProxy(self, enabled=n <= self.verbosity)
+
+    def info(self, msg: str, **fields: object) -> None:
+        self._emit(logging.INFO, msg, fields)
+
+    def warning(self, msg: str, **fields: object) -> None:
+        self._emit(logging.WARNING, msg, fields)
+
+    def error(self, msg: str, **fields: object) -> None:
+        self._emit(logging.ERROR, msg, fields)
+
+    def exception(self, msg: str, **fields: object) -> None:
+        self._logger.error(msg, exc_info=True, extra={"fields": dict(fields)} if fields else {})
+
+
+class _LeveledProxy:
+    def __init__(self, parent: VLogger, enabled: bool) -> None:
+        self._parent = parent
+        self._enabled = enabled
+
+    def info(self, msg: str, **fields: object) -> None:
+        if self._enabled:
+            self._parent.info(msg, **fields)
+
+
+_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warn": logging.WARNING,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+}
+
+
+def configure_logger(
+    tags: Optional[Mapping[str, str]] = None,
+    level: str = "info",
+    verbosity: int = 1,
+    stream=None,
+) -> VLogger:
+    """Configure the root tpu-nexus logger with JSON output and static tags."""
+    logger = logging.getLogger("tpu_nexus")
+    logger.setLevel(_LEVELS.get((level or "info").lower(), logging.INFO))
+    handler = logging.StreamHandler(stream or sys.stderr)
+    handler.setFormatter(JsonFormatter(tags))
+    logger.handlers = [handler]
+    logger.propagate = False
+    return VLogger(logger, verbosity=verbosity)
+
+
+def get_logger(name: str = "tpu_nexus", verbosity: int = 1) -> VLogger:
+    return VLogger(logging.getLogger(name), verbosity=verbosity)
+
+
+class Metrics:
+    """Minimal metrics interface: counters, gauges, timings (DogStatsD verbs)."""
+
+    def count(self, name: str, value: int = 1, tags: Optional[Mapping[str, str]] = None) -> None:
+        raise NotImplementedError
+
+    def gauge(self, name: str, value: float, tags: Optional[Mapping[str, str]] = None) -> None:
+        raise NotImplementedError
+
+    def timing(self, name: str, seconds: float, tags: Optional[Mapping[str, str]] = None) -> None:
+        raise NotImplementedError
+
+
+class NullMetrics(Metrics):
+    def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
+        pass
+
+    def gauge(self, name, value, tags=None) -> None:  # noqa: ANN001
+        pass
+
+    def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
+        pass
+
+
+class RecordingMetrics(Metrics):
+    """In-memory recorder for tests."""
+
+    def __init__(self) -> None:
+        self.counters: Dict[str, int] = {}
+        self.gauges: Dict[str, float] = {}
+        self.timings: Dict[str, list] = {}
+
+    def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
+        self.counters[name] = self.counters.get(name, 0) + value
+
+    def gauge(self, name, value, tags=None) -> None:  # noqa: ANN001
+        self.gauges[name] = value
+
+    def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
+        self.timings.setdefault(name, []).append(seconds)
+
+
+class StatsdClient(Metrics):
+    """DogStatsD-format emitter, UDP (host:port) or UDS (unix:///path).
+
+    Fire-and-forget: socket errors are swallowed — telemetry must never take
+    down the supervision hot path (the reference's statsd is equally
+    best-effort UDP).
+    """
+
+    def __init__(
+        self,
+        namespace: str,
+        address: Optional[str] = None,
+        static_tags: Optional[Mapping[str, str]] = None,
+    ) -> None:
+        self.namespace = namespace.rstrip(".")
+        self._tags = [f"{k}:{v}" for k, v in (static_tags or {}).items()]
+        address = address or os.environ.get("DD_DOGSTATSD_URL") or "udp://127.0.0.1:8125"
+        self._sock: Optional[socket.socket] = None
+        try:
+            if address.startswith("unix://"):
+                sock = socket.socket(socket.AF_UNIX, socket.SOCK_DGRAM)
+                sock.connect(address[len("unix://"):])
+            else:
+                if address.startswith("udp://"):
+                    address = address[len("udp://"):]
+                host, _, port = address.partition(":")
+                sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+                # connect() resolves once here, not per-datagram on the hot path
+                sock.connect((host, int(port or 8125)))
+            sock.setblocking(False)
+            self._sock = sock
+        except OSError:
+            self._sock = None
+
+    def _send(self, payload: str, tags: Optional[Mapping[str, str]]) -> None:
+        if self._sock is None:
+            return
+        all_tags = self._tags + [f"{k}:{v}" for k, v in (tags or {}).items()]
+        if all_tags:
+            payload = f"{payload}|#{','.join(all_tags)}"
+        try:
+            self._sock.send(payload.encode("utf-8"))
+        except OSError:
+            pass
+
+    def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
+        self._send(f"{self.namespace}.{name}:{value}|c", tags)
+
+    def gauge(self, name, value, tags=None) -> None:  # noqa: ANN001
+        self._send(f"{self.namespace}.{name}:{value}|g", tags)
+
+    def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
+        self._send(f"{self.namespace}.{name}:{seconds * 1000.0:.3f}|ms", tags)
+
+
+class Timer:
+    """Context manager emitting a timing metric."""
+
+    def __init__(self, metrics: Metrics, name: str, tags: Optional[Mapping[str, str]] = None) -> None:
+        self._metrics = metrics
+        self._name = name
+        self._tags = tags
+        self.elapsed = 0.0
+
+    def __enter__(self) -> "Timer":
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> None:  # noqa: ANN002
+        self.elapsed = time.perf_counter() - self._start
+        self._metrics.timing(self._name, self.elapsed, self._tags)

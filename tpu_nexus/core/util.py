@@ -1,0 +1,16 @@
+"""Small helpers (reference: nexus-core pkg/util, used at services/supervisor.go:71)."""
+
+from __future__ import annotations
+
+from typing import Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def coalesce(*values: Optional[T]) -> Optional[T]:
+    """Return the first non-None value (reference CoalescePointer, 2-arg;
+    generalized to n-ary)."""
+    for v in values:
+        if v is not None:
+            return v
+    return None
