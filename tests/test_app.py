@@ -1,0 +1,69 @@
+"""App composition tests (reference app/app_dependencies.go behavior:
+nil-guarded singletons, store-type selection, fatal on unknown type,
+end-to-end Start)."""
+
+import asyncio
+import uuid
+from datetime import timedelta
+
+import pytest
+
+from tpu_nexus.app.config import SupervisorConfig
+from tpu_nexus.app.dependencies import ApplicationServices
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.k8s.fake import FakeKubeClient
+
+from test_supervisor import ALGORITHM, NS, event_obj, job_obj, pod_obj
+
+
+def test_unknown_store_type_fatal():
+    cfg = SupervisorConfig(cql_store_type="bogus")
+    services = ApplicationServices(fatal_exit=False)
+    with pytest.raises(RuntimeError, match="unknown cql-store-type"):
+        services.with_store_for(cfg)
+
+
+def test_builder_is_idempotent_singleton():
+    cfg = SupervisorConfig(cql_store_type="memory")
+    services = ApplicationServices(fatal_exit=False).with_memory_store()
+    first = services.store
+    services.with_store_for(cfg)  # second build attempt must be a no-op
+    services.with_memory_store()
+    assert services.store is first
+
+
+async def test_end_to_end_start_processes_event():
+    rid = str(uuid.uuid4())
+    client = FakeKubeClient(
+        {
+            "Job": [job_obj(rid)],
+            "Pod": [pod_obj(rid)],
+            "Event": [event_obj("FailedCreate", "no quota", "Job", rid)],
+        }
+    )
+    cfg = SupervisorConfig(
+        cql_store_type="memory",
+        resource_namespace=NS,
+        failure_rate_base_delay=timedelta(milliseconds=5),
+        failure_rate_max_delay=timedelta(milliseconds=50),
+        rate_limit_elements_per_second=0,
+    )
+    services = (
+        ApplicationServices(fatal_exit=False)
+        .with_store_for(cfg)
+        .with_fake_kube_client(client)
+        .with_supervisor(cfg, resync_period=timedelta(0))
+    )
+    services.store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    ctx = LifecycleContext()
+    task = asyncio.create_task(services.start(ctx, cfg))
+    await asyncio.sleep(0.05)
+    assert await services.supervisor.idle(timeout=10)
+    ctx.cancel()
+    await task
+    cp = services.store.read_checkpoint(ALGORITHM, rid)
+    assert cp.lifecycle_stage == LifecycleStage.SCHEDULING_FAILED
+    assert rid in client.deleted("Job")

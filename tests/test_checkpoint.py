@@ -1,0 +1,132 @@
+"""Tests for the checkpoint ledger (SURVEY §2.3 pkg/checkpoint contract,
+§2.5 schema)."""
+
+from datetime import datetime, timezone
+
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore, SqliteCheckpointStore
+
+
+def make_cp(**overrides):
+    defaults = dict(
+        algorithm="test-algorithm",
+        id="f47ac10b-58cc-4372-a567-0e02b2c3d479",
+        lifecycle_stage=LifecycleStage.BUFFERED,
+        payload_uri="http://localhost/payload",
+        received_by_host="host123",
+        received_at=datetime(2023, 10, 1, 12, 0, tzinfo=timezone.utc),
+        tag="tag_123",
+        api_version="v1.0",
+    )
+    defaults.update(overrides)
+    return CheckpointedRequest(**defaults)
+
+
+def test_is_finished_terminal_stages():
+    for stage in (
+        LifecycleStage.COMPLETED,
+        LifecycleStage.FAILED,
+        LifecycleStage.SCHEDULING_FAILED,
+        LifecycleStage.DEADLINE_EXCEEDED,
+        LifecycleStage.CANCELLED,
+    ):
+        assert make_cp(lifecycle_stage=stage).is_finished(), stage
+    for stage in (
+        LifecycleStage.NEW,
+        LifecycleStage.BUFFERED,
+        LifecycleStage.RUNNING,
+        LifecycleStage.PREEMPTED,
+    ):
+        assert not make_cp(lifecycle_stage=stage).is_finished(), stage
+
+
+def test_transition_partial_order():
+    # terminal absorbs (multi-host first-writer-wins, SURVEY §7.4)
+    assert not LifecycleStage.can_transition(LifecycleStage.CANCELLED, LifecycleStage.RUNNING)
+    assert not LifecycleStage.can_transition(LifecycleStage.FAILED, LifecycleStage.COMPLETED)
+    # monotone forward
+    assert LifecycleStage.can_transition(LifecycleStage.BUFFERED, LifecycleStage.RUNNING)
+    assert LifecycleStage.can_transition(LifecycleStage.RUNNING, LifecycleStage.FAILED)
+    # preempted runs return to RUNNING when the JobSet restarts them
+    assert LifecycleStage.can_transition(LifecycleStage.PREEMPTED, LifecycleStage.RUNNING)
+    assert LifecycleStage.can_transition(LifecycleStage.RUNNING, LifecycleStage.PREEMPTED)
+    # but never regress to pre-run stages
+    assert not LifecycleStage.can_transition(LifecycleStage.RUNNING, LifecycleStage.BUFFERED)
+
+
+def test_deep_copy_isolation():
+    cp = make_cp(per_chip_steps={"host0/chip0": 10})
+    dup = cp.deep_copy()
+    dup.lifecycle_stage = LifecycleStage.FAILED
+    dup.per_chip_steps["host0/chip0"] = 99
+    assert cp.lifecycle_stage == LifecycleStage.BUFFERED
+    assert cp.per_chip_steps["host0/chip0"] == 10
+
+
+def test_row_round_trip():
+    cp = make_cp(
+        per_chip_steps={"host0/chip0": 123, "host1/chip3": 456},
+        hlo_trace_ref="gs://traces/run1.hlo",
+        restart_count=2,
+    )
+    back = CheckpointedRequest.from_row(cp.to_row())
+    assert back == cp
+
+
+@pytest.fixture(params=["memory", "sqlite"])
+def store(request, tmp_path):
+    if request.param == "memory":
+        yield InMemoryCheckpointStore()
+    else:
+        s = SqliteCheckpointStore(str(tmp_path / "ledger.db"))
+        yield s
+        s.close()
+
+
+def test_store_read_miss_returns_none(store):
+    assert store.read_checkpoint("nope", "missing") is None
+
+
+def test_store_upsert_read_update(store):
+    cp = make_cp()
+    store.upsert_checkpoint(cp)
+    got = store.read_checkpoint(cp.algorithm, cp.id)
+    assert got == cp
+    # read-modify-write through a deep copy (reference mutation discipline)
+    mutated = got.deep_copy()
+    mutated.lifecycle_stage = LifecycleStage.FAILED
+    mutated.algorithm_failure_cause = "Algorithm encountered a fatal error during execution."
+    store.upsert_checkpoint(mutated)
+    again = store.read_checkpoint(cp.algorithm, cp.id)
+    assert again.lifecycle_stage == LifecycleStage.FAILED
+    # the original object must be unaffected (store copies on write)
+    assert cp.lifecycle_stage == LifecycleStage.BUFFERED
+
+
+def test_store_secondary_queries(store):
+    store.upsert_checkpoint(make_cp(id="a", lifecycle_stage=LifecycleStage.RUNNING, tag="t1"))
+    store.upsert_checkpoint(make_cp(id="b", lifecycle_stage=LifecycleStage.RUNNING, tag="t2"))
+    store.upsert_checkpoint(make_cp(id="c", lifecycle_stage=LifecycleStage.CANCELLED, tag="t1"))
+    assert {cp.id for cp in store.query_by_stage(LifecycleStage.RUNNING)} == {"a", "b"}
+    assert {cp.id for cp in store.query_by_tag("t1")} == {"a", "c"}
+    assert {cp.id for cp in store.query_by_host("host123")} == {"a", "b", "c"}
+
+
+def test_sqlite_lazy_construction(tmp_path):
+    # constructing against an unwritable path must not fail until first query
+    s = SqliteCheckpointStore("/nonexistent-dir/ledger.db")
+    with pytest.raises(Exception):
+        s.read_checkpoint("a", "b")
+
+
+def test_sqlite_persistence_across_reopen(tmp_path):
+    path = str(tmp_path / "ledger.db")
+    s1 = SqliteCheckpointStore(path)
+    s1.upsert_checkpoint(make_cp(per_chip_steps={"h0/c0": 7}))
+    s1.close()
+    s2 = SqliteCheckpointStore(path)
+    got = s2.read_checkpoint("test-algorithm", "f47ac10b-58cc-4372-a567-0e02b2c3d479")
+    assert got is not None and got.per_chip_steps == {"h0/c0": 7}
+    s2.close()

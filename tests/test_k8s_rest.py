@@ -1,0 +1,139 @@
+"""RestKubeClient tests against a loopback fake API server (aiohttp)."""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp import web
+
+from tpu_nexus.k8s.client import NotFoundError
+from tpu_nexus.k8s.rest import RestKubeClient
+
+
+def make_app(state):
+    app = web.Application()
+
+    async def list_pods(request):
+        state["list_headers"] = dict(request.headers)
+        if request.query.get("watch") == "1":
+            state["watch_rv"] = request.query.get("resourceVersion")
+            resp = web.StreamResponse()
+            resp.content_type = "application/json"
+            await resp.prepare(request)
+            for evt in state.get("watch_events", []):
+                await resp.write((json.dumps(evt) + "\n").encode())
+            # hold the stream open briefly, then end (client iterates out)
+            await asyncio.sleep(0.05)
+            return resp
+        return web.json_response(
+            {
+                "kind": "PodList",
+                "metadata": {"resourceVersion": "42"},
+                "items": [{"metadata": {"name": "p1", "namespace": "nexus"}}],
+            }
+        )
+
+    async def delete_job(request):
+        state["delete_body"] = await request.json()
+        name = request.match_info["name"]
+        if name == "missing":
+            return web.json_response({"kind": "Status", "code": 404}, status=404)
+        return web.json_response({"kind": "Status", "status": "Success"})
+
+    async def create_job(request):
+        state["created"] = await request.json()
+        return web.json_response(state["created"])
+
+    app.router.add_get("/api/v1/namespaces/nexus/pods", list_pods)
+    app.router.add_delete("/apis/batch/v1/namespaces/nexus/jobs/{name}", delete_job)
+    app.router.add_post("/apis/batch/v1/namespaces/nexus/jobs", create_job)
+    return app
+
+
+@pytest.fixture
+def state():
+    return {}
+
+
+async def run_with_server(state, fn):
+    app = make_app(state)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+    client = RestKubeClient(f"http://127.0.0.1:{port}", token="sekret")
+    try:
+        await fn(client)
+    finally:
+        await client.close()
+        await runner.cleanup()
+
+
+async def test_list_objects_and_auth_header(state):
+    async def fn(client):
+        items, rv = await client.list_objects("Pod", "nexus")
+        assert rv == "42"
+        assert items[0]["metadata"]["name"] == "p1"
+        assert items[0]["kind"] == "Pod"  # kind restored for typed views
+        assert state["list_headers"]["Authorization"] == "Bearer sekret"
+
+    await run_with_server(state, fn)
+
+
+async def test_watch_streams_chunked_lines(state):
+    state["watch_events"] = [
+        {"type": "ADDED", "object": {"metadata": {"name": "p2", "namespace": "nexus"}}},
+        {"type": "BOOKMARK", "object": {"metadata": {"resourceVersion": "50"}}},
+        {"type": "DELETED", "object": {"metadata": {"name": "p2", "namespace": "nexus"}}},
+    ]
+
+    async def fn(client):
+        seen = []
+        async for event_type, obj in client.watch_objects("Pod", "nexus", "42"):
+            seen.append((event_type, obj["metadata"].get("name")))
+        assert state["watch_rv"] == "42"
+        assert ("ADDED", "p2") in seen and ("DELETED", "p2") in seen
+
+    await run_with_server(state, fn)
+
+
+async def test_delete_job_background_propagation(state):
+    async def fn(client):
+        await client.delete_job("nexus", "run-1")
+        assert state["delete_body"]["propagationPolicy"] == "Background"
+        with pytest.raises(NotFoundError):
+            await client.delete_object("Job", "nexus", "missing")
+
+    await run_with_server(state, fn)
+
+
+async def test_create_object(state):
+    async def fn(client):
+        out = await client.create_object("Job", "nexus", {"metadata": {"name": "j1"}})
+        assert out["metadata"]["name"] == "j1"
+
+    await run_with_server(state, fn)
+
+
+def test_kubeconfig_parsing(tmp_path):
+    kc = tmp_path / "config"
+    kc.write_text(
+        """
+apiVersion: v1
+kind: Config
+current-context: ctx
+contexts:
+- name: ctx
+  context: {cluster: c1, user: u1}
+clusters:
+- name: c1
+  cluster: {server: "http://127.0.0.1:6443"}
+users:
+- name: u1
+  user: {token: "tok"}
+"""
+    )
+    client = RestKubeClient.from_kubeconfig(str(kc))
+    assert client.base_url == "http://127.0.0.1:6443"
+    assert client._headers()["Authorization"] == "Bearer tok"

@@ -1,0 +1,5 @@
+"""App composition: typed config + dependency-injection builder
+(reference app/app_config.go + app/app_dependencies.go)."""
+
+from tpu_nexus.app.config import SupervisorConfig  # noqa: F401
+from tpu_nexus.app.dependencies import ApplicationServices  # noqa: F401

@@ -1,0 +1,19 @@
+"""Checkpoint ledger: run-metadata records keyed ((algorithm, id)).
+
+The "checkpoint" here is the run lifecycle ledger of the reference
+(nexus.checkpoints table, reference test-resources/checkpoints.cql:1-29;
+SURVEY.md §2.5) — NOT model weights.  Tensor checkpoints produced by the JAX
+workload harness live in object storage and are referenced from the ledger
+row (`tensor_checkpoint_uri`), keeping the control-plane source of truth in
+one place (SURVEY.md §5.4).
+"""
+
+from tpu_nexus.checkpoint.models import (  # noqa: F401
+    CheckpointedRequest,
+    LifecycleStage,
+)
+from tpu_nexus.checkpoint.store import (  # noqa: F401
+    CheckpointStore,
+    InMemoryCheckpointStore,
+    SqliteCheckpointStore,
+)

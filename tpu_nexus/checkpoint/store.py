@@ -1,0 +1,189 @@
+"""Checkpoint store backends.
+
+Equivalent of nexus-core pkg/checkpoint/request `CqlStore` as consumed at
+reference app/app_dependencies.go:18-34 and services/supervisor.go:264,301
+(SURVEY.md §2.3).  Contract:
+
+  * `read_checkpoint(algorithm, id)` -> row or None;
+  * `upsert_checkpoint(cp)` writes the full row (last-write-wins upsert,
+    CQL semantics);
+  * construction is LAZY — building a store against an unreachable backend
+    must not fail until the first query (the reference test constructs
+    against 127.0.0.1 unconditionally, services/supervisor_test.go:36-39);
+  * secondary lookups by tag / received_by_host / lifecycle_stage mirror the
+    reference's secondary indexes (test-resources/checkpoints.cql:25-29).
+
+Backends:
+  * InMemoryCheckpointStore — tests and the fake-cluster topology;
+  * SqliteCheckpointStore  — durable single-file store for local runs;
+  * ScyllaCqlStore / AstraCqlStore — real CQL cluster via the pure-python
+    wire client in tpu_nexus.checkpoint.cql (lazy session).
+
+Stores are plain last-write-wins (CQL upsert semantics, reference parity);
+lifecycle-transition guarding (IsFinished + the stage partial order) lives
+in the supervisor's commit path, not here.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest
+
+_COLUMNS = [
+    "algorithm",
+    "id",
+    "lifecycle_stage",
+    "payload_uri",
+    "result_uri",
+    "algorithm_failure_cause",
+    "algorithm_failure_details",
+    "received_by_host",
+    "received_at",
+    "sent_at",
+    "applied_configuration",
+    "configuration_overrides",
+    "content_hash",
+    "last_modified",
+    "tag",
+    "api_version",
+    "job_uid",
+    "parent",
+    "payload_valid_for",
+    "hlo_trace_ref",
+    "per_chip_steps",
+    "tensor_checkpoint_uri",
+    "restart_count",
+]
+
+
+class CheckpointStoreError(Exception):
+    pass
+
+
+class CheckpointStore:
+    """Abstract store interface (sync; the supervisor hot path wraps calls
+    in the actor's worker, and CQL/sqlite calls are fast or offloaded)."""
+
+    def read_checkpoint(self, algorithm: str, id: str) -> Optional[CheckpointedRequest]:
+        raise NotImplementedError
+
+    def upsert_checkpoint(self, cp: CheckpointedRequest) -> None:
+        raise NotImplementedError
+
+    def query_by_stage(self, stage: str) -> List[CheckpointedRequest]:
+        raise NotImplementedError
+
+    def query_by_tag(self, tag: str) -> List[CheckpointedRequest]:
+        raise NotImplementedError
+
+    def query_by_host(self, host: str) -> List[CheckpointedRequest]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryCheckpointStore(CheckpointStore):
+    """Thread-safe in-memory store; the test/fake-cluster backend."""
+
+    def __init__(self) -> None:
+        self._rows: Dict[Tuple[str, str], CheckpointedRequest] = {}
+        self._lock = threading.Lock()
+
+    def read_checkpoint(self, algorithm: str, id: str) -> Optional[CheckpointedRequest]:
+        with self._lock:
+            cp = self._rows.get((algorithm, id))
+            return cp.deep_copy() if cp is not None else None
+
+    def upsert_checkpoint(self, cp: CheckpointedRequest) -> None:
+        with self._lock:
+            self._rows[(cp.algorithm, cp.id)] = cp.deep_copy()
+
+    def _query(self, pred) -> List[CheckpointedRequest]:  # noqa: ANN001
+        with self._lock:
+            return [cp.deep_copy() for cp in self._rows.values() if pred(cp)]
+
+    def query_by_stage(self, stage: str) -> List[CheckpointedRequest]:
+        return self._query(lambda cp: cp.lifecycle_stage == stage)
+
+    def query_by_tag(self, tag: str) -> List[CheckpointedRequest]:
+        return self._query(lambda cp: cp.tag == tag)
+
+    def query_by_host(self, host: str) -> List[CheckpointedRequest]:
+        return self._query(lambda cp: cp.received_by_host == host)
+
+
+class SqliteCheckpointStore(CheckpointStore):
+    """Durable single-file store (local/dev runs without a CQL cluster).
+
+    Lazy: the file is opened on first query, honoring the store contract.
+    """
+
+    def __init__(self, path: str) -> None:
+        self._path = path
+        self._conn: Optional[sqlite3.Connection] = None
+        self._lock = threading.Lock()
+
+    def _connection(self) -> sqlite3.Connection:
+        if self._conn is None:
+            conn = sqlite3.connect(self._path, check_same_thread=False)
+            cols = ", ".join(f"{c} TEXT" if c != "restart_count" else f"{c} INTEGER" for c in _COLUMNS)
+            conn.execute(
+                f"CREATE TABLE IF NOT EXISTS checkpoints ({cols}, PRIMARY KEY (algorithm, id))"
+            )
+            for idx_col in ("tag", "received_by_host", "lifecycle_stage"):
+                conn.execute(
+                    f"CREATE INDEX IF NOT EXISTS idx_{idx_col} ON checkpoints ({idx_col})"
+                )
+            conn.commit()
+            self._conn = conn
+        return self._conn
+
+    def read_checkpoint(self, algorithm: str, id: str) -> Optional[CheckpointedRequest]:
+        with self._lock:
+            cur = self._connection().execute(
+                f"SELECT {', '.join(_COLUMNS)} FROM checkpoints WHERE algorithm=? AND id=?",
+                (algorithm, id),
+            )
+            row = cur.fetchone()
+        if row is None:
+            return None
+        return CheckpointedRequest.from_row(dict(zip(_COLUMNS, row)))
+
+    def upsert_checkpoint(self, cp: CheckpointedRequest) -> None:
+        row = cp.to_row()
+        values = [row[c] for c in _COLUMNS]
+        placeholders = ", ".join("?" for _ in _COLUMNS)
+        with self._lock:
+            conn = self._connection()
+            conn.execute(
+                f"INSERT OR REPLACE INTO checkpoints ({', '.join(_COLUMNS)}) VALUES ({placeholders})",
+                values,
+            )
+            conn.commit()
+
+    def _query(self, column: str, value: str) -> List[CheckpointedRequest]:
+        with self._lock:
+            cur = self._connection().execute(
+                f"SELECT {', '.join(_COLUMNS)} FROM checkpoints WHERE {column}=?", (value,)
+            )
+            rows = cur.fetchall()
+        return [CheckpointedRequest.from_row(dict(zip(_COLUMNS, r))) for r in rows]
+
+    def query_by_stage(self, stage: str) -> List[CheckpointedRequest]:
+        return self._query("lifecycle_stage", stage)
+
+    def query_by_tag(self, tag: str) -> List[CheckpointedRequest]:
+        return self._query("tag", tag)
+
+    def query_by_host(self, host: str) -> List[CheckpointedRequest]:
+        return self._query("received_by_host", host)
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                self._conn.close()
+                self._conn = None

@@ -1,0 +1,120 @@
+"""In-process fake Kubernetes client.
+
+Parity with `k8s.io/client-go/kubernetes/fake.NewClientset(objects...)` as
+used by the reference test suite (services/supervisor_test.go:40, SURVEY.md
+§3.4): pre-seeded Events/Pods/Jobs are replayed through real informers, so
+the "multi-node cluster" is simulated entirely in-process.  Additionally
+supports live injection of watch events and records all write actions for
+assertions.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
+
+from tpu_nexus.k8s.client import (
+    KIND_API,
+    PROPAGATION_BACKGROUND,
+    KubeClient,
+    NotFoundError,
+)
+from tpu_nexus.checkpoint.models import POD_JOB_NAME_LABEL
+
+
+def _key(obj: Dict[str, Any]) -> Tuple[str, str]:
+    meta = obj.get("metadata", {}) or {}
+    return (meta.get("namespace", ""), meta.get("name", ""))
+
+
+class FakeKubeClient(KubeClient):
+    def __init__(self, objects: Optional[Dict[str, List[Dict[str, Any]]]] = None) -> None:
+        """`objects` maps kind -> list of API dicts (the seeded cluster
+        state)."""
+        self._objects: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {
+            kind: {} for kind in KIND_API
+        }
+        for kind, items in (objects or {}).items():
+            for obj in items:
+                self._objects.setdefault(kind, {})[_key(obj)] = obj
+        self._watchers: Dict[str, List[asyncio.Queue]] = {kind: [] for kind in KIND_API}
+        #: recorded write actions: (verb, kind, namespace, name, extra)
+        self.actions: List[Tuple[str, str, str, str, Dict[str, Any]]] = []
+        self._rv = 1
+
+    # -- seeding / injection (test API) -------------------------------------
+
+    def inject(self, event_type: str, kind: str, obj: Dict[str, Any]) -> None:
+        """Apply a watch event to the fake cluster state and fan it out to
+        watchers (the live-injection seam the Go fake exposes via its
+        watch Reactor)."""
+        store = self._objects.setdefault(kind, {})
+        if event_type == "DELETED":
+            store.pop(_key(obj), None)
+        else:
+            store[_key(obj)] = obj
+        self._rv += 1
+        for queue in self._watchers.get(kind, []):
+            queue.put_nowait((event_type, obj))
+
+    # -- KubeClient ----------------------------------------------------------
+
+    async def list_objects(self, kind: str, namespace: str) -> Tuple[List[Dict[str, Any]], str]:
+        items = [
+            obj
+            for (ns, _), obj in self._objects.get(kind, {}).items()
+            if not namespace or ns == namespace
+        ]
+        return list(items), str(self._rv)
+
+    async def watch_objects(
+        self, kind: str, namespace: str, resource_version: Optional[str] = None
+    ) -> AsyncIterator[Tuple[str, Dict[str, Any]]]:
+        queue: asyncio.Queue = asyncio.Queue()
+        self._watchers.setdefault(kind, []).append(queue)
+        try:
+            while True:
+                event_type, obj = await queue.get()
+                ns = (obj.get("metadata") or {}).get("namespace", "")
+                if namespace and ns != namespace:
+                    continue
+                yield event_type, obj
+        finally:
+            self._watchers[kind].remove(queue)
+
+    async def create_object(self, kind: str, namespace: str, manifest: Dict[str, Any]) -> Dict[str, Any]:
+        manifest.setdefault("metadata", {}).setdefault("namespace", namespace)
+        self.actions.append(("create", kind, namespace, manifest["metadata"].get("name", ""), {}))
+        self.inject("ADDED", kind, manifest)
+        return manifest
+
+    async def delete_object(
+        self,
+        kind: str,
+        namespace: str,
+        name: str,
+        propagation: str = PROPAGATION_BACKGROUND,
+    ) -> None:
+        store = self._objects.get(kind, {})
+        obj = store.get((namespace, name))
+        self.actions.append(("delete", kind, namespace, name, {"propagation": propagation}))
+        if obj is None:
+            raise NotFoundError(f"{kind} {namespace}/{name} not found")
+        self.inject("DELETED", kind, obj)
+        if kind in ("Job", "JobSet"):
+            # background propagation: dependent pods are garbage-collected
+            # asynchronously (reference relies on DeletePropagationBackground,
+            # services/supervisor.go:262)
+            asyncio.get_running_loop().call_soon(self._gc_pods_of_job, name)
+
+    def _gc_pods_of_job(self, job_name: str) -> None:
+        pods = self._objects.get("Pod", {})
+        for key, pod in list(pods.items()):
+            labels = (pod.get("metadata") or {}).get("labels") or {}
+            if labels.get(POD_JOB_NAME_LABEL) == job_name:
+                self.inject("DELETED", "Pod", pod)
+
+    # -- assertion helpers ---------------------------------------------------
+
+    def deleted(self, kind: str) -> List[str]:
+        return [name for verb, k, _, name, _ in self.actions if verb == "delete" and k == kind]

@@ -1,0 +1,4 @@
+"""The supervision service (reference L3, services/supervisor.go)."""
+
+from tpu_nexus.supervisor.service import ProcessingConfig, Supervisor  # noqa: F401
+from tpu_nexus.supervisor.taxonomy import DecisionAction, RunStatusAnalysisResult  # noqa: F401

@@ -1,0 +1,68 @@
+"""Label/namespace-based event filtering over informer caches.
+
+Equivalent of nexus-core `resolvers.IsNexusRunEvent` /
+`resolvers.GetCachedObject[T]` as consumed at reference
+services/supervisor.go:147,160,211 (SURVEY.md §2.3):
+
+  * a "Nexus run" is a Job labeled
+    {NEXUS_COMPONENT_LABEL: JOB_LABEL_ALGORITHM_RUN} carrying
+    JOB_TEMPLATE_NAME_KEY (the algorithm name); its Pods carry the
+    k8s-standard batch.kubernetes.io/job-name backlink
+    (fixtures services/supervisor_test.go:73-76,246);
+  * lookups return None for cache misses — the stale-event drop path
+    (services/supervisor.go:161-164,218-221) — never raise.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from tpu_nexus.checkpoint.models import (
+    JOB_LABEL_ALGORITHM_RUN,
+    NEXUS_COMPONENT_LABEL,
+)
+from tpu_nexus.k8s.informer import Informer
+from tpu_nexus.k8s.objects import EventObj, JobObj, JobSetObj, PodObj
+
+
+def get_cached_object(name: str, namespace: str, informer: Optional[Informer]) -> Optional[Any]:
+    """Typed cache lookup returning None for missing objects (stale events)."""
+    if informer is None:
+        return None
+    return informer.get(name, namespace)
+
+
+def _is_run_labeled(labels: Dict[str, str]) -> bool:
+    return labels.get(NEXUS_COMPONENT_LABEL) == JOB_LABEL_ALGORITHM_RUN
+
+
+def is_nexus_run_event(
+    event: EventObj,
+    namespace: str,
+    informers: Dict[str, Informer],
+) -> bool:
+    """True iff the event's involved object is (or belongs to) a Nexus
+    algorithm run in `namespace`, resolved via the informer caches."""
+    ref = event.involved_object
+    obj_ns = ref.namespace or event.meta.namespace
+    if namespace and obj_ns != namespace:
+        return False
+    if ref.kind == "Job":
+        job: Optional[JobObj] = get_cached_object(ref.name, obj_ns, informers.get("Job"))
+        return job is not None and _is_run_labeled(job.meta.labels)
+    if ref.kind == "JobSet":
+        jobset: Optional[JobSetObj] = get_cached_object(ref.name, obj_ns, informers.get("JobSet"))
+        return jobset is not None and _is_run_labeled(jobset.meta.labels)
+    if ref.kind == "Pod":
+        pod: Optional[PodObj] = get_cached_object(ref.name, obj_ns, informers.get("Pod"))
+        if pod is None:
+            return False
+        if _is_run_labeled(pod.meta.labels):
+            return True
+        # fall back to the owning Job's labels via the job-name backlink
+        job_name = pod.job_name()
+        if not job_name:
+            return False
+        job = get_cached_object(job_name, obj_ns, informers.get("Job"))
+        return job is not None and _is_run_labeled(job.meta.labels)
+    return False

@@ -1,0 +1,335 @@
+"""Failure classification: event -> decision.
+
+Reproduces the reference's supervision state machine exactly
+(services/supervisor.go:159-258; table in SURVEY.md §2.2) and extends it
+with the TPU failure classes from the north star (BASELINE.json): ICI link
+down, XLA compile abort, TPU preemption, HBM OOM — detected from event
+reasons/messages, pod container termination states (exit codes 137/255
+parity, reference comments services/supervisor.go:310-313,336-338), and
+JobSet failure conditions.
+
+Reference-exact behavioral quirks preserved:
+  * Pod `Failed` maps to STUCK_IN_PENDING -> SCHEDULING_FAILED, not FAILED
+    (services/supervisor.go:234-243, asserted supervisor_test.go:398-401);
+  * Job `FailedCreate` -> SCHEDULING_FAILED; `DeadlineExceeded` |
+    `BackoffLimitExceeded` -> DEADLINE_EXCEEDED; `PodFailurePolicy` -> FAILED;
+  * the three human RunStatusMessage strings are byte-identical to the
+    reference's (services/supervisor.go:176,187,198).
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from tpu_nexus.checkpoint.models import JOB_TEMPLATE_NAME_KEY, LifecycleStage
+from tpu_nexus.k8s.informer import Informer
+from tpu_nexus.k8s.objects import EventObj, JobObj, JobSetObj, PodObj
+from tpu_nexus.supervisor.resolvers import get_cached_object
+
+
+class DecisionAction:
+    """Decision constants (reference DecisionAction + 4 constants,
+    services/supervisor.go:49-56; TPU classes appended)."""
+
+    TO_RUNNING = "ToRunning"
+    TO_FAIL_STUCK_IN_PENDING = "ToFailStuckInPending"
+    TO_FAIL_DEADLINE_EXCEEDED = "ToFailDeadlineExceeded"
+    TO_FAIL_FATAL_ERROR = "ToFailFatalError"
+    # -- TPU-native extensions --
+    TO_FAIL_COMPILE_ABORT = "ToFailXlaCompileAbort"
+    TO_FAIL_HBM_OOM = "ToFailHbmOom"
+    TO_FAIL_ICI_LINK_DOWN = "ToFailIciLinkDown"
+    TO_PREEMPT_RESTARTABLE = "ToPreemptRestartable"
+
+
+#: decision -> resulting lifecycle stage (SURVEY §2.2 classification table +
+#: TPU rows; preemption is NON-terminal: restart-from-step, SURVEY §7.4)
+DECISION_STAGE: Dict[str, str] = {
+    DecisionAction.TO_RUNNING: LifecycleStage.RUNNING,
+    DecisionAction.TO_FAIL_STUCK_IN_PENDING: LifecycleStage.SCHEDULING_FAILED,
+    DecisionAction.TO_FAIL_DEADLINE_EXCEEDED: LifecycleStage.DEADLINE_EXCEEDED,
+    DecisionAction.TO_FAIL_FATAL_ERROR: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_COMPILE_ABORT: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_HBM_OOM: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_ICI_LINK_DOWN: LifecycleStage.FAILED,
+    DecisionAction.TO_PREEMPT_RESTARTABLE: LifecycleStage.PREEMPTED,
+}
+
+#: decisions that delete the k8s Job (all reference fail paths delete with
+#: background propagation; ToRunning and restartable preemption do not)
+DELETES_JOB = frozenset(
+    {
+        DecisionAction.TO_FAIL_STUCK_IN_PENDING,
+        DecisionAction.TO_FAIL_DEADLINE_EXCEEDED,
+        DecisionAction.TO_FAIL_FATAL_ERROR,
+        DecisionAction.TO_FAIL_COMPILE_ABORT,
+        DecisionAction.TO_FAIL_HBM_OOM,
+        DecisionAction.TO_FAIL_ICI_LINK_DOWN,
+    }
+)
+
+# Reference-exact human messages (services/supervisor.go:176,187,198)
+MSG_STUCK_IN_PENDING = (
+    "Unable to launch a container for the algorithm - please review configuration and try again."
+)
+MSG_DEADLINE_EXCEEDED = "Algorithm exceeded its max allowed run time limit or retry attempt count."
+MSG_FATAL_ERROR = "Algorithm encountered a fatal error during execution."
+# TPU-class human messages
+MSG_COMPILE_ABORT = "Algorithm failed to compile for TPU (XLA compile abort) - review the program and shapes."
+MSG_HBM_OOM = "Algorithm exhausted TPU HBM memory - reduce batch/model size or increase sharding."
+MSG_ICI_LINK_DOWN = "TPU interconnect (ICI) link failure - the slice is unhealthy; run cannot continue."
+MSG_PREEMPTED = "TPU slice was preempted - run will restart from its last tensor checkpoint."
+
+
+@dataclass
+class RunStatusAnalysisResult:
+    """The actor's work element (reference RunStatusAnalysisResult,
+    services/supervisor.go:58-66)."""
+
+    action: str
+    algorithm_name: str
+    request_id: str
+    run_status_message: str
+    run_status_trace: str = ""
+    object_uid: str = ""
+    object_kind: str = ""
+    #: name of the involved object (pod name for Pod events) — lets the
+    #: executor re-read the freshest cached state at commit time
+    object_name: str = ""
+    #: TPU extension: object-storage ref for an HLO dump / profiler trace
+    #: extracted from the failure context (empty when not applicable)
+    hlo_trace_ref: str = ""
+    #: monotonic timestamp when the triggering event entered classification;
+    #: drives the fault-detect -> checkpoint-commit latency metric
+    detected_at: float = 0.0
+
+
+# -- TPU failure signatures ----------------------------------------------------
+# Matched (case-insensitive) against event messages and container termination
+# messages.  TPU/XLA errors surface messily (SURVEY §7.4 "hard parts"):
+# stack traces in logs, exit codes, JobSet conditions, node events.
+
+_COMPILE_ABORT_RE = re.compile(
+    r"xla.*(compil|lower)|compil\w+ (error|fail|abort)|INVALID_ARGUMENT.*(hlo|xla)|mosaic.*(error|fail)",
+    re.IGNORECASE,
+)
+_HBM_OOM_RE = re.compile(
+    r"hbm.*(oom|exhaust|exceed)|out of mem\w* .*hbm|RESOURCE_EXHAUSTED|"
+    r"allocat\w+ .*(hbm|device memory)|OOM.*tpu",
+    re.IGNORECASE,
+)
+_ICI_RE = re.compile(
+    r"ici.*(link|fail|down|error)|interconnect.*(fail|down|timeout)|"
+    r"chip to chip|DATA_LOSS.*collective|collective.*(timeout|deadlock)",
+    re.IGNORECASE,
+)
+_PREEMPT_RE = re.compile(
+    r"preempt|spot.*(reclaim|terminat)|node.*shutdown|maintenance event",
+    re.IGNORECASE,
+)
+
+_HLO_REF_RE = re.compile(r"(?:gs|s3|file)://\S+\.(?:hlo|pb|pbtxt|xplane\.pb)")
+
+
+def classify_tpu_failure(text: str) -> Optional[str]:
+    """Map raw failure text to a TPU decision, or None if not TPU-specific.
+
+    Precedence: preemption (infrastructure, restartable) > ICI (infrastructure,
+    terminal) > HBM OOM > compile abort — infrastructure causes win over
+    program causes when both appear in one trace.
+    """
+    if not text:
+        return None
+    if _PREEMPT_RE.search(text):
+        return DecisionAction.TO_PREEMPT_RESTARTABLE
+    if _ICI_RE.search(text):
+        return DecisionAction.TO_FAIL_ICI_LINK_DOWN
+    if _HBM_OOM_RE.search(text):
+        return DecisionAction.TO_FAIL_HBM_OOM
+    if _COMPILE_ABORT_RE.search(text):
+        return DecisionAction.TO_FAIL_COMPILE_ABORT
+    return None
+
+
+def extract_hlo_trace_ref(text: str) -> str:
+    m = _HLO_REF_RE.search(text or "")
+    return m.group(0) if m else ""
+
+
+def _tpu_message(action: str) -> str:
+    return {
+        DecisionAction.TO_FAIL_COMPILE_ABORT: MSG_COMPILE_ABORT,
+        DecisionAction.TO_FAIL_HBM_OOM: MSG_HBM_OOM,
+        DecisionAction.TO_FAIL_ICI_LINK_DOWN: MSG_ICI_LINK_DOWN,
+        DecisionAction.TO_PREEMPT_RESTARTABLE: MSG_PREEMPTED,
+    }[action]
+
+
+def _pod_termination_text(pod: PodObj) -> str:
+    """Concatenated container termination reasons/messages — where TPU
+    runtime errors usually surface."""
+    parts = []
+    for cs in pod.container_statuses:
+        if cs.terminated is not None:
+            parts.append(f"{cs.terminated.reason}: {cs.terminated.message} (exit {cs.terminated.exit_code})")
+        if cs.waiting_reason:
+            parts.append(cs.waiting_reason)
+    return "\n".join(parts)
+
+
+def _result(
+    action: str,
+    algorithm: str,
+    request_id: str,
+    message: str,
+    trace: str,
+    uid: str,
+    kind: str,
+    detected_at: float,
+    object_name: str = "",
+) -> RunStatusAnalysisResult:
+    return RunStatusAnalysisResult(
+        action=action,
+        algorithm_name=algorithm,
+        request_id=request_id,
+        run_status_message=message,
+        run_status_trace=trace,
+        object_uid=uid,
+        object_kind=kind,
+        object_name=object_name or request_id,
+        hlo_trace_ref=extract_hlo_trace_ref(trace),
+        detected_at=detected_at,
+    )
+
+
+def classify_event(
+    event: EventObj,
+    namespace: str,
+    informers: Dict[str, Informer],
+    detected_at: float = 0.0,
+) -> Optional[RunStatusAnalysisResult]:
+    """The reference's onEvent switch (services/supervisor.go:159-258),
+    with a TPU-signature pass layered in front of the generic mapping for
+    failure-ish events.  Returns None for drops/no-ops."""
+    ref = event.involved_object
+    obj_ns = ref.namespace or event.meta.namespace
+
+    if ref.kind == "Job":
+        job: Optional[JobObj] = get_cached_object(ref.name, obj_ns, informers.get("Job"))
+        if job is None:
+            return None  # stale event: job no longer cached (reference :161-164)
+        # the k8s Job name IS the request id; the template label carries the
+        # algorithm name (reference :160,177-181)
+        request_id = job.meta.name
+        algorithm = job.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
+        uid, kind = job.meta.uid, "Job"
+        if event.reason == "FailedCreate":
+            return _result(
+                DecisionAction.TO_FAIL_STUCK_IN_PENDING,
+                algorithm, request_id, MSG_STUCK_IN_PENDING, event.message, uid, kind, detected_at,
+            )
+        if event.reason in ("DeadlineExceeded", "BackoffLimitExceeded"):
+            return _result(
+                DecisionAction.TO_FAIL_DEADLINE_EXCEEDED,
+                algorithm, request_id, MSG_DEADLINE_EXCEEDED, event.message, uid, kind, detected_at,
+            )
+        if event.reason == "PodFailurePolicy":
+            # mainly covers exit 137 (OOM) and 255 (unknown fatal),
+            # reference comments :310-313,336-338; check for TPU signatures
+            # in the event message first
+            tpu_action = classify_tpu_failure(event.message)
+            if tpu_action is not None:
+                return _result(
+                    tpu_action, algorithm, request_id, _tpu_message(tpu_action),
+                    event.message, uid, kind, detected_at,
+                )
+            return _result(
+                DecisionAction.TO_FAIL_FATAL_ERROR,
+                algorithm, request_id, MSG_FATAL_ERROR, event.message, uid, kind, detected_at,
+            )
+        return None  # anything else ignored (reference :205-206)
+
+    if ref.kind == "JobSet":
+        # TPU-native extension: multi-host runs are JobSets; failure
+        # conditions carry aggregated child-job failure reasons
+        jobset: Optional[JobSetObj] = get_cached_object(ref.name, obj_ns, informers.get("JobSet"))
+        if jobset is None:
+            return None
+        request_id = jobset.meta.name
+        algorithm = jobset.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
+        uid, kind = jobset.meta.uid, "JobSet"
+        text = event.message or "\n".join(c.message for c in jobset.conditions)
+        tpu_action = classify_tpu_failure(f"{event.reason}\n{text}")
+        if tpu_action is not None:
+            return _result(
+                tpu_action, algorithm, request_id, _tpu_message(tpu_action),
+                text, uid, kind, detected_at,
+            )
+        if event.reason in ("FailedCreate", "SuspendedJobs"):
+            return _result(
+                DecisionAction.TO_FAIL_STUCK_IN_PENDING,
+                algorithm, request_id, MSG_STUCK_IN_PENDING, text, uid, kind, detected_at,
+            )
+        if event.reason in ("DeadlineExceeded", "FailedJobs"):
+            action = (
+                DecisionAction.TO_FAIL_DEADLINE_EXCEEDED
+                if event.reason == "DeadlineExceeded"
+                else DecisionAction.TO_FAIL_FATAL_ERROR
+            )
+            msg = MSG_DEADLINE_EXCEEDED if event.reason == "DeadlineExceeded" else MSG_FATAL_ERROR
+            return _result(action, algorithm, request_id, msg, text, uid, kind, detected_at)
+        if event.reason == "Started":
+            return _result(
+                DecisionAction.TO_RUNNING, algorithm, request_id, event.reason, text, uid, kind, detected_at,
+            )
+        return None
+
+    if ref.kind == "Pod":
+        pod: Optional[PodObj] = get_cached_object(ref.name, obj_ns, informers.get("Pod"))
+        if pod is None:
+            return None  # stale (reference :218-221)
+        # pod -> run id via the job-name backlink (reference :231,241,251)
+        request_id = pod.job_name()
+        job = get_cached_object(request_id, obj_ns, informers.get("Job")) if request_id else None
+        algorithm = (
+            job.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
+            if job is not None
+            else pod.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
+        )
+        uid, kind = pod.meta.uid, "Pod"
+        if event.reason == "Started":
+            return _result(
+                DecisionAction.TO_RUNNING, algorithm, request_id, event.reason,
+                event.message, uid, kind, detected_at, pod.meta.name,
+            )
+        if event.reason in ("Failed", "BackOff"):
+            # TPU signature pass over event message + container termination text
+            text = f"{event.message}\n{_pod_termination_text(pod)}".strip()
+            tpu_action = classify_tpu_failure(text)
+            if tpu_action is not None:
+                return _result(
+                    tpu_action, algorithm, request_id, _tpu_message(tpu_action),
+                    text, uid, kind, detected_at, pod.meta.name,
+                )
+            if event.reason == "Failed":
+                # quirk preserved: Pod Failed -> STUCK_IN_PENDING ->
+                # SCHEDULING_FAILED, not FAILED (reference :234-243)
+                return _result(
+                    DecisionAction.TO_FAIL_STUCK_IN_PENDING,
+                    algorithm, request_id, event.reason, text, uid, kind, detected_at, pod.meta.name,
+                )
+            return _result(
+                DecisionAction.TO_FAIL_FATAL_ERROR,
+                algorithm, request_id, event.reason, text, uid, kind, detected_at, pod.meta.name,
+            )
+        if event.reason in ("TPUPreempted", "Preempted", "Evicted"):
+            text = f"{event.message}\n{_pod_termination_text(pod)}".strip()
+            return _result(
+                DecisionAction.TO_PREEMPT_RESTARTABLE,
+                algorithm, request_id, MSG_PREEMPTED, text, uid, kind, detected_at, pod.meta.name,
+            )
+        return None  # logged no-op upstream (reference :254-257)
+
+    return None
