@@ -12,10 +12,21 @@ import asyncio
 import inspect
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: TPU tunnel environments pin JAX_PLATFORMS to the
+# hardware plugin (and sitecustomize may import jax before conftest runs),
+# but unit tests always run on the virtual CPU mesh — the real chip is
+# reserved for bench.py.  Env vars cover fresh subprocesses; the
+# jax.config.update calls below cover this process even though jax may
+# already be imported (backends initialize lazily, config wins over env).
+os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 
 def pytest_pyfunc_call(pyfuncitem):

@@ -18,7 +18,11 @@ Layout (mirrors SURVEY.md §7.2 build order):
   k8s/         kube client interface, fake client, shared informers
   supervisor/  the supervision service: classification + decision execution
   launcher/    JobSet composition for jax.distributed TPU jobs
-  workload/    JAX training harness: models/ ops/ parallel/ (TPU compute path)
+  parallel/    device meshes, sharding rules, distributed bootstrap, ring
+               attention (context parallelism)
+  models/      model zoo: Llama family (flagship), MNIST
+  ops/         pallas TPU kernels with XLA fallbacks
+  workload/    JAX training harness: train step/loop, heartbeats, tensor ckpt
   app/         dependency-injection builder + typed app config
 """
 

@@ -1,0 +1,33 @@
+"""TPU-native parallelism subsystem.
+
+The reference (SneaksAndData/nexus-supervisor) contains no parallelism or
+communication code at all (SURVEY.md §2.7) — its supervised "algorithm jobs"
+are opaque containers.  In the TPU-native rebuild the supervised workloads are
+JAX programs, so this package is where scale lives:
+
+* ``mesh``        — device-mesh construction (dp / fsdp / tp / sp / ep axes);
+* ``sharding``    — logical-axis → mesh-axis rule system (NamedSharding);
+* ``distributed`` — multi-host bootstrap for ``jax.distributed`` processes
+                    launched by :mod:`tpu_nexus.launcher` (coordinator address
+                    via JobSet headless-service DNS);
+* ``ring``        — ring attention (context/sequence parallelism) built on
+                    ``shard_map`` + ``ppermute`` so collectives ride ICI.
+"""
+
+from tpu_nexus.parallel.mesh import MeshSpec, build_mesh, local_mesh
+from tpu_nexus.parallel.sharding import (
+    LOGICAL_RULES_1D,
+    LOGICAL_RULES_FSDP_TP,
+    logical_to_sharding,
+    shard_pytree,
+)
+
+__all__ = [
+    "MeshSpec",
+    "build_mesh",
+    "local_mesh",
+    "LOGICAL_RULES_1D",
+    "LOGICAL_RULES_FSDP_TP",
+    "logical_to_sharding",
+    "shard_pytree",
+]

@@ -1,0 +1,111 @@
+"""Multi-host bootstrap for launched workloads.
+
+The reference has no distributed communication backend (SURVEY.md §2.7 — its
+only I/O is k8s watches, CQL, statsd).  The TPU-native equivalent for the
+*launched jobs* is XLA collectives over ICI (intra-slice) and DCN
+(inter-slice), bootstrapped by ``jax.distributed.initialize`` with a
+coordinator address injected by the launcher (SURVEY.md §5.8): the JobSet
+manifest composed by :mod:`tpu_nexus.launcher.jobset` points every worker at
+replica 0's headless-service DNS name.
+
+Env contract (set by the launcher, read here):
+
+* ``NEXUS_COORDINATOR_ADDRESS`` — ``<pod-0-dns>:<port>``;
+* ``NEXUS_PROCESS_ID``          — this process's index (JobSet completion
+                                  index);
+* ``NEXUS_NUM_PROCESSES``       — world size;
+* ``NEXUS_RUN_ID`` / ``NEXUS_ALGORITHM`` — ledger key for heartbeats.
+
+On Cloud TPU all four can be auto-detected by JAX's TPU metadata plugin, so
+every variable is optional; explicit env wins so the same code runs under
+plain k8s Jobs, JobSets, and local fault-injection tests.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_COORDINATOR = "NEXUS_COORDINATOR_ADDRESS"
+ENV_PROCESS_ID = "NEXUS_PROCESS_ID"
+ENV_NUM_PROCESSES = "NEXUS_NUM_PROCESSES"
+ENV_RUN_ID = "NEXUS_RUN_ID"
+ENV_ALGORITHM = "NEXUS_ALGORITHM"
+
+
+@dataclass(frozen=True)
+class ProcessContext:
+    """Identity of this process within a launched run."""
+
+    run_id: str
+    algorithm: str
+    process_id: int
+    num_processes: int
+    coordinator: Optional[str]
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
+
+    def chip_key(self, local_device_index: int) -> str:
+        """Ledger key for per-chip step counters, e.g. ``host2/chip1``
+        (checkpoint column ``per_chip_steps``, north-star extension)."""
+        return f"host{self.process_id}/chip{local_device_index}"
+
+
+def process_context_from_env(env: Optional[dict] = None) -> ProcessContext:
+    e = os.environ if env is None else env
+    num_processes = int(e.get(ENV_NUM_PROCESSES, "1"))
+    if num_processes > 1 and ENV_PROCESS_ID not in e:
+        # without this, every worker would default to process_id=0: all would
+        # claim coordinatorship and write colliding host0/chipN ledger keys
+        raise ValueError(
+            f"{ENV_NUM_PROCESSES}={num_processes} but {ENV_PROCESS_ID} is unset; "
+            "the launcher must inject the JobSet completion index"
+        )
+    return ProcessContext(
+        run_id=e.get(ENV_RUN_ID, "local"),
+        algorithm=e.get(ENV_ALGORITHM, "local"),
+        process_id=int(e.get(ENV_PROCESS_ID, "0")),
+        num_processes=num_processes,
+        coordinator=e.get(ENV_COORDINATOR),
+    )
+
+
+def initialize_distributed(ctx: Optional[ProcessContext] = None) -> ProcessContext:
+    """Bring up the JAX distributed runtime when the run is multi-process.
+
+    Single-process runs (unit tests, local CPU jobs — BASELINE config #2)
+    skip initialization entirely; multi-process runs block until all
+    ``num_processes`` workers reach the coordinator.
+    """
+    ctx = ctx or process_context_from_env()
+    if ctx.num_processes <= 1:
+        logger.debug("single-process run; skipping jax.distributed.initialize")
+        return ctx
+    import dataclasses
+
+    import jax
+
+    kwargs = {}
+    if ctx.coordinator:
+        kwargs = dict(
+            coordinator_address=ctx.coordinator,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
+    logger.info(
+        "initializing jax.distributed: process %d/%d coordinator=%s",
+        ctx.process_id,
+        ctx.num_processes,
+        ctx.coordinator or "<auto>",
+    )
+    jax.distributed.initialize(**kwargs)
+    # the runtime's view is authoritative (auto-detect may renumber processes)
+    return dataclasses.replace(
+        ctx, process_id=jax.process_index(), num_processes=jax.process_count()
+    )

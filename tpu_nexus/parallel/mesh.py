@@ -1,0 +1,89 @@
+"""Device-mesh construction.
+
+No reference counterpart (SURVEY.md §2.7: parallelism strategies ABSENT in the
+reference) — this is the TPU-first foundation for the workload harness.  The
+mesh axes follow the standard megascale naming:
+
+* ``dp``   — pure data parallelism (gradients all-reduced, params replicated);
+* ``fsdp`` — data parallelism with fully-sharded parameters (params/opt-state
+             sharded over this axis, all-gathered per layer on use);
+* ``tp``   — tensor (model) parallelism over hidden/head dimensions;
+* ``sp``   — sequence/context parallelism (ring attention over this axis);
+* ``ep``   — expert parallelism for MoE layers.
+
+Collectives over these axes are inserted by XLA from sharding annotations
+(GSPMD) — nothing here issues a collective by hand; ``tpu_nexus.parallel.ring``
+is the one place that does (shard_map + ppermute).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+#: canonical axis order — keep ICI-heavy axes (tp, sp) innermost so that on a
+#: real slice they land on physically adjacent chips (torus neighbours) and
+#: their collectives ride ICI, while dp/fsdp ride the outer (possibly DCN)
+#: dimension.  jax.devices() orders devices host-major, so the *last* mesh
+#: axes get intra-host/intra-slice neighbours.
+AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape.  Sizes must multiply to the device count; a
+    single ``-1`` axis is inferred (numpy-reshape style)."""
+
+    dp: int = 1
+    fsdp: int = -1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> Tuple[int, ...]:
+        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+
+    def resolve(self, n_devices: int) -> Tuple[int, ...]:
+        """Concretize the one allowed ``-1`` against ``n_devices``."""
+        sizes = list(self.sizes())
+        if any(s == 0 or s < -1 for s in sizes):
+            raise ValueError(f"axis sizes must be -1 or >= 1, got spec {self}")
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis allowed, got spec {self}")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(f"{n_devices} devices not divisible by fixed axes product {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(f"mesh {sizes} wants {fixed} devices, have {n_devices}")
+        return tuple(sizes)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        return AXIS_ORDER
+
+
+def build_mesh(
+    spec: Optional[MeshSpec] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a :class:`jax.sharding.Mesh` over ``devices`` (default: all).
+
+    Trivial (size-1) axes are kept in the mesh — partition specs can then
+    always name every logical axis and XLA drops the no-op dimensions.
+    """
+    spec = spec or MeshSpec()
+    devs = np.asarray(devices if devices is not None else jax.devices())
+    sizes = spec.resolve(devs.size)
+    return Mesh(devs.reshape(sizes), AXIS_ORDER)
+
+
+def local_mesh(spec: Optional[MeshSpec] = None) -> Mesh:
+    """Mesh over this process's addressable devices only (single-host)."""
+    return build_mesh(spec, devices=jax.local_devices())

@@ -1,0 +1,155 @@
+"""Ring attention: context/sequence parallelism over the ``sp`` mesh axis.
+
+No reference counterpart (SURVEY.md §5.7 — long-context ABSENT in the
+reference); this is a first-class capability of the TPU-native framework.
+Design: the sequence is sharded over ``sp``; each device keeps its Q shard
+resident and the K/V shards rotate around the ring via ``ppermute`` (one hop
+per step, riding ICI on a real slice).  Attention is accumulated block-by-block
+with the flash-attention online-softmax recurrence, so memory stays
+O(local_seq²) per step and the full sequence never materializes on one chip.
+
+All math accumulates in float32 regardless of input dtype (bf16 inputs are
+fine — the MXU consumes bf16, the running softmax state is f32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+_NEG_INF = -1e30
+
+
+def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
+    """One (Q-block × KV-block) attention step with GQA support.
+
+    Shapes: q [B, Sq, Hq, D]; k, v [B, Sk, Hkv, D], Hq % Hkv == 0.
+    Returns (scores-exp @ v partial [B, Sq, Hq, D] in f32,
+             row max  [B, Sq, Hq] f32,
+             row sum  [B, Sq, Hq] f32).
+    """
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    # [B, Hkv, G, Sq, Sk] in f32 straight off the MXU
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
+        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [B, Hkv, G, Sq]
+    p = jnp.exp(scores - m[..., None])
+    l = jnp.sum(p, axis=-1)  # [B, Hkv, G, Sq]
+    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    pv = pv.reshape(b, sq, hq, d)
+    m = jnp.moveaxis(m, 3, 1).reshape(b, sq, hq)
+    l = jnp.moveaxis(l, 3, 1).reshape(b, sq, hq)
+    return pv, m, l
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Attention over a sequence sharded on ``axis_name``.
+
+    Must be called inside ``shard_map`` (or ``jit`` with the axis bound);
+    q/k/v are the *local* shards ``[B, S_local, H, D]``.  K/V blocks rotate
+    ring-wise; each step combines via the online-softmax recurrence:
+
+        m' = max(m, m_blk); l' = l·e^{m−m'} + l_blk·e^{m_blk−m'}
+        acc' = acc·e^{m−m'} + pv_blk·e^{m_blk−m'}
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    q_offset = my * s
+
+    # derive the init carry from q so its varying-manual-axes (vma) type
+    # matches the loop body's output under shard_map's tracking
+    zero = q[..., 0].astype(jnp.float32) * 0.0  # [B, S, H]
+    init = (q.astype(jnp.float32) * 0.0, zero + _NEG_INF, zero)
+    # backward rotation: after step t the local block is the one that
+    # originated on device (my + t) % n, so every device sees every KV shard.
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    def accumulate(state, k_blk, v_blk, src):
+        acc, m, l = state
+        pv, m_blk, l_blk = _block_attention(q, k_blk, v_blk, q_offset, src * s, causal, scale)
+        m_new = jnp.maximum(m, m_blk)
+        # guard fully-masked rows: e^{-inf - -inf} -> e^0 would poison acc
+        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        beta = jnp.where(m_blk == _NEG_INF, 0.0, jnp.exp(m_blk - m_new))
+        return (
+            acc * alpha[..., None] + pv * beta[..., None],
+            m_new,
+            l * alpha + l_blk * beta,
+        )
+
+    def visit(state, k_blk, v_blk, t):
+        src = (my + t) % n
+        if not causal:
+            return accumulate(state, k_blk, v_blk, src)
+        # src > my ⇒ every key position follows every query position: the
+        # whole block is masked — skip its einsums (≈2x FLOPs at large sp).
+        # The predicate is device-local, which is fine: no collectives inside.
+        return jax.lax.cond(
+            src > my,
+            lambda st: st,
+            lambda st: accumulate(st, k_blk, v_blk, src),
+            state,
+        )
+
+    def step(t, carry):
+        state, k_blk, v_blk = carry
+        state = visit(state, k_blk, v_blk, t)
+        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
+        return state, k_next, v_next
+
+    # n-1 rotated steps, then the final block without the discarded rotation
+    state, k_last, v_last = jax.lax.fori_loop(0, n - 1, step, (init, k, v))
+    acc, m, l = visit(state, k_last, v_last, n - 1)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    *,
+    causal: bool = True,
+    batch_axes=("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: str = "tp",
+) -> jax.Array:
+    """shard_map entry point: global ``[B, S, H, D]`` arrays, sequence sharded
+    over ``sp``, heads over ``tp``, batch over ``(dp, fsdp)``."""
+    spec = P(batch_axes, seq_axis, head_axis, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
