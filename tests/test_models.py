@@ -1,0 +1,140 @@
+"""Model zoo tests: shapes, determinism, sharded execution on the CPU mesh,
+ring-attention injection equivalence."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_nexus.models import (
+    LlamaConfig,
+    llama_axes,
+    llama_forward,
+    llama_init,
+    MnistConfig,
+    mnist_axes,
+    mnist_forward,
+    mnist_init,
+)
+from tpu_nexus.models.llama import param_count
+from tpu_nexus.parallel import (
+    LOGICAL_RULES_FSDP_TP,
+    MeshSpec,
+    build_mesh,
+    shard_pytree,
+)
+from tpu_nexus.parallel.ring import ring_attention_sharded
+
+
+class TestLlama:
+    def test_forward_shape_and_finite(self):
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0, cfg.vocab_size)
+        logits = llama_forward(params, tokens, cfg)
+        assert logits.shape == (2, 32, cfg.vocab_size)
+        assert bool(jnp.all(jnp.isfinite(logits.astype(jnp.float32))))
+
+    def test_axes_tree_matches_params(self):
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        axes = llama_axes(cfg)
+        flat_p = jax.tree.structure(params)
+        flat_a = jax.tree.structure(axes, is_leaf=lambda x: isinstance(x, tuple))
+        assert flat_p == flat_a
+        # every axes tuple matches its param's rank
+        jax.tree.map(
+            lambda p, a: (_ for _ in ()).throw(AssertionError(f"{p.shape} vs {a}"))
+            if p.ndim != len(a)
+            else None,
+            params,
+            axes,
+            is_leaf=lambda x: isinstance(x, tuple) or hasattr(x, "ndim"),
+        )
+
+    def test_causality(self):
+        """Changing a future token must not change past logits."""
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        t1 = jnp.zeros((1, 16), jnp.int32)
+        t2 = t1.at[0, 10].set(7)
+        l1 = llama_forward(params, t1, cfg)
+        l2 = llama_forward(params, t2, cfg)
+        np.testing.assert_allclose(
+            np.asarray(l1[0, :10].astype(jnp.float32)),
+            np.asarray(l2[0, :10].astype(jnp.float32)),
+            rtol=1e-5,
+            atol=1e-5,
+        )
+        assert not np.allclose(
+            np.asarray(l1[0, 10].astype(jnp.float32)), np.asarray(l2[0, 10].astype(jnp.float32))
+        )
+
+    def test_sharded_forward_matches_unsharded(self):
+        import dataclasses
+
+        # f32 compute: bf16 reduction-order noise across shardings would
+        # swamp the comparison; sharding equivalence is what's under test
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        ref = llama_forward(params, tokens, cfg)
+
+        mesh = build_mesh(MeshSpec(fsdp=2, tp=2, sp=2))
+        sharded = shard_pytree(params, llama_axes(cfg), mesh, LOGICAL_RULES_FSDP_TP)
+        with mesh:
+            out = jax.jit(functools.partial(llama_forward, cfg=cfg))(sharded, tokens)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_ring_attention_injection_matches_default(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), dtype=jnp.float32)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0, cfg.vocab_size)
+        ref = llama_forward(params, tokens, cfg)
+
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4))
+        ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=None)
+
+        def attn(q, k, v, causal=True):
+            return ring(q, k, v, causal=causal)
+
+        out = llama_forward(params, tokens, cfg, attn_fn=attn)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4
+        )
+
+    def test_param_count_8b(self):
+        n = param_count(LlamaConfig.llama3_8b())
+        assert 7.9e9 < n < 8.2e9, n
+
+    def test_tied_embeddings(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), tied_embeddings=True)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        assert "lm_head" not in params
+        tokens = jnp.zeros((1, 8), jnp.int32)
+        logits = llama_forward(params, tokens, cfg)
+        assert logits.shape == (1, 8, cfg.vocab_size)
+
+
+class TestMnist:
+    def test_forward(self):
+        cfg = MnistConfig()
+        params = mnist_init(jax.random.PRNGKey(0), cfg)
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 784))
+        logits = mnist_forward(params, x, cfg)
+        assert logits.shape == (8, 10)
+
+    def test_axes_structure(self):
+        cfg = MnistConfig()
+        params = mnist_init(jax.random.PRNGKey(0), cfg)
+        axes = mnist_axes(cfg)
+        assert jax.tree.structure(params) == jax.tree.structure(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )

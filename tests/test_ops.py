@@ -1,0 +1,83 @@
+"""Kernel-layer tests: pallas flash attention (interpreter mode on the CPU
+mesh) and rmsnorm against their XLA references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.ops import attention, dense_attention, rms_norm
+from tpu_nexus.ops.flash_attention import flash_attention
+from tpu_nexus.ops.rmsnorm import rms_norm_pallas
+
+
+def rand_qkv(key, b=1, s=256, hq=2, hkv=1, d=128, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, hq, d), dtype),
+        jax.random.normal(kk, (b, s, hkv, d), dtype),
+        jax.random.normal(kv, (b, s, hkv, d), dtype),
+    )
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        q, k, v = rand_qkv(jax.random.PRNGKey(0))
+        out = flash_attention(q, k, v, causal=causal, interpret=True)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_gqa_grouping(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(1), hq=4, hkv=2)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def test_grads_match_dense(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(2), s=128)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+
+    def test_bf16(self):
+        q, k, v = rand_qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, causal=True, interpret=True)
+        assert out.dtype == jnp.bfloat16
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
+        )
+
+    def test_dispatch_falls_back_off_tpu(self):
+        # on the CPU test mesh, impl="auto" must route to the XLA path
+        q, k, v = rand_qkv(jax.random.PRNGKey(4), s=64, d=32)
+        out = attention(q, k, v, causal=True, impl="auto")
+        ref = dense_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestRmsNorm:
+    def test_pallas_matches_xla(self):
+        x = jax.random.normal(jax.random.PRNGKey(0), (4, 256, 128))
+        w = jax.random.normal(jax.random.PRNGKey(1), (128,)) + 1.0
+        out = rms_norm_pallas(x, w, interpret=True)
+        ref = rms_norm(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_bf16_f32_reduction(self):
+        x = (jax.random.normal(jax.random.PRNGKey(2), (8, 64)) * 30).astype(jnp.bfloat16)
+        w = jnp.ones((64,))
+        out = rms_norm(x, w)
+        assert out.dtype == jnp.bfloat16
+        # rms of output ~1
+        rms = float(jnp.sqrt(jnp.mean(jnp.square(out.astype(jnp.float32)))))
+        assert 0.9 < rms < 1.1

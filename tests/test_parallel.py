@@ -22,20 +22,7 @@ from tpu_nexus.parallel.distributed import (
 from tpu_nexus.parallel.ring import ring_attention_sharded
 
 
-def dense_attention(q, k, v, causal=True):
-    """Reference O(S^2) attention, f32."""
-    qf, kf, vf = q.astype(jnp.float32), k.astype(jnp.float32), v.astype(jnp.float32)
-    b, s, hq, d = qf.shape
-    hkv = kf.shape[2]
-    if hkv != hq:
-        kf = jnp.repeat(kf, hq // hkv, axis=2)
-        vf = jnp.repeat(vf, hq // hkv, axis=2)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * (d**-0.5)
-    if causal:
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask, scores, -jnp.inf)
-    w = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+from tpu_nexus.ops import dense_attention
 
 
 class TestMesh:
@@ -114,7 +101,7 @@ class TestRingAttention:
         assert out.dtype == jnp.bfloat16
         ref = dense_attention(q, q, q, causal=True)
         np.testing.assert_allclose(
-            np.asarray(out, np.float32), np.asarray(ref), rtol=3e-2, atol=3e-2
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=3e-2, atol=3e-2
         )
 
 

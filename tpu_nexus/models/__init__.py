@@ -1,0 +1,22 @@
+"""Model zoo for the supervised-workload harness.
+
+The reference supervises opaque algorithm containers (SURVEY.md §2.7); the
+TPU-native framework ships the algorithms themselves as JAX programs.  The
+flagship family is Llama-3 (BASELINE.json configs #4/#5: Llama-3-8B
+jax.distributed pretrain); MNIST covers the small single-slice demo
+(config #3).
+"""
+
+from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_forward, llama_init
+from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
+
+__all__ = [
+    "LlamaConfig",
+    "llama_axes",
+    "llama_forward",
+    "llama_init",
+    "MnistConfig",
+    "mnist_axes",
+    "mnist_forward",
+    "mnist_init",
+]

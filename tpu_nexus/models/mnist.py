@@ -1,0 +1,60 @@
+"""MNIST MLP — the small single-slice demo workload (BASELINE.json config #3:
+"single v5e-4 TPU VM: JAX MNIST train job, classify XLA-compile-abort
+failure").  Same functional conventions as the flagship model."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class MnistConfig:
+    input_dim: int = 784
+    hidden: int = 512
+    n_classes: int = 10
+    n_layers: int = 2
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+def mnist_axes(cfg: MnistConfig) -> Dict[str, Any]:
+    return {
+        "in": {"w": (None, "embed"), "b": ("embed",)},
+        "hidden": {"w": (None, "embed", "mlp"), "b": (None, "mlp")},
+        "out": {"w": ("embed", None), "b": (None,)},
+    }
+
+
+def mnist_init(key: jax.Array, cfg: MnistConfig) -> Dict[str, Any]:
+    k_in, k_h, k_out = jax.random.split(key, 3)
+    pd = cfg.param_dtype
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(pd)
+
+    return {
+        "in": {"w": normal(k_in, (cfg.input_dim, cfg.hidden), cfg.input_dim),
+               "b": jnp.zeros((cfg.hidden,), pd)},
+        "hidden": {
+            "w": normal(k_h, (cfg.n_layers, cfg.hidden, cfg.hidden), cfg.hidden),
+            "b": jnp.zeros((cfg.n_layers, cfg.hidden), pd),
+        },
+        "out": {"w": normal(k_out, (cfg.hidden, cfg.n_classes), cfg.hidden),
+                "b": jnp.zeros((cfg.n_classes,), pd)},
+    }
+
+
+def mnist_forward(params: Dict[str, Any], x: jax.Array, cfg: MnistConfig) -> jax.Array:
+    """Logits [B, n_classes] for flattened images [B, 784]."""
+    ct = cfg.dtype
+    h = jax.nn.relu(x.astype(ct) @ params["in"]["w"].astype(ct) + params["in"]["b"].astype(ct))
+
+    def layer(h, p):
+        return jax.nn.relu(h @ p["w"].astype(ct) + p["b"].astype(ct)), None
+
+    h, _ = jax.lax.scan(layer, h, params["hidden"])
+    return h @ params["out"]["w"].astype(ct) + params["out"]["b"].astype(ct)

@@ -1,0 +1,15 @@
+"""TPU kernel layer: pallas kernels for the hot ops, XLA fallbacks elsewhere.
+
+No reference counterpart (the reference has no compute code at all,
+SURVEY.md §2.7) — this package exists because the TPU-native framework ships
+the workload compute path.  Policy: only hand-write what XLA can't already
+fuse well.  Attention is the one op where a kernel beats XLA's pattern
+(O(S²) score materialization in HBM); norms/rotary/matmuls are left to XLA
+fusion, with a pallas rmsnorm kept as a reference kernel and for the
+fused-residual variant.
+"""
+
+from tpu_nexus.ops.attention import attention, dense_attention
+from tpu_nexus.ops.rmsnorm import rms_norm
+
+__all__ = ["attention", "dense_attention", "rms_norm"]

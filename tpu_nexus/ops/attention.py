@@ -1,0 +1,80 @@
+"""Attention dispatch: pallas flash kernel on TPU, XLA einsum elsewhere.
+
+Layout convention throughout the framework: ``[batch, seq, heads, head_dim]``
+(the layout the mesh shards naturally: batch over dp/fsdp, seq over sp,
+heads over tp).  GQA is first-class: ``k``/``v`` may have fewer heads than
+``q`` as long as the count divides evenly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _gqa_expand(q, k, v):
+    """Validate head counts; return the group factor."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if hq % hkv:
+        raise ValueError(f"q heads {hq} not divisible by kv heads {hkv}")
+    return hq // hkv
+
+
+def dense_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference XLA attention (O(S²) scores), GQA-aware, f32 accumulation.
+
+    This is the CPU/fallback path and the numerical ground truth the pallas
+    kernel is tested against.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    g = _gqa_expand(q, k, v)
+    b, sq, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    qg = q.reshape(b, sq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0) + (sk - sq)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", w, v, preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    impl: str = "auto",
+) -> jax.Array:
+    """Main entry point. ``impl``: "auto" | "pallas" | "xla".
+
+    "auto" picks the pallas flash kernel when running on TPU with
+    kernel-compatible shapes (seq and head_dim multiples of the tile sizes),
+    else the XLA path.  Both paths are differentiable.
+    """
+    if impl == "xla":
+        return dense_attention(q, k, v, causal=causal, scale=scale)
+    from tpu_nexus.ops.flash_attention import flash_attention, flash_supported
+
+    if impl == "pallas":
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    if flash_supported(q, k, v):
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    return dense_attention(q, k, v, causal=causal, scale=scale)

@@ -1,0 +1,187 @@
+"""Pallas TPU flash attention (forward kernel + recompute backward).
+
+Fused online-softmax attention: scores never materialize in HBM, the K/V
+stream is consumed block-by-block from VMEM, accumulation is f32 on the MXU.
+Kernel follows the pallas_guide playbook: grid over (batch, q-head, q-block),
+K/V blocked per kv-head (GQA via index_map integer division), causal blocks
+past the diagonal skipped entirely via a dynamic fori_loop trip count.
+
+Backward is recompute-based (jax.vjp over the XLA reference): correct and
+memory-light under ``jax.checkpoint``-style training; a dedicated pallas
+backward kernel is a later optimization.
+
+Shapes: q [B, S, Hq, D], k/v [B, S, Hkv, D]; Hq % Hkv == 0; D % 128 == 0;
+S % BLOCK == 0.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_nexus.ops.attention import dense_attention
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def flash_supported(q, k, v) -> bool:
+    """Shapes the kernel handles; callers fall back to XLA otherwise."""
+    b, s, hq, d = q.shape
+    sk = k.shape[1]
+    return (
+        _on_tpu()
+        and d % 128 == 0
+        and s % BLOCK_Q == 0
+        and sk % BLOCK_K == 0
+        # kernel masks with q_pos anchored at 0: self-attention only (decode
+        # shapes sq != sk would mis-mask — they take the XLA path)
+        and s == sk
+        and hq % k.shape[2] == 0
+        # full K/V per kv-head must sit in VMEM next to q/acc blocks
+        and sk * d * k.dtype.itemsize <= 4 * 1024 * 1024
+    )
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, s_k: int):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :]  # [BLOCK_Q, D]
+    n_k_blocks = s_k // BLOCK_K
+    if causal:
+        # blocks wholly past the diagonal contribute nothing — don't visit
+        n_k_blocks = jnp.minimum(n_k_blocks, ((qi + 1) * BLOCK_Q + BLOCK_K - 1) // BLOCK_K)
+
+    def body(kb, carry):
+        acc, m, l = carry
+        k_blk = k_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]  # [BLOCK_K, D]
+        v_blk = v_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]
+        scores = jax.lax.dot_general(
+            q,
+            k_blk,
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [BLOCK_Q, BLOCK_K]
+        scores = scores * scale
+        if causal:
+            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        m_blk = jnp.max(scores, axis=1, keepdims=True)  # [BLOCK_Q, 1]
+        m_new = jnp.maximum(m, m_blk)
+        # masked rows produce m=-inf on the diagonal path only when the row
+        # has no visible keys, which cannot happen under causal (self-key);
+        # the exp() is therefore safe, but keep the guard for robustness
+        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
+        p = jnp.exp(scores - m_new)  # [BLOCK_Q, BLOCK_K] f32
+        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v_blk.dtype),
+            v_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc = acc * alpha + pv
+        return acc, m_new, l_new
+
+    d = q.shape[-1]
+    init = (
+        jnp.zeros((BLOCK_Q, d), jnp.float32),
+        jnp.full((BLOCK_Q, 1), _NEG_INF, jnp.float32),
+        jnp.zeros((BLOCK_Q, 1), jnp.float32),
+    )
+    acc, _, l = jax.lax.fori_loop(0, n_k_blocks, body, init)
+    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
+    b, s, hq, d = q.shape
+    s_k, hkv = k.shape[1], k.shape[2]
+    g = hq // hkv
+    # kernel layout [B, H, S, D]
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    grid = (b, hq, s // BLOCK_Q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal, s_k=s_k),
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * hq * s * s_k * d // (2 if causal else 1),
+            bytes_accessed=(qt.size + kt.size + vt.size) * q.dtype.itemsize * 2,
+            transcendentals=b * hq * s * s_k,
+        ),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return jnp.swapaxes(out, 1, 2)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash(q, k, v, scale, causal, interpret):
+    return _flash_forward(q, k, v, scale, causal, interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, interpret):
+    return _flash_forward(q, k, v, scale, causal, interpret), (q, k, v)
+
+
+def _flash_bwd(scale, causal, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal, scale=scale), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention, ``[B, S, H, D]`` in and out.
+
+    ``interpret`` defaults to True off-TPU so the kernel logic is testable on
+    the CPU mesh (pallas interpreter mode).
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if interpret is None:
+        interpret = not _on_tpu()
+    if causal and q.shape[1] != k.shape[1]:
+        raise ValueError(
+            f"causal flash kernel requires sq == sk (got {q.shape[1]} vs {k.shape[1]}); "
+            "use ops.attention which falls back to the XLA path for decode shapes"
+        )
+    return _flash(q, k, v, float(scale), bool(causal), bool(interpret))

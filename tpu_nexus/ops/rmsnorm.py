@@ -1,0 +1,61 @@
+"""RMSNorm: XLA implementation (default) + pallas reference kernel.
+
+XLA already fuses the reduce + rsqrt + scale chain into its matmul neighbours,
+so the XLA path is the production default; the pallas kernel exists as the
+package's simplest kernel template and for explicit-fusion experiments.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """y = x / rms(x) * weight, reduction in f32 (bf16-safe)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rms_kernel(x_ref, w_ref, o_ref, *, eps: float):
+    x = x_ref[:].astype(jnp.float32)
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    o_ref[:] = (x * jax.lax.rsqrt(var + eps) * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def rms_norm_pallas(
+    x: jax.Array,
+    weight: jax.Array,
+    eps: float = 1e-5,
+    block_rows: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Pallas RMSNorm over the last dim; x reshaped to [rows, hidden]."""
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+    shape = x.shape
+    hidden = shape[-1]
+    rows = x.size // hidden
+    x2 = x.reshape(rows, hidden)
+    block_rows = min(block_rows, rows)
+    if rows % block_rows:
+        return rms_norm(x, weight, eps)  # ragged fallback
+    out = pl.pallas_call(
+        functools.partial(_rms_kernel, eps=eps),
+        out_shape=jax.ShapeDtypeStruct((rows, hidden), x.dtype),
+        grid=(rows // block_rows,),
+        in_specs=[
+            pl.BlockSpec((block_rows, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((hidden,), lambda i: (0,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_rows, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(x2, weight)
+    return out.reshape(shape)

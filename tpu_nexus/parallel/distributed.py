@@ -91,13 +91,11 @@ def initialize_distributed(ctx: Optional[ProcessContext] = None) -> ProcessConte
 
     import jax
 
-    kwargs = {}
+    # pass what we explicitly know; jax accepts these kwargs individually and
+    # auto-detects the rest (Cloud TPU metadata) — "explicit env wins"
+    kwargs = dict(num_processes=ctx.num_processes, process_id=ctx.process_id)
     if ctx.coordinator:
-        kwargs = dict(
-            coordinator_address=ctx.coordinator,
-            num_processes=ctx.num_processes,
-            process_id=ctx.process_id,
-        )
+        kwargs["coordinator_address"] = ctx.coordinator
     logger.info(
         "initializing jax.distributed: process %d/%d coordinator=%s",
         ctx.process_id,
