@@ -1,0 +1,91 @@
+"""Headline benchmark: supervised JAX training throughput, tokens/sec/chip.
+
+Runs the full workload harness path (sharded train step, flash-attention
+kernel, remat, heartbeats into an in-memory ledger) on the real device(s) and
+prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+``vs_baseline``: the reference (SneaksAndData/nexus-supervisor) publishes no
+performance numbers (BASELINE.md — its `published` map is empty), so there is
+no reference number to ratio against; by convention we report the ratio vs
+the recorded target in BASELINE.json `published` when present, else 1.0.
+
+Model: ``LlamaConfig.nexus_1b`` — ~1B params, head_dim 128 (pallas flash
+kernel on the hot path), bf16 params+optimizer, sized for one v5e chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+
+def main() -> None:
+    import jax
+
+    # ensure the real accelerator is used (tests force cpu; bench must not)
+    import jax.numpy as jnp
+
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+    from tpu_nexus.workload.data import synthetic_tokens
+    from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+    n_chips = jax.device_count()
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu:
+        cfg = LlamaConfig.nexus_1b()
+        batch, seq, steps, warmup = 4, 2048, 20, 3
+    else:  # CPU smoke: keep it honest but small
+        cfg = LlamaConfig.tiny()
+        batch, seq, steps, warmup = 8, 128, 10, 2
+
+    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    mesh = build_mesh(MeshSpec(fsdp=-1))
+    rules = LOGICAL_RULES_FSDP_TP
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, rules)
+    step_fn = make_train_step(cfg, tcfg, mesh, rules)
+    data = synthetic_tokens(batch, seq, cfg.vocab_size, seed=0)
+
+    # sync via float() (device->host transfer): steps chain through the
+    # donated state, so pulling the final loss waits for the whole window.
+    # (block_until_ready alone does not synchronize through remote-relay
+    # backends — measured 150x-too-fast numbers with it.)
+    with mesh:
+        for _ in range(warmup):
+            state, metrics = step_fn(state, jnp.asarray(next(data)))
+        float(metrics["loss"])
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, metrics = step_fn(state, jnp.asarray(next(data)))
+        float(metrics["loss"])
+        elapsed = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / elapsed
+    per_chip = tokens_per_sec / n_chips
+
+    baseline = 0.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__) or ".", "BASELINE.json")) as fh:
+            published = json.load(fh).get("published") or {}
+        baseline = float(published.get("tokens_per_sec_per_chip", 0.0))
+    except (OSError, ValueError):
+        pass
+    vs_baseline = per_chip / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "supervised_jax_tokens_per_sec_per_chip",
+                "value": round(per_chip, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Launcher tests: manifest composition contract + launch/cancel flow against
+the fake kube client."""
+
+import uuid
+
+import pytest
+
+from tpu_nexus.checkpoint.models import (
+    JOB_LABEL_ALGORITHM_RUN,
+    JOB_TEMPLATE_NAME_KEY,
+    NEXUS_COMPONENT_LABEL,
+    LifecycleStage,
+)
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.k8s.fake import FakeKubeClient
+from tpu_nexus.launcher import (
+    Launcher,
+    LaunchSpec,
+    compose_job,
+    compose_jobset,
+    coordinator_address,
+)
+from tpu_nexus.parallel.distributed import ENV_COORDINATOR, ENV_NUM_PROCESSES
+
+
+def spec(**over):
+    base = dict(
+        run_id=str(uuid.uuid4()),
+        algorithm="llama-pretrain",
+        image="ghcr.io/x/workload:1",
+        command=["python", "-m", "tpu_nexus.workload"],
+        num_hosts=4,
+        resources={"google.com/tpu": "4"},
+        node_selector={"cloud.google.com/gke-tpu-topology": "4x4"},
+        namespace="nexus",
+    )
+    base.update(over)
+    return LaunchSpec(**base)
+
+
+class TestManifests:
+    def test_job_carries_supervisor_contract(self):
+        s = spec(num_hosts=1)
+        job = compose_job(s)
+        # name IS the run id; labels are what the supervisor filters on
+        assert job["metadata"]["name"] == s.run_id
+        labels = job["metadata"]["labels"]
+        assert labels[NEXUS_COMPONENT_LABEL] == JOB_LABEL_ALGORITHM_RUN
+        assert labels[JOB_TEMPLATE_NAME_KEY] == s.algorithm
+        assert job["spec"]["template"]["metadata"]["labels"][NEXUS_COMPONENT_LABEL]
+        # OOM/fatal exit codes surface as PodFailurePolicy (FATAL path parity)
+        codes = job["spec"]["podFailurePolicy"]["rules"][0]["onExitCodes"]["values"]
+        assert codes == [137, 255]
+
+    def test_multi_host_env_contract(self):
+        s = spec(num_hosts=4)
+        job = compose_job(s)
+        env = {e["name"]: e["value"] for e in job["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert env[ENV_NUM_PROCESSES] == "4"
+        assert env[ENV_COORDINATOR] == coordinator_address(s)
+        assert job["spec"]["completionMode"] == "Indexed"
+        assert job["spec"]["completions"] == 4
+
+    def test_single_host_omits_coordinator(self):
+        job = compose_job(spec(num_hosts=1))
+        env = {e["name"] for e in job["spec"]["template"]["spec"]["containers"][0]["env"]}
+        assert ENV_COORDINATOR not in env
+
+    def test_jobset_topology(self):
+        s = spec()
+        js = compose_jobset(s)
+        assert js["kind"] == "JobSet"
+        assert js["metadata"]["name"] == s.run_id
+        assert js["spec"]["replicatedJobs"][0]["template"]["spec"]["completions"] == 4
+        assert js["spec"]["failurePolicy"]["maxRestarts"] == 3
+
+    def test_tpu_resources_and_selector(self):
+        pod = compose_job(spec())["spec"]["template"]["spec"]
+        assert pod["containers"][0]["resources"]["limits"]["google.com/tpu"] == "4"
+        assert pod["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "4x4"
+
+
+class TestLauncher:
+    async def test_launch_seeds_ledger_then_creates(self):
+        store = InMemoryCheckpointStore()
+        kube = FakeKubeClient()
+        s = spec(num_hosts=1)
+        cp = await Launcher(kube, store).launch(s, payload_uri="s3://payloads/x")
+        assert cp.lifecycle_stage == LifecycleStage.BUFFERED
+        assert cp.payload_uri == "s3://payloads/x"
+        jobs, _ = await kube.list_objects("Job", "nexus")
+        assert [j["metadata"]["name"] for j in jobs] == [s.run_id]
+
+    async def test_multi_host_uses_jobset(self):
+        store = InMemoryCheckpointStore()
+        kube = FakeKubeClient()
+        s = spec(num_hosts=4)
+        await Launcher(kube, store).launch(s)
+        jobsets, _ = await kube.list_objects("JobSet", "nexus")
+        assert len(jobsets) == 1
+
+    async def test_cancel_guards_and_deletes(self):
+        store = InMemoryCheckpointStore()
+        kube = FakeKubeClient()
+        s = spec(num_hosts=1)
+        launcher = Launcher(kube, store)
+        await launcher.launch(s)
+        assert await launcher.cancel(s.algorithm, s.run_id, namespace="nexus")
+        cp = store.read_checkpoint(s.algorithm, s.run_id)
+        assert cp.lifecycle_stage == LifecycleStage.CANCELLED
+        # second cancel is a guarded no-op
+        assert not await launcher.cancel(s.algorithm, s.run_id, namespace="nexus")

@@ -1,0 +1,29 @@
+"""Job launcher: composes and submits the k8s resources that run algorithm
+workloads as ``jax.distributed`` processes on TPU pod slices.
+
+This is the north-star extension of the reference's `app/app_dependencies.go`
+("gains a JAX/XLA job-launcher client so Nexus spawns algorithm jobs as
+jax.distributed processes on a TPU pod instead of CUDA containers",
+BASELINE.json).  The reference itself never creates workloads — its sibling
+"scheduler" does — so the manifest/labeling contract here is reconstructed
+from what the supervisor filters on (SURVEY.md §2.2): the Job/JobSet name IS
+the run id (a UUID), and the nexus labels mark it an algorithm run.
+"""
+
+from tpu_nexus.launcher.jobset import (
+    LaunchSpec,
+    compose_job,
+    compose_jobset,
+    coordinator_address,
+    workload_env,
+)
+from tpu_nexus.launcher.client import Launcher
+
+__all__ = [
+    "LaunchSpec",
+    "compose_job",
+    "compose_jobset",
+    "coordinator_address",
+    "workload_env",
+    "Launcher",
+]

@@ -1,0 +1,79 @@
+"""Launcher client: submit a run, seed its ledger row, watch for completion.
+
+The flow a receiver/scheduler drives (the supervisor then owns the failure
+paths):
+
+1. ``launch`` — upsert the BUFFERED ledger row (seed state the reference
+   fixtures start from, test-resources/checkpoints.cql:35), then create the
+   Job (single-host / no JobSet CRD) or JobSet (multi-host TPU slice);
+2. the workload harness transitions RUNNING and heartbeats;
+3. ``cancel`` — terminal CANCELLED + delete, guarded first-writer-wins.
+"""
+
+from __future__ import annotations
+
+import logging
+from datetime import datetime, timezone
+from typing import Optional
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import CheckpointStore
+from tpu_nexus.k8s.client import KubeClient
+from tpu_nexus.launcher.jobset import LaunchSpec, compose_job, compose_jobset
+
+logger = logging.getLogger(__name__)
+
+
+class Launcher:
+    def __init__(self, kube: KubeClient, store: CheckpointStore, use_jobset: bool = True) -> None:
+        self.kube = kube
+        self.store = store
+        self.use_jobset = use_jobset
+
+    async def launch(self, spec: LaunchSpec, payload_uri: str = "") -> CheckpointedRequest:
+        """Seed ledger (BUFFERED) then create the workload resource.
+
+        Ledger-first ordering: the supervisor drops events for runs it cannot
+        find a checkpoint for (reference services/supervisor.go:265-273), so
+        the row must exist before the first pod event can fire.
+        """
+        now = datetime.now(timezone.utc)
+        cp = CheckpointedRequest(
+            algorithm=spec.algorithm,
+            id=spec.run_id,
+            lifecycle_stage=LifecycleStage.BUFFERED,
+            payload_uri=payload_uri,
+            received_at=now,
+            sent_at=now,
+            api_version="v1",
+        )
+        cp.touch()
+        self.store.upsert_checkpoint(cp)
+        multi_host = self.use_jobset and spec.num_hosts > 1
+        manifest = compose_jobset(spec) if multi_host else compose_job(spec)
+        kind = manifest["kind"]
+        created = await self.kube.create_object(kind, spec.namespace, manifest)
+        logger.info("launched %s %s/%s (algorithm=%s hosts=%d)",
+                    kind, spec.namespace, spec.run_id, spec.algorithm, spec.num_hosts)
+        cp = cp.deep_copy()
+        cp.job_uid = created.get("metadata", {}).get("uid", "")
+        cp.touch()
+        self.store.upsert_checkpoint(cp)
+        return cp
+
+    async def cancel(self, algorithm: str, run_id: str, namespace: str = "default") -> bool:
+        """Cancel a run: terminal CANCELLED first (so late Started events are
+        guarded), then delete the resource with background propagation."""
+        cp = self.store.read_checkpoint(algorithm, run_id)
+        if cp is None or cp.is_finished():
+            return False
+        cp = cp.deep_copy()
+        cp.lifecycle_stage = LifecycleStage.CANCELLED
+        cp.touch()
+        self.store.upsert_checkpoint(cp)
+        for kind in ("JobSet", "Job"):
+            try:
+                await self.kube.delete_object(kind, namespace, run_id)
+            except Exception:  # noqa: BLE001 - either kind may not exist
+                continue
+        return True

@@ -1,0 +1,170 @@
+"""JobSet / Job manifest composition for TPU slice workloads.
+
+Multi-host topology (BASELINE.json config #4: "v5e-16 multi-host JobSet"):
+one JobSet with a single replicated job of ``num_hosts`` completions; the
+headless service JobSet creates per job gives every worker a stable DNS name,
+and worker 0's name is the ``jax.distributed`` coordinator address injected
+via the ``NEXUS_*`` env contract (tpu_nexus.parallel.distributed).
+
+Labeling contract (what the supervisor filters on, SURVEY.md §2.2):
+``NEXUS_COMPONENT_LABEL: algorithm-run`` + ``JOB_TEMPLATE_NAME_KEY:
+<algorithm>`` on every object; the run id is the JobSet/Job name.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpu_nexus.checkpoint.models import (
+    JOB_LABEL_ALGORITHM_RUN,
+    JOB_TEMPLATE_NAME_KEY,
+    NEXUS_COMPONENT_LABEL,
+)
+from tpu_nexus.parallel.distributed import (
+    ENV_ALGORITHM,
+    ENV_COORDINATOR,
+    ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID,
+    ENV_RUN_ID,
+)
+
+COORDINATOR_PORT = 8476
+#: JobSet's exclusive-topology annotation: one worker pod per TPU host
+TPU_TOPOLOGY_ANNOTATION = "alpha.jobset.sigs.k8s.io/exclusive-topology"
+
+
+@dataclass(frozen=True)
+class LaunchSpec:
+    """Everything needed to materialize a run's k8s resources."""
+
+    run_id: str
+    algorithm: str
+    image: str
+    command: List[str] = field(default_factory=list)
+    num_hosts: int = 1
+    #: TPU accelerator resource, e.g. {"google.com/tpu": "4"} per host
+    resources: Dict[str, str] = field(default_factory=dict)
+    #: TPU nodeSelector, e.g. {"cloud.google.com/gke-tpu-accelerator":
+    #: "tpu-v5-lite-podslice", "cloud.google.com/gke-tpu-topology": "4x4"}
+    node_selector: Dict[str, str] = field(default_factory=dict)
+    env: Dict[str, str] = field(default_factory=dict)
+    backoff_limit: int = 0
+    deadline_seconds: Optional[int] = None
+    namespace: str = "default"
+
+
+def run_labels(spec: LaunchSpec) -> Dict[str, str]:
+    return {
+        NEXUS_COMPONENT_LABEL: JOB_LABEL_ALGORITHM_RUN,
+        JOB_TEMPLATE_NAME_KEY: spec.algorithm,
+    }
+
+
+def coordinator_address(spec: LaunchSpec) -> str:
+    """Worker 0's stable DNS under the JobSet-managed headless service."""
+    return (
+        f"{spec.run_id}-workers-0-0.{spec.run_id}.{spec.namespace}.svc:{COORDINATOR_PORT}"
+    )
+
+
+def workload_env(spec: LaunchSpec, process_id_field: str = "JOB_COMPLETION_INDEX") -> List[Dict[str, Any]]:
+    """The NEXUS_* env contract consumed by parallel.distributed.
+
+    Process id comes from the downward-API completion index env populated by
+    the Job controller on indexed jobs.
+    """
+    env: List[Dict[str, Any]] = [
+        {"name": ENV_RUN_ID, "value": spec.run_id},
+        {"name": ENV_ALGORITHM, "value": spec.algorithm},
+        {"name": ENV_NUM_PROCESSES, "value": str(spec.num_hosts)},
+        {"name": ENV_PROCESS_ID, "value": f"$({process_id_field})"},
+    ]
+    if spec.num_hosts > 1:
+        env.append({"name": ENV_COORDINATOR, "value": coordinator_address(spec)})
+    env.extend({"name": k, "value": v} for k, v in sorted(spec.env.items()))
+    return env
+
+
+def _pod_template(spec: LaunchSpec) -> Dict[str, Any]:
+    container: Dict[str, Any] = {
+        "name": "algorithm",
+        "image": spec.image,
+        "env": workload_env(spec),
+    }
+    if spec.command:
+        container["command"] = list(spec.command)
+    if spec.resources:
+        container["resources"] = {"limits": dict(spec.resources)}
+    pod_spec: Dict[str, Any] = {
+        "restartPolicy": "Never",
+        "containers": [container],
+    }
+    if spec.node_selector:
+        pod_spec["nodeSelector"] = dict(spec.node_selector)
+    return {
+        "metadata": {"labels": run_labels(spec)},
+        "spec": pod_spec,
+    }
+
+
+def compose_job(spec: LaunchSpec) -> Dict[str, Any]:
+    """Plain batch/v1 Job — single-host runs (BASELINE configs #2/#3) and
+    clusters without the JobSet CRD.  Indexed completion mode so the env
+    contract is identical to the JobSet path."""
+    job_spec: Dict[str, Any] = {
+        "completionMode": "Indexed",
+        "completions": spec.num_hosts,
+        "parallelism": spec.num_hosts,
+        "backoffLimit": spec.backoff_limit,
+        # surface OOM (137) and unknown-fatal (255) as PodFailurePolicy events
+        # — the reference's FATAL path (services/supervisor.go:310-313)
+        "podFailurePolicy": {
+            "rules": [
+                {
+                    "action": "FailJob",
+                    "onExitCodes": {"operator": "In", "values": [137, 255]},
+                }
+            ]
+        },
+        "template": _pod_template(spec),
+    }
+    if spec.deadline_seconds:
+        job_spec["activeDeadlineSeconds"] = spec.deadline_seconds
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": {
+            "name": spec.run_id,
+            "namespace": spec.namespace,
+            "labels": run_labels(spec),
+        },
+        "spec": job_spec,
+    }
+
+
+def compose_jobset(spec: LaunchSpec) -> Dict[str, Any]:
+    """JobSet for multi-host TPU slices: all workers restart together on a
+    worker failure (Recreate) — a TPU slice is all-or-nothing, and
+    restart-from-step is driven by the tensor checkpoint (SURVEY.md §7.4)."""
+    job = compose_job(spec)
+    return {
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "kind": "JobSet",
+        "metadata": {
+            "name": spec.run_id,
+            "namespace": spec.namespace,
+            "labels": run_labels(spec),
+            "annotations": {TPU_TOPOLOGY_ANNOTATION: "cloud.google.com/gke-nodepool"},
+        },
+        "spec": {
+            "failurePolicy": {"maxRestarts": 3},
+            "replicatedJobs": [
+                {
+                    "name": "workers",
+                    "replicas": 1,
+                    "template": {"spec": job["spec"]},
+                }
+            ],
+        },
+    }

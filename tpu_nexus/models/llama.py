@@ -71,6 +71,17 @@ class LlamaConfig:
         )
 
     @staticmethod
+    def nexus_1b() -> "LlamaConfig":
+        """~1B-param bench config sized for a single v5e chip: head_dim 128
+        keeps the pallas flash kernel on the hot path, tied embeddings +
+        32k vocab keep params+adam-state inside 16 GB HBM in bf16."""
+        return LlamaConfig(
+            vocab_size=32768, hidden=2048, n_layers=14, n_heads=16, n_kv_heads=8,
+            head_dim=128, intermediate=8192, tied_embeddings=True,
+            param_dtype=jnp.bfloat16, max_seq_len=4096,
+        )
+
+    @staticmethod
     def tiny(vocab_size: int = 256) -> "LlamaConfig":
         """Test/dry-run config: shapes small but structure identical."""
         return LlamaConfig(
@@ -136,13 +147,17 @@ def llama_init(key: jax.Array, cfg: LlamaConfig) -> Dict[str, Any]:
     return params
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
-    """Rotary embedding, x [B, S, H, D], positions [B, S]."""
-    d = x.shape[-1]
-    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)  # [D/2]
+def rope_tables(positions: jax.Array, head_dim: int, theta: float):
+    """cos/sin tables [B, S, 1, D/2] — computed ONCE per forward, outside the
+    layer scan (layer-invariant; inside the scan body XLA could not hoist
+    them and remat would recompute the transcendentals per layer per pass)."""
+    freqs = theta ** (-jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, D/2]
-    cos = jnp.cos(angles)[:, :, None, :]  # [B, S, 1, D/2]
-    sin = jnp.sin(angles)[:, :, None, :]
+    return jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+
+def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Apply rotary embedding, x [B, S, H, D]."""
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
@@ -172,14 +187,15 @@ def llama_forward(
 
     ct = cfg.dtype
     x = params["embed"]["tokens"].astype(ct)[tokens]  # [B, S, E]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     def block(x, layer):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
         k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
         v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
-        q = _rope(q, positions, cfg.rope_theta)
-        k = _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, cos, sin)
+        k = _rope(k, cos, sin)
         o = attn_fn(q, k, v, causal=True)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)

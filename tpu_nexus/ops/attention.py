@@ -69,6 +69,8 @@ def attention(
     kernel-compatible shapes (seq and head_dim multiples of the tile sizes),
     else the XLA path.  Both paths are differentiable.
     """
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown attention impl {impl!r}; use auto|pallas|xla")
     if impl == "xla":
         return dense_attention(q, k, v, causal=causal, scale=scale)
     from tpu_nexus.ops.flash_attention import flash_attention, flash_supported

@@ -179,9 +179,25 @@ def flash_attention(
         scale = q.shape[-1] ** -0.5
     if interpret is None:
         interpret = not _on_tpu()
-    if causal and q.shape[1] != k.shape[1]:
+    # validate every kernel assumption — a forced pallas path must never
+    # silently drop the sequence tail or mis-map GQA heads
+    b, s, hq, d = q.shape
+    sk, hkv = k.shape[1], k.shape[2]
+    problems = []
+    if d % 128:
+        problems.append(f"head_dim {d} % 128 != 0")
+    if s % BLOCK_Q:
+        problems.append(f"seq {s} % BLOCK_Q({BLOCK_Q}) != 0")
+    if sk % BLOCK_K:
+        problems.append(f"kv seq {sk} % BLOCK_K({BLOCK_K}) != 0")
+    if s != sk:
+        problems.append(f"sq {s} != sk {sk} (self-attention only)")
+    if hq % hkv:
+        problems.append(f"q heads {hq} % kv heads {hkv} != 0")
+    if problems:
         raise ValueError(
-            f"causal flash kernel requires sq == sk (got {q.shape[1]} vs {k.shape[1]}); "
-            "use ops.attention which falls back to the XLA path for decode shapes"
+            "flash_attention unsupported shapes: "
+            + "; ".join(problems)
+            + " — use ops.attention which falls back to the XLA path"
         )
     return _flash(q, k, v, float(scale), bool(causal), bool(interpret))

@@ -91,11 +91,18 @@ def initialize_distributed(ctx: Optional[ProcessContext] = None) -> ProcessConte
 
     import jax
 
-    # pass what we explicitly know; jax accepts these kwargs individually and
-    # auto-detects the rest (Cloud TPU metadata) — "explicit env wins"
-    kwargs = dict(num_processes=ctx.num_processes, process_id=ctx.process_id)
+    # identity kwargs travel together with the coordinator address: passing
+    # launcher-assigned ids against an auto-detected coordinator could number
+    # process 0 on a host that never binds the advertised address (deadlock).
+    # Either the launcher provides the full contract, or TPU-metadata
+    # auto-detection provides all three consistently.
+    kwargs = {}
     if ctx.coordinator:
-        kwargs["coordinator_address"] = ctx.coordinator
+        kwargs = dict(
+            coordinator_address=ctx.coordinator,
+            num_processes=ctx.num_processes,
+            process_id=ctx.process_id,
+        )
     logger.info(
         "initializing jax.distributed: process %d/%d coordinator=%s",
         ctx.process_id,

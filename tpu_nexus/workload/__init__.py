@@ -1,0 +1,25 @@
+"""The supervised-workload harness: what runs *inside* the algorithm jobs.
+
+The reference treats algorithm jobs as opaque containers it only ever kills
+(SURVEY.md §2.7); here the workload is a first-class JAX training program
+that cooperates with the supervisor through the ledger:
+
+* heartbeats per-chip step counters into ``per_chip_steps`` (north-star
+  checkpoint-schema extension);
+* commits Orbax tensor checkpoints and records the URI, enabling
+  restart-from-step after preemption (the "JobSet restart vs delete" policy
+  axis, SURVEY.md §7.4);
+* exposes fault-injection hooks so the failure taxonomy can be exercised
+  end-to-end (BASELINE.json configs #3/#5).
+"""
+
+from tpu_nexus.workload.train import TrainConfig, make_train_step, init_train_state
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+
+__all__ = [
+    "TrainConfig",
+    "make_train_step",
+    "init_train_state",
+    "WorkloadConfig",
+    "run_workload",
+]

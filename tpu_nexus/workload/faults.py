@@ -1,0 +1,82 @@
+"""Fault-injection hooks for exercising the supervision taxonomy end-to-end.
+
+The reference injects faults only as synthetic k8s events in tests
+(SURVEY.md §5.3); the TPU framework additionally lets the *workload itself*
+die in controlled ways (BASELINE.json config #5: "injected preemption + ICI
+fault — stress failure taxonomy & restart trace").  Modes map 1:1 to the
+failure classes the supervisor classifies:
+
+==============  =====================================================
+mode            effect / classified as
+==============  =====================================================
+``oom``         os._exit(137) — container OOMKilled → FATAL (exit-code parity
+                with the reference's PodFailurePolicy 137 note,
+                services/supervisor.go:310-313)
+``fatal``       os._exit(255) — unknown fatal → FATAL
+``preempt``     SIGTERM to self — TPU preemption path → PREEMPTED/restart
+``xla-abort``   raise RuntimeError("XLA compilation aborted...") → XLA_COMPILE_ABORT
+``hbm-oom``     raise the XLA RESOURCE_EXHAUSTED wording → HBM_OOM
+``ici``         raise the ICI link wording → ICI_LINK_FAILURE
+``hang``        sleep forever — stuck-in-running, caught by missing heartbeats
+==============  =====================================================
+
+Configured by env (set by tests / chaos harness, read once at loop entry):
+``NEXUS_FAULT_MODE``, ``NEXUS_FAULT_STEP``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+ENV_FAULT_MODE = "NEXUS_FAULT_MODE"
+ENV_FAULT_STEP = "NEXUS_FAULT_STEP"
+
+#: message wordings recognized by the supervisor's classifier
+#: (tpu_nexus.supervisor.taxonomy) — injection uses the same strings so the
+#: end-to-end path is honest
+MSG_XLA_ABORT = "XLA compilation aborted: INTERNAL: Mosaic failed to compile module"
+MSG_HBM_OOM = "RESOURCE_EXHAUSTED: Attempting to allocate 9.54G. That was not possible. There are 2.1G free."
+MSG_ICI = "ICI link failure detected on interconnect 3: neighbor chip unreachable"
+
+
+@dataclass(frozen=True)
+class FaultPlan:
+    mode: Optional[str]
+    step: int
+
+    @staticmethod
+    def from_env(env=None) -> "FaultPlan":
+        e = os.environ if env is None else env
+        return FaultPlan(mode=e.get(ENV_FAULT_MODE) or None, step=int(e.get(ENV_FAULT_STEP, "0")))
+
+
+def maybe_inject(plan: FaultPlan, step: int) -> None:
+    """Called once per training step; fires the configured fault at its step."""
+    if plan.mode is None or step != plan.step:
+        return
+    logger.warning("injecting fault %r at step %d", plan.mode, step)
+    if plan.mode == "oom":
+        os._exit(137)
+    if plan.mode == "fatal":
+        os._exit(255)
+    if plan.mode == "preempt":
+        os.kill(os.getpid(), signal.SIGTERM)
+        time.sleep(60)  # wait for the handler/runtime to take us down
+        os._exit(143)
+    if plan.mode == "xla-abort":
+        raise RuntimeError(MSG_XLA_ABORT)
+    if plan.mode == "hbm-oom":
+        raise RuntimeError(MSG_HBM_OOM)
+    if plan.mode == "ici":
+        raise RuntimeError(MSG_ICI)
+    if plan.mode == "hang":
+        while True:  # pragma: no cover - unbounded by design
+            time.sleep(3600)
+    raise ValueError(f"unknown fault mode {plan.mode!r}")

@@ -1,0 +1,174 @@
+"""The workload run loop: train, heartbeat, checkpoint, die honestly.
+
+This is what the launcher's JobSet containers execute (BASELINE.json
+configs #2-#5).  Cooperation contract with the supervisor:
+
+* on start: transition the ledger row to RUNNING (first-writer-wins — the
+  supervisor's Pod-Started path may already have done it);
+* every ``heartbeat_every`` steps: write this host's per-chip step counters
+  into ``per_chip_steps`` (ledger merge, not overwrite — other hosts own
+  their keys);
+* every ``checkpoint_every`` steps: Orbax-save the train state and record
+  ``tensor_checkpoint_uri`` (restart-from-step after preemption);
+* on clean exit: COMPLETED + ``result_uri`` (only if not already terminal —
+  a cancelled run stays CANCELLED, the reference's IsFinished guard);
+* on crash: exit nonzero / raise — detection is the supervisor's job, via
+  k8s events, which keeps the failure path honest end-to-end.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, Optional
+
+import jax
+import numpy as np
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import CheckpointStore
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
+from tpu_nexus.parallel.sharding import RuleTable
+from tpu_nexus.workload.data import synthetic_tokens
+from tpu_nexus.workload.faults import FaultPlan, maybe_inject
+from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class WorkloadConfig:
+    model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    rules: RuleTable = field(default_factory=lambda: dict(LOGICAL_RULES_FSDP_TP))
+    batch_size: int = 8
+    seq_len: int = 128
+    steps: int = 20
+    heartbeat_every: int = 5
+    checkpoint_every: int = 0  # 0 = disabled
+    checkpoint_dir: str = ""
+    seed: int = 0
+
+
+class LedgerReporter:
+    """Writes the run's lifecycle + heartbeats through the reference's
+    read-guard-mutate-upsert discipline (services/supervisor.go:264-281)."""
+
+    def __init__(self, store: Optional[CheckpointStore], ctx: ProcessContext) -> None:
+        self.store = store
+        self.ctx = ctx
+
+    def _mutate(self, fn) -> None:
+        if self.store is None:
+            return
+        cp = self.store.read_checkpoint(self.ctx.algorithm, self.ctx.run_id)
+        if cp is None:
+            cp = CheckpointedRequest(algorithm=self.ctx.algorithm, id=self.ctx.run_id)
+        if cp.is_finished():
+            return  # IsFinished guard: never resurrect a terminal run
+        cp = cp.deep_copy()
+        fn(cp)
+        cp.touch()
+        self.store.upsert_checkpoint(cp)
+
+    def running(self) -> None:
+        def f(cp):
+            if LifecycleStage.can_transition(cp.lifecycle_stage, LifecycleStage.RUNNING):
+                cp.lifecycle_stage = LifecycleStage.RUNNING
+
+        self._mutate(f)
+
+    def heartbeat(self, step: int) -> None:
+        def f(cp):
+            for i in range(jax.local_device_count()):
+                cp.per_chip_steps[self.ctx.chip_key(i)] = int(step)
+
+        self._mutate(f)
+
+    def tensor_checkpoint(self, uri: str, step: int) -> None:
+        def f(cp):
+            cp.tensor_checkpoint_uri = uri
+            for i in range(jax.local_device_count()):
+                cp.per_chip_steps[self.ctx.chip_key(i)] = int(step)
+
+        self._mutate(f)
+
+    def completed(self, result_uri: str = "") -> None:
+        def f(cp):
+            cp.lifecycle_stage = LifecycleStage.COMPLETED
+            cp.result_uri = result_uri
+
+        self._mutate(f)
+
+
+def run_workload(
+    cfg: WorkloadConfig,
+    store: Optional[CheckpointStore] = None,
+    ctx: Optional[ProcessContext] = None,
+    data: Optional[Iterator[np.ndarray]] = None,
+) -> Dict[str, Any]:
+    """Run the training loop; returns summary metrics.
+
+    ``store``/``ctx``/``data`` are injectable for tests; production wiring
+    reads env (launcher contract) and a CQL store.
+    """
+    ctx = initialize_distributed(ctx)
+    reporter = LedgerReporter(store, ctx)
+    plan = FaultPlan.from_env()
+    mesh = build_mesh(cfg.mesh)
+    logger.info("workload %s/%s: mesh %s", ctx.algorithm, ctx.run_id, dict(mesh.shape))
+
+    key = jax.random.PRNGKey(cfg.seed)
+    state = init_train_state(key, cfg.model, cfg.train, mesh, cfg.rules)
+    ckpt: Optional[TensorCheckpointer] = None
+    start_step = 0
+    if cfg.checkpoint_every and cfg.checkpoint_dir:
+        ckpt = TensorCheckpointer(cfg.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            state = ckpt.restore(state, latest)
+            start_step = latest
+            logger.info("restored tensor checkpoint at step %d", latest)
+
+    step_fn = make_train_step(cfg.model, cfg.train, mesh, cfg.rules)
+    data = data or synthetic_tokens(
+        cfg.batch_size, cfg.seq_len, cfg.model.vocab_size, seed=cfg.seed + ctx.process_id
+    )
+
+    reporter.running()
+    metrics: Dict[str, Any] = {}
+    t0 = time.perf_counter()
+    tokens_done = 0
+    with mesh:
+        for step in range(start_step, cfg.steps):
+            maybe_inject(plan, step)
+            batch = jax.numpy.asarray(next(data))
+            state, m = step_fn(state, batch)
+            tokens_done += batch.size
+            if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
+                # pull metrics (device sync) only on heartbeat steps
+                metrics = {k: float(v) for k, v in m.items()}
+                reporter.heartbeat(step + 1)
+                logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
+            if ckpt and (step + 1) % cfg.checkpoint_every == 0:
+                uri = ckpt.save(step + 1, state)
+                reporter.tensor_checkpoint(uri, step + 1)
+    jax.block_until_ready(state["step"])
+    elapsed = time.perf_counter() - t0
+    if ckpt:
+        ckpt.wait()
+        ckpt.close()
+    metrics = {k: float(v) for k, v in m.items()} if cfg.steps > start_step else metrics
+    final_step = int(state["step"])
+    reporter.completed()
+    return {
+        "final_step": final_step,
+        "elapsed_s": elapsed,
+        "tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        **metrics,
+    }

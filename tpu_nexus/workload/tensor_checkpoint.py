@@ -1,0 +1,57 @@
+"""Tensor (weights/optimizer) checkpointing via Orbax.
+
+Distinct from the *ledger* checkpoint (run metadata in Scylla, SURVEY.md
+§2.5): these are the actual arrays, written to a directory/object-store path;
+the ledger row points at them via ``tensor_checkpoint_uri`` so a preempted
+run restarts from step instead of being deleted (SURVEY.md §7.4).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class TensorCheckpointer:
+    """Thin Orbax wrapper: save/restore the train-state pytree keyed by step.
+
+    Orbax handles multi-host coordination and sharded arrays natively; the
+    restore path re-shards onto the current mesh via the target pytree's
+    shardings (abstract arrays from ``jax.eval_shape`` + shardings).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = directory
+        self._mngr = ocp.CheckpointManager(
+            directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
+        )
+
+    def save(self, step: int, state: Dict[str, Any]) -> str:
+        self._mngr.save(step, args=self._ocp.args.StandardSave(state))
+        return self.uri_for(step)
+
+    def wait(self) -> None:
+        self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, state_like: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
+        """``state_like``: pytree of arrays OR jax.ShapeDtypeStruct with
+        .sharding set — restored arrays land sharded accordingly."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self._mngr.restore(step, args=self._ocp.args.StandardRestore(state_like))
+
+    def uri_for(self, step: int) -> str:
+        return f"{self.directory}/{step}"
+
+    def close(self) -> None:
+        self._mngr.close()
