@@ -24,8 +24,6 @@ import time
 
 def main() -> None:
     import jax
-
-    # ensure the real accelerator is used (tests force cpu; bench must not)
     import jax.numpy as jnp
 
     from tpu_nexus.models import LlamaConfig

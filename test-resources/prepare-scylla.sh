@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Apply keyspace + ledger schema + seed rows to the compose Scylla node.
+set -euo pipefail
+
+cqlsh -e "create keyspace if not exists nexus with replication = {'class': 'SimpleStrategy', 'replication_factor': 1};"
+cqlsh -f /schema.cql
+cqlsh -f /seed-checkpoints.cql
+echo "scylla prepared: nexus.checkpoints + seed rows"

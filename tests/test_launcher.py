@@ -55,11 +55,29 @@ class TestManifests:
     def test_multi_host_env_contract(self):
         s = spec(num_hosts=4)
         job = compose_job(s)
-        env = {e["name"]: e["value"] for e in job["spec"]["template"]["spec"]["containers"][0]["env"]}
+        env_list = job["spec"]["template"]["spec"]["containers"][0]["env"]
+        env = {e["name"]: e.get("value") for e in env_list}
         assert env[ENV_NUM_PROCESSES] == "4"
-        assert env[ENV_COORDINATOR] == coordinator_address(s)
+        assert env[ENV_COORDINATOR] == coordinator_address(s, jobset=False)
+        # process id comes from the completion-index annotation via downward
+        # API (a $(VAR) reference would never expand — controller env comes
+        # after user env)
+        pid = next(e for e in env_list if e["name"] == "NEXUS_PROCESS_ID")
+        assert "job-completion-index" in pid["valueFrom"]["fieldRef"]["fieldPath"]
         assert job["spec"]["completionMode"] == "Indexed"
         assert job["spec"]["completions"] == 4
+        # plain-Job path gets stable pod DNS via subdomain + headless service
+        assert job["spec"]["template"]["spec"]["subdomain"] == s.run_id
+
+    def test_jobset_coordinator_dns(self):
+        s = spec(num_hosts=4)
+        js = compose_jobset(s)
+        tmpl = js["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]
+        env = {e["name"]: e.get("value") for e in tmpl["spec"]["containers"][0]["env"]}
+        assert env[ENV_COORDINATOR] == coordinator_address(s, jobset=True)
+        assert env[ENV_COORDINATOR].startswith(f"{s.run_id}-workers-0-0.")
+        # JobSet manages its own headless service; no subdomain on the pod
+        assert "subdomain" not in tmpl["spec"]
 
     def test_single_host_omits_coordinator(self):
         job = compose_job(spec(num_hosts=1))
@@ -98,6 +116,17 @@ class TestLauncher:
         await Launcher(kube, store).launch(s)
         jobsets, _ = await kube.list_objects("JobSet", "nexus")
         assert len(jobsets) == 1
+
+    async def test_multi_host_plain_job_creates_headless_service(self):
+        store = InMemoryCheckpointStore()
+        kube = FakeKubeClient()
+        s = spec(num_hosts=4)
+        await Launcher(kube, store, use_jobset=False).launch(s)
+        services, _ = await kube.list_objects("Service", "nexus")
+        assert [sv["metadata"]["name"] for sv in services] == [s.run_id]
+        assert services[0]["spec"]["clusterIP"] == "None"
+        jobs, _ = await kube.list_objects("Job", "nexus")
+        assert len(jobs) == 1
 
     async def test_cancel_guards_and_deletes(self):
         store = InMemoryCheckpointStore()

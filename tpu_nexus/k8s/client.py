@@ -20,6 +20,7 @@ from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 KIND_API = {
     "Event": ("api/v1", "events"),
     "Pod": ("api/v1", "pods"),
+    "Service": ("api/v1", "services"),  # launcher's plain-Job headless svc
     "Job": ("apis/batch/v1", "jobs"),
     "JobSet": ("apis/jobset.x-k8s.io/v1alpha2", "jobsets"),
 }

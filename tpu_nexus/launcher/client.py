@@ -18,8 +18,13 @@ from typing import Optional
 
 from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
 from tpu_nexus.checkpoint.store import CheckpointStore
-from tpu_nexus.k8s.client import KubeClient
-from tpu_nexus.launcher.jobset import LaunchSpec, compose_job, compose_jobset
+from tpu_nexus.k8s.client import KubeClient, NotFoundError
+from tpu_nexus.launcher.jobset import (
+    LaunchSpec,
+    compose_headless_service,
+    compose_job,
+    compose_jobset,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -49,8 +54,16 @@ class Launcher:
         )
         cp.touch()
         self.store.upsert_checkpoint(cp)
-        multi_host = self.use_jobset and spec.num_hosts > 1
-        manifest = compose_jobset(spec) if multi_host else compose_job(spec)
+        if self.use_jobset and spec.num_hosts > 1:
+            manifest = compose_jobset(spec)
+        else:
+            manifest = compose_job(spec)
+            if spec.num_hosts > 1:
+                # plain-Job multi-host fallback: the coordinator DNS needs a
+                # headless Service (JobSet would create its own)
+                await self.kube.create_object(
+                    "Service", spec.namespace, compose_headless_service(spec)
+                )
         kind = manifest["kind"]
         created = await self.kube.create_object(kind, spec.namespace, manifest)
         logger.info("launched %s %s/%s (algorithm=%s hosts=%d)",
@@ -71,9 +84,12 @@ class Launcher:
         cp.lifecycle_stage = LifecycleStage.CANCELLED
         cp.touch()
         self.store.upsert_checkpoint(cp)
-        for kind in ("JobSet", "Job"):
+        # only ONE of the kinds exists per run — 404 on the other is expected;
+        # any real API error must surface (a run marked CANCELLED while its
+        # JobSet keeps burning the TPU slice would be invisible otherwise)
+        for kind in ("JobSet", "Job", "Service"):
             try:
                 await self.kube.delete_object(kind, namespace, run_id)
-            except Exception:  # noqa: BLE001 - either kind may not exist
+            except NotFoundError:
                 continue
         return True

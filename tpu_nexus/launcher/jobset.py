@@ -61,36 +61,52 @@ def run_labels(spec: LaunchSpec) -> Dict[str, str]:
     }
 
 
-def coordinator_address(spec: LaunchSpec) -> str:
-    """Worker 0's stable DNS under the JobSet-managed headless service."""
-    return (
-        f"{spec.run_id}-workers-0-0.{spec.run_id}.{spec.namespace}.svc:{COORDINATOR_PORT}"
-    )
+def coordinator_address(spec: LaunchSpec, jobset: bool = True) -> str:
+    """Worker 0's stable DNS name.
+
+    JobSet path: under the JobSet-managed headless service, pod 0 of the
+    replicated job is ``<js>-workers-0-0.<js>.<ns>.svc``.  Plain indexed-Job
+    path: pods get hostname ``<job>-<index>`` when the pod template sets
+    ``subdomain`` to a matching headless Service (created by the Launcher),
+    giving ``<job>-0.<job>.<ns>.svc``.
+    """
+    host = f"{spec.run_id}-workers-0-0" if jobset else f"{spec.run_id}-0"
+    return f"{host}.{spec.run_id}.{spec.namespace}.svc:{COORDINATOR_PORT}"
 
 
-def workload_env(spec: LaunchSpec, process_id_field: str = "JOB_COMPLETION_INDEX") -> List[Dict[str, Any]]:
+def workload_env(spec: LaunchSpec, jobset: bool = True) -> List[Dict[str, Any]]:
     """The NEXUS_* env contract consumed by parallel.distributed.
 
-    Process id comes from the downward-API completion index env populated by
-    the Job controller on indexed jobs.
+    Process id comes from the downward API: the Job controller stamps the
+    ``batch.kubernetes.io/job-completion-index`` annotation on indexed-job
+    pods (a ``$(VAR)`` reference to JOB_COMPLETION_INDEX would NOT expand —
+    dependent expansion only sees variables declared earlier in the list,
+    and the controller appends its env after user env).
     """
     env: List[Dict[str, Any]] = [
         {"name": ENV_RUN_ID, "value": spec.run_id},
         {"name": ENV_ALGORITHM, "value": spec.algorithm},
         {"name": ENV_NUM_PROCESSES, "value": str(spec.num_hosts)},
-        {"name": ENV_PROCESS_ID, "value": f"$({process_id_field})"},
+        {
+            "name": ENV_PROCESS_ID,
+            "valueFrom": {
+                "fieldRef": {
+                    "fieldPath": "metadata.annotations['batch.kubernetes.io/job-completion-index']"
+                }
+            },
+        },
     ]
     if spec.num_hosts > 1:
-        env.append({"name": ENV_COORDINATOR, "value": coordinator_address(spec)})
+        env.append({"name": ENV_COORDINATOR, "value": coordinator_address(spec, jobset=jobset)})
     env.extend({"name": k, "value": v} for k, v in sorted(spec.env.items()))
     return env
 
 
-def _pod_template(spec: LaunchSpec) -> Dict[str, Any]:
+def _pod_template(spec: LaunchSpec, jobset: bool) -> Dict[str, Any]:
     container: Dict[str, Any] = {
         "name": "algorithm",
         "image": spec.image,
-        "env": workload_env(spec),
+        "env": workload_env(spec, jobset=jobset),
     }
     if spec.command:
         container["command"] = list(spec.command)
@@ -100,6 +116,11 @@ def _pod_template(spec: LaunchSpec) -> Dict[str, Any]:
         "restartPolicy": "Never",
         "containers": [container],
     }
+    if not jobset and spec.num_hosts > 1:
+        # stable per-index pod DNS for the coordinator: requires the matching
+        # headless Service (compose_headless_service) the Launcher creates
+        pod_spec["subdomain"] = spec.run_id
+        pod_spec["setHostnameAsFQDN"] = False
     if spec.node_selector:
         pod_spec["nodeSelector"] = dict(spec.node_selector)
     return {
@@ -108,7 +129,26 @@ def _pod_template(spec: LaunchSpec) -> Dict[str, Any]:
     }
 
 
-def compose_job(spec: LaunchSpec) -> Dict[str, Any]:
+def compose_headless_service(spec: LaunchSpec) -> Dict[str, Any]:
+    """Headless Service backing the plain-Job multi-host coordinator DNS
+    (JobSet creates its own; this is only for the no-CRD fallback path)."""
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": spec.run_id,
+            "namespace": spec.namespace,
+            "labels": run_labels(spec),
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": run_labels(spec),
+            "ports": [{"name": "coordinator", "port": COORDINATOR_PORT}],
+        },
+    }
+
+
+def compose_job(spec: LaunchSpec, jobset: bool = False) -> Dict[str, Any]:
     """Plain batch/v1 Job — single-host runs (BASELINE configs #2/#3) and
     clusters without the JobSet CRD.  Indexed completion mode so the env
     contract is identical to the JobSet path."""
@@ -127,7 +167,7 @@ def compose_job(spec: LaunchSpec) -> Dict[str, Any]:
                 }
             ]
         },
-        "template": _pod_template(spec),
+        "template": _pod_template(spec, jobset),
     }
     if spec.deadline_seconds:
         job_spec["activeDeadlineSeconds"] = spec.deadline_seconds
@@ -147,7 +187,7 @@ def compose_jobset(spec: LaunchSpec) -> Dict[str, Any]:
     """JobSet for multi-host TPU slices: all workers restart together on a
     worker failure (Recreate) — a TPU slice is all-or-nothing, and
     restart-from-step is driven by the tensor checkpoint (SURVEY.md §7.4)."""
-    job = compose_job(spec)
+    job = compose_job(spec, jobset=True)
     return {
         "apiVersion": "jobset.x-k8s.io/v1alpha2",
         "kind": "JobSet",

@@ -139,6 +139,11 @@ def run_workload(
     data = data or synthetic_tokens(
         cfg.batch_size, cfg.seq_len, cfg.model.vocab_size, seed=cfg.seed + ctx.process_id
     )
+    # restart-from-step must also restart-from-*data*: fast-forward the
+    # stream so resumed steps see the batches they would have seen, not a
+    # replay of batch 0..N (which silently corrupts the training trajectory)
+    for _ in range(start_step):
+        next(data)
 
     reporter.running()
     metrics: Dict[str, Any] = {}
