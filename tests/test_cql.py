@@ -243,3 +243,16 @@ def test_upsert_builds_inlined_insert():
     assert "{'h0/c0': 5}" in q  # map literal
     assert "'FAILED'" in q
     store.close()
+
+
+def test_merge_chip_steps_builds_map_append():
+    server = FakeCqlServer()
+    server.start()
+    store = ScyllaCqlStore(hosts=["127.0.0.1"], port=server.port)
+    store.merge_chip_steps("test-algorithm", "run-1", {"host1/chip0": 7, "host1/chip1": 7})
+    q = server.queries[0]
+    # per-key map append: atomic per cell, no read-modify-write
+    assert q.startswith("UPDATE nexus.checkpoints SET per_chip_steps = per_chip_steps + ")
+    assert "{'host1/chip0': 7, 'host1/chip1': 7}" in q
+    assert "WHERE algorithm = 'test-algorithm' AND id = 'run-1'" in q
+    store.close()

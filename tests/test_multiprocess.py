@@ -1,0 +1,83 @@
+"""True multi-process rehearsal: 2 jax.distributed CPU processes run one
+sharded training run against a shared sqlite ledger (SURVEY §7.4 "testing
+multi-host without TPUs"; BASELINE config #4 in miniature).
+
+Validates for real (not simulated): coordinator bootstrap via the launcher
+env contract, a process-spanning global mesh, cross-process collectives in
+the train step, per-process data sharding, and concurrent per-host
+heartbeats merging (not clobbering) in the ledger.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import SqliteCheckpointStore
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_jax_distributed_run(tmp_path):
+    db = str(tmp_path / "ledger.db")
+    run_id, algorithm = "rehearsal-1", "llama-rehearsal"
+    store = SqliteCheckpointStore(db)
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=algorithm, id=run_id, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    store.close()
+
+    port = free_port()
+    env_base = {
+        **os.environ,
+        "PALLAS_AXON_POOL_IPS": "",  # detach the TPU tunnel in children
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "NEXUS_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "NEXUS_NUM_PROCESSES": "2",
+        "NEXUS_RUN_ID": run_id,
+        "NEXUS_ALGORITHM": algorithm,
+        "NEXUS_REHEARSAL_DB": db,
+        "NEXUS_BATCH": "4",
+        "NEXUS_STEPS": "6",
+        "NEXUS_HEARTBEAT_EVERY": "2",
+    }
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tpu_nexus.workload.rehearsal"],
+            env={**env_base, "NEXUS_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(2)
+    ]
+    outs = [p.communicate(timeout=300)[0] for p in procs]
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {i} failed:\n{out[-3000:]}"
+
+    results = []
+    for out in outs:
+        line = [ln for ln in out.splitlines() if ln.startswith("REHEARSAL_RESULT ")][0]
+        results.append(json.loads(line[len("REHEARSAL_RESULT "):]))
+    # SPMD: both processes computed the same global loss
+    assert results[0]["final_step"] == results[1]["final_step"] == 6
+    assert abs(results[0]["loss"] - results[1]["loss"]) < 1e-6
+
+    store = SqliteCheckpointStore(db)
+    cp = store.read_checkpoint(algorithm, run_id)
+    assert cp.lifecycle_stage == LifecycleStage.COMPLETED
+    # both hosts' heartbeats survived concurrent merging: each process has 2
+    # virtual devices -> 4 distinct chip keys
+    assert cp.per_chip_steps == {
+        "host0/chip0": 6, "host0/chip1": 6, "host1/chip0": 6, "host1/chip1": 6,
+    }, cp.per_chip_steps

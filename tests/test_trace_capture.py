@@ -1,0 +1,55 @@
+"""Failure-time trace capture: artifact written, ledger ref recorded, and the
+ref rides the error message into the supervisor's extractor (north-star
+hlo_trace_ref column end-to-end)."""
+
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.supervisor.taxonomy import classify_tpu_failure, extract_hlo_trace_ref
+from tpu_nexus.workload.faults import ENV_FAULT_MODE, ENV_FAULT_STEP
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.parallel import MeshSpec
+from tpu_nexus.workload.train import TrainConfig
+
+CTX = ProcessContext(run_id="trace-run", algorithm="llama", process_id=0, num_processes=1, coordinator=None)
+
+
+def workload(tmp_path):
+    return WorkloadConfig(
+        model=LlamaConfig.tiny(),
+        train=TrainConfig(warmup_steps=2, total_steps=50),
+        mesh=MeshSpec(fsdp=4, tp=2),
+        batch_size=2,
+        seq_len=32,
+        steps=6,
+        heartbeat_every=2,
+        checkpoint_dir=str(tmp_path),
+    )
+
+
+def test_failure_writes_trace_and_ledger_ref(tmp_path, monkeypatch):
+    monkeypatch.setenv(ENV_FAULT_MODE, "xla-abort")
+    monkeypatch.setenv(ENV_FAULT_STEP, "3")
+    store = InMemoryCheckpointStore()
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=CTX.algorithm, id=CTX.run_id, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    with pytest.raises(RuntimeError, match="hlo_trace: file://") as ei:
+        run_workload(workload(tmp_path), store=store, ctx=CTX)
+    message = str(ei.value)
+    # the ref is extractable from the message exactly as the supervisor would
+    ref = extract_hlo_trace_ref(message)
+    assert ref.startswith("file://") and ref.endswith(".hlo")
+    # the original failure text is preserved for classification
+    assert classify_tpu_failure(message) is not None
+    # artifact exists and carries context
+    path = ref[len("file://"):]
+    content = open(path).read()
+    assert "trace-run" in content and "step=3" in content and "Mosaic" in content
+    # ledger row got the ref without a lifecycle change (supervisor's call)
+    cp = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+    assert cp.hlo_trace_ref == ref
+    assert cp.lifecycle_stage == LifecycleStage.RUNNING

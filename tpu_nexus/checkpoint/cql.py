@@ -423,6 +423,30 @@ class CqlCheckpointStore(CheckpointStore):
         literals = ", ".join(to_literal(v) for v in values.values())
         self._execute(f"INSERT INTO {self.table} ({cols}) VALUES ({literals})")
 
+    def merge_chip_steps(self, algorithm: str, id: str, steps: Dict[str, int]) -> None:
+        """CQL map append: per-key upsert, atomic per cell — concurrent hosts
+        never clobber each other's chip counters (no read needed)."""
+        if not steps:
+            return
+        literal = to_literal({k: int(v) for k, v in steps.items()})
+        self._execute(
+            f"UPDATE {self.table} SET per_chip_steps = per_chip_steps + {literal} "
+            f"WHERE algorithm = {quote_text(algorithm)} AND id = {quote_text(id)}"
+        )
+
+    def update_fields(self, algorithm: str, id: str, fields: Dict[str, Any]) -> None:
+        """Column-level UPDATE — CQL writes are per-cell, so columns not
+        named (per_chip_steps especially) are untouched."""
+        if "per_chip_steps" in fields:
+            raise ValueError("use merge_chip_steps for per_chip_steps")
+        if not fields:
+            return
+        sets = ", ".join(f"{k} = {to_literal(v)}" for k, v in fields.items())
+        self._execute(
+            f"UPDATE {self.table} SET {sets} "
+            f"WHERE algorithm = {quote_text(algorithm)} AND id = {quote_text(id)}"
+        )
+
     def _query_index(self, column: str, value: str) -> List[CheckpointedRequest]:
         rows = self._execute(
             f"SELECT {_SELECT_COLS} FROM {self.table} WHERE {column} = {quote_text(value)}"

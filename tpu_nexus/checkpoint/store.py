@@ -82,6 +82,35 @@ class CheckpointStore:
     def query_by_host(self, host: str) -> List[CheckpointedRequest]:
         raise NotImplementedError
 
+    def merge_chip_steps(self, algorithm: str, id: str, steps: Dict[str, int]) -> None:
+        """Merge per-chip heartbeat counters into the row WITHOUT a full-row
+        read-modify-write: N hosts heartbeat one run concurrently and each
+        owns only its own ``host<i>/chip<j>`` keys — a whole-row RMW would let
+        host A's write clobber host B's keys.  Backends override with an
+        atomic per-key update (CQL map append; sqlite single-column txn);
+        this default is only safe single-writer."""
+        cp = self.read_checkpoint(algorithm, id)
+        if cp is None:
+            return
+        cp = cp.deep_copy()
+        cp.per_chip_steps.update(steps)
+        self.upsert_checkpoint(cp)
+
+    def update_fields(self, algorithm: str, id: str, fields: Dict[str, object]) -> None:
+        """Column-level update (never touches columns not named — in
+        particular never rewrites ``per_chip_steps``, which concurrent hosts
+        are merging).  Backends override with a real partial write; this
+        default RMW is only safe single-writer."""
+        if "per_chip_steps" in fields:
+            raise ValueError("use merge_chip_steps for per_chip_steps")
+        cp = self.read_checkpoint(algorithm, id)
+        if cp is None:
+            return
+        cp = cp.deep_copy()
+        for key, value in fields.items():
+            setattr(cp, key, value)
+        self.upsert_checkpoint(cp)
+
     def close(self) -> None:
         pass
 
@@ -114,6 +143,21 @@ class InMemoryCheckpointStore(CheckpointStore):
 
     def query_by_host(self, host: str) -> List[CheckpointedRequest]:
         return self._query(lambda cp: cp.received_by_host == host)
+
+    def merge_chip_steps(self, algorithm: str, id: str, steps: Dict[str, int]) -> None:
+        with self._lock:
+            cp = self._rows.get((algorithm, id))
+            if cp is not None:
+                cp.per_chip_steps.update(steps)
+
+    def update_fields(self, algorithm: str, id: str, fields: Dict[str, object]) -> None:
+        if "per_chip_steps" in fields:
+            raise ValueError("use merge_chip_steps for per_chip_steps")
+        with self._lock:
+            cp = self._rows.get((algorithm, id))
+            if cp is not None:
+                for key, value in fields.items():
+                    setattr(cp, key, value)
 
 
 class SqliteCheckpointStore(CheckpointStore):
@@ -181,6 +225,48 @@ class SqliteCheckpointStore(CheckpointStore):
 
     def query_by_host(self, host: str) -> List[CheckpointedRequest]:
         return self._query("received_by_host", host)
+
+    def merge_chip_steps(self, algorithm: str, id: str, steps: Dict[str, int]) -> None:
+        import json
+
+        with self._lock:
+            conn = self._connection()
+            # IMMEDIATE: take the write lock before reading so two hosts'
+            # merge transactions serialize instead of clobbering
+            conn.execute("BEGIN IMMEDIATE")
+            try:
+                cur = conn.execute(
+                    "SELECT per_chip_steps FROM checkpoints WHERE algorithm=? AND id=?",
+                    (algorithm, id),
+                )
+                row = cur.fetchone()
+                if row is None:
+                    return
+                current = json.loads(row[0]) if row[0] else {}
+                current.update(steps)
+                conn.execute(
+                    "UPDATE checkpoints SET per_chip_steps=? WHERE algorithm=? AND id=?",
+                    (json.dumps(current, sort_keys=True), algorithm, id),
+                )
+            finally:
+                conn.commit()
+
+    def update_fields(self, algorithm: str, id: str, fields: Dict[str, object]) -> None:
+        if "per_chip_steps" in fields:
+            raise ValueError("use merge_chip_steps for per_chip_steps")
+        if not fields:
+            return
+        for key in fields:
+            if key not in _COLUMNS:
+                raise ValueError(f"unknown column {key!r}")
+        sets = ", ".join(f"{k}=?" for k in fields)
+        with self._lock:
+            conn = self._connection()
+            conn.execute(
+                f"UPDATE checkpoints SET {sets} WHERE algorithm=? AND id=?",
+                [*fields.values(), algorithm, id],
+            )
+            conn.commit()
 
     def close(self) -> None:
         with self._lock:

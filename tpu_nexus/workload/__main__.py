@@ -10,7 +10,6 @@ uncaught errors.
 from __future__ import annotations
 
 import logging
-import os
 import sys
 
 
@@ -18,29 +17,12 @@ def main() -> int:
     from tpu_nexus.app.config import SupervisorConfig
     from tpu_nexus.app.dependencies import ApplicationServices
     from tpu_nexus.core.config import load_config
-    from tpu_nexus.models import LlamaConfig
-    from tpu_nexus.parallel import MeshSpec
     from tpu_nexus.workload.harness import WorkloadConfig, run_workload
-    from tpu_nexus.workload.train import TrainConfig
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     cfg = load_config(SupervisorConfig)
     store = ApplicationServices().with_store_for(cfg).store
-
-    preset = os.environ.get("NEXUS_MODEL_PRESET", "tiny")
-    model = getattr(LlamaConfig, preset)()
-    wcfg = WorkloadConfig(
-        model=model,
-        train=TrainConfig(total_steps=int(os.environ.get("NEXUS_STEPS", "100"))),
-        mesh=MeshSpec(fsdp=-1),
-        batch_size=int(os.environ.get("NEXUS_BATCH", "8")),
-        seq_len=int(os.environ.get("NEXUS_SEQ_LEN", "512")),
-        steps=int(os.environ.get("NEXUS_STEPS", "100")),
-        heartbeat_every=int(os.environ.get("NEXUS_HEARTBEAT_EVERY", "10")),
-        checkpoint_every=int(os.environ.get("NEXUS_CHECKPOINT_EVERY", "0")),
-        checkpoint_dir=os.environ.get("NEXUS_CHECKPOINT_DIR", ""),
-    )
-    result = run_workload(wcfg, store=store)
+    result = run_workload(WorkloadConfig.from_env(), store=store)
     logging.getLogger(__name__).info("workload done: %s", result)
     return 0
 

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import time
 from dataclasses import dataclass, field
+from datetime import datetime, timezone
 from typing import Any, Dict, Iterator, Optional
 
 import jax
@@ -35,7 +36,12 @@ from tpu_nexus.parallel.sharding import RuleTable
 from tpu_nexus.workload.data import synthetic_tokens
 from tpu_nexus.workload.faults import FaultPlan, maybe_inject
 from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
-from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+from tpu_nexus.workload.train import (
+    TrainConfig,
+    batch_sharding,
+    init_train_state,
+    make_train_step,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -54,56 +60,115 @@ class WorkloadConfig:
     checkpoint_dir: str = ""
     seed: int = 0
 
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "WorkloadConfig":
+        """The launcher env contract, parsed in ONE place — both the workload
+        container entrypoint and the multi-process rehearsal use this, so the
+        rehearsal always exercises exactly what production will run."""
+        import os
+
+        e = os.environ if env is None else env
+        steps = int(e.get("NEXUS_STEPS", "100"))
+        return WorkloadConfig(
+            model=getattr(LlamaConfig, e.get("NEXUS_MODEL_PRESET", "tiny"))(),
+            train=TrainConfig(
+                warmup_steps=int(e.get("NEXUS_WARMUP_STEPS", "10")),
+                total_steps=max(steps, 2),
+            ),
+            mesh=MeshSpec(fsdp=-1),
+            batch_size=int(e.get("NEXUS_BATCH", "8")),
+            seq_len=int(e.get("NEXUS_SEQ_LEN", "512")),
+            steps=steps,
+            heartbeat_every=int(e.get("NEXUS_HEARTBEAT_EVERY", "10")),
+            checkpoint_every=int(e.get("NEXUS_CHECKPOINT_EVERY", "0")),
+            checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
+            seed=int(e.get("NEXUS_SEED", "0")),
+        )
+
 
 class LedgerReporter:
-    """Writes the run's lifecycle + heartbeats through the reference's
-    read-guard-mutate-upsert discipline (services/supervisor.go:264-281)."""
+    """Writes the run's lifecycle + heartbeats with the reference's
+    guard-before-write discipline (services/supervisor.go:264-281), but via
+    COLUMN-level writes: N hosts report one run concurrently, so whole-row
+    upserts would clobber each other's columns — per_chip_steps especially
+    (merged per-key) but also checkpoint/trace refs."""
 
     def __init__(self, store: Optional[CheckpointStore], ctx: ProcessContext) -> None:
         self.store = store
         self.ctx = ctx
 
-    def _mutate(self, fn) -> None:
+    def _guarded_update(self, fields: Dict[str, Any]) -> None:
+        """Update columns unless the run is already terminal (IsFinished
+        guard: never resurrect/mutate a cancelled or finished run)."""
         if self.store is None:
             return
         cp = self.store.read_checkpoint(self.ctx.algorithm, self.ctx.run_id)
         if cp is None:
             cp = CheckpointedRequest(algorithm=self.ctx.algorithm, id=self.ctx.run_id)
-        if cp.is_finished():
-            return  # IsFinished guard: never resurrect a terminal run
-        cp = cp.deep_copy()
-        fn(cp)
-        cp.touch()
-        self.store.upsert_checkpoint(cp)
+            self.store.upsert_checkpoint(cp)
+        elif cp.is_finished():
+            return
+        fields = dict(fields, last_modified=datetime.now(timezone.utc))
+        self.store.update_fields(self.ctx.algorithm, self.ctx.run_id, fields)
 
     def running(self) -> None:
-        def f(cp):
-            if LifecycleStage.can_transition(cp.lifecycle_stage, LifecycleStage.RUNNING):
-                cp.lifecycle_stage = LifecycleStage.RUNNING
+        if self.store is None:
+            return
+        cp = self.store.read_checkpoint(self.ctx.algorithm, self.ctx.run_id)
+        stage = cp.lifecycle_stage if cp else LifecycleStage.NEW
+        if cp is not None and cp.is_finished():
+            return
+        if LifecycleStage.can_transition(stage, LifecycleStage.RUNNING):
+            self._guarded_update({"lifecycle_stage": LifecycleStage.RUNNING})
 
-        self._mutate(f)
+    def _chip_steps(self, step: int) -> Dict[str, int]:
+        return {self.ctx.chip_key(i): int(step) for i in range(jax.local_device_count())}
 
     def heartbeat(self, step: int) -> None:
-        def f(cp):
-            for i in range(jax.local_device_count()):
-                cp.per_chip_steps[self.ctx.chip_key(i)] = int(step)
-
-        self._mutate(f)
+        # per-key merge, NOT a row RMW: each host owns only its own chip keys
+        # and N hosts heartbeat one run concurrently (SURVEY §7.4 multi-host)
+        if self.store is None:
+            return
+        cp = self.store.read_checkpoint(self.ctx.algorithm, self.ctx.run_id)
+        if cp is None or cp.is_finished():
+            return  # IsFinished guard: no heartbeats onto terminal rows
+        self.store.merge_chip_steps(self.ctx.algorithm, self.ctx.run_id, self._chip_steps(step))
 
     def tensor_checkpoint(self, uri: str, step: int) -> None:
-        def f(cp):
-            cp.tensor_checkpoint_uri = uri
-            for i in range(jax.local_device_count()):
-                cp.per_chip_steps[self.ctx.chip_key(i)] = int(step)
-
-        self._mutate(f)
+        self._guarded_update({"tensor_checkpoint_uri": uri})
+        self.heartbeat(step)
 
     def completed(self, result_uri: str = "") -> None:
-        def f(cp):
-            cp.lifecycle_stage = LifecycleStage.COMPLETED
-            cp.result_uri = result_uri
+        self._guarded_update(
+            {"lifecycle_stage": LifecycleStage.COMPLETED, "result_uri": result_uri}
+        )
 
-        self._mutate(f)
+    def hlo_trace(self, uri: str) -> None:
+        """Record the failure-time trace artifact ref; the lifecycle itself
+        stays untouched — the terminal transition is the supervisor's call."""
+        self._guarded_update({"hlo_trace_ref": uri})
+
+
+def _dump_failure_trace(cfg: WorkloadConfig, ctx: ProcessContext, step: int, exc: BaseException) -> str:
+    """Write the failure-time trace artifact (traceback + device/mesh context)
+    and return its URI (``file://...hlo``; object-store in production).  The
+    extension matches the supervisor's HLO-ref extractor.  Best-effort: a
+    failing dump never masks the original error."""
+    import tempfile
+    import traceback
+
+    try:
+        base = cfg.checkpoint_dir or tempfile.gettempdir()
+        path = f"{base}/hlo_trace_{ctx.run_id}_host{ctx.process_id}_step{step}.hlo"
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(f"run={ctx.run_id} algorithm={ctx.algorithm} host={ctx.process_id} step={step}\n")
+            fh.write(f"backend={jax.default_backend()} devices={jax.local_device_count()}\n")
+            fh.write(f"mesh={cfg.mesh}\nmodel={cfg.model}\n\n")
+            fh.write("".join(traceback.format_exception(exc)))
+        return f"file://{path}"
+    except OSError:  # pragma: no cover - trace dir unwritable
+        logger.exception("failed to write failure trace")
+        return ""
 
 
 def run_workload(
@@ -136,33 +201,56 @@ def run_workload(
             logger.info("restored tensor checkpoint at step %d", latest)
 
     step_fn = make_train_step(cfg.model, cfg.train, mesh, cfg.rules)
+    # cfg.batch_size is GLOBAL; each process generates its own shard of the
+    # batch (disjoint seeds) and multi-process runs assemble the global array
+    # from process-local data
+    if cfg.batch_size % ctx.num_processes:
+        raise ValueError(f"batch {cfg.batch_size} not divisible by {ctx.num_processes} processes")
+    local_batch = cfg.batch_size // ctx.num_processes
     data = data or synthetic_tokens(
-        cfg.batch_size, cfg.seq_len, cfg.model.vocab_size, seed=cfg.seed + ctx.process_id
+        local_batch, cfg.seq_len, cfg.model.vocab_size, seed=cfg.seed + ctx.process_id
     )
     # restart-from-step must also restart-from-*data*: fast-forward the
     # stream so resumed steps see the batches they would have seen, not a
     # replay of batch 0..N (which silently corrupts the training trajectory)
     for _ in range(start_step):
         next(data)
+    tokens_sharding = batch_sharding(mesh, cfg.rules)
+
+    def to_global(raw):
+        if ctx.num_processes > 1:
+            return jax.make_array_from_process_local_data(tokens_sharding, np.asarray(raw))
+        return jax.numpy.asarray(raw)
 
     reporter.running()
     metrics: Dict[str, Any] = {}
     t0 = time.perf_counter()
     tokens_done = 0
-    with mesh:
-        for step in range(start_step, cfg.steps):
-            maybe_inject(plan, step)
-            batch = jax.numpy.asarray(next(data))
-            state, m = step_fn(state, batch)
-            tokens_done += batch.size
-            if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
-                # pull metrics (device sync) only on heartbeat steps
-                metrics = {k: float(v) for k, v in m.items()}
-                reporter.heartbeat(step + 1)
-                logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
-            if ckpt and (step + 1) % cfg.checkpoint_every == 0:
-                uri = ckpt.save(step + 1, state)
-                reporter.tensor_checkpoint(uri, step + 1)
+    step = start_step
+    try:
+        with mesh:
+            for step in range(start_step, cfg.steps):
+                maybe_inject(plan, step)
+                batch = to_global(next(data))
+                state, m = step_fn(state, batch)
+                tokens_done += batch.size
+                if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
+                    # pull metrics (device sync) only on heartbeat steps
+                    metrics = {k: float(v) for k, v in m.items()}
+                    reporter.heartbeat(step + 1)
+                    logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
+                if ckpt and (step + 1) % cfg.checkpoint_every == 0:
+                    uri = ckpt.save(step + 1, state)
+                    reporter.tensor_checkpoint(uri, step + 1)
+    except Exception as exc:  # noqa: BLE001 - annotate, record, re-raise
+        # north-star contract: failure-time trace artifact, its ref in the
+        # ledger (hlo_trace_ref) AND in the raised message so the k8s event
+        # text carries it to the supervisor's extractor
+        uri = _dump_failure_trace(cfg, ctx, step, exc)
+        if uri:
+            reporter.hlo_trace(uri)
+            raise RuntimeError(f"{exc} [hlo_trace: {uri}]") from exc
+        raise
     jax.block_until_ready(state["step"])
     elapsed = time.perf_counter() - t0
     if ckpt:
@@ -170,7 +258,18 @@ def run_workload(
         ckpt.close()
     metrics = {k: float(v) for k, v in m.items()} if cfg.steps > start_step else metrics
     final_step = int(state["step"])
-    reporter.completed()
+    # completion protocol: every host lands its final heartbeat, THEN a
+    # cross-process barrier, THEN only the coordinator commits the terminal
+    # COMPLETED — otherwise a fast host's terminal write makes the IsFinished
+    # guard drop slower hosts' last heartbeats (observed in the 2-process
+    # rehearsal test)
+    reporter.heartbeat(final_step)
+    if ctx.num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("tpu_nexus_workload_done")
+    if ctx.is_coordinator:
+        reporter.completed()
     return {
         "final_step": final_step,
         "elapsed_s": elapsed,

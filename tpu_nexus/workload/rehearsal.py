@@ -1,0 +1,43 @@
+"""Multi-host rehearsal worker: the SURVEY §7.4 strategy of rehearsing
+multi-host semantics with ≥2 local ``jax.distributed`` CPU processes before
+any TPU slice exists.
+
+Run N of these with the launcher's env contract pointing at one coordinator:
+
+    NEXUS_COORDINATOR_ADDRESS=127.0.0.1:<port> NEXUS_NUM_PROCESSES=N \
+    NEXUS_PROCESS_ID=<i> NEXUS_RUN_ID=<id> NEXUS_ALGORITHM=<algo> \
+    NEXUS_REHEARSAL_DB=<sqlite path> python -m tpu_nexus.workload.rehearsal
+
+Each process contributes its local devices to one global mesh, generates its
+own shard of the global batch, and heartbeats its own ``host<i>/chip<j>``
+keys into the shared ledger — the full multi-host workload contract
+(BASELINE.json config #4) minus the TPUs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_nexus.checkpoint.store import SqliteCheckpointStore
+    from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+
+    store = None
+    db = os.environ.get("NEXUS_REHEARSAL_DB", "")
+    if db:
+        store = SqliteCheckpointStore(db)
+    # identical env-contract parsing to the production container entrypoint
+    result = run_workload(WorkloadConfig.from_env(), store=store)
+    print("REHEARSAL_RESULT " + json.dumps({k: result[k] for k in ("final_step", "loss")}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -114,6 +114,13 @@ def state_shardings(init_fn, key, model_cfg, mesh, rules) -> Any:
     }
 
 
+def batch_sharding(mesh: Mesh, rules: RuleTable) -> NamedSharding:
+    """Sharding of the global token batch ``[B, S]`` (batch over dp×fsdp,
+    sequence over sp) — also what multi-host data loading assembles into via
+    ``jax.make_array_from_process_local_data``."""
+    return NamedSharding(mesh, spec_for(("batch", "seq"), rules))
+
+
 def make_train_step(
     model_cfg: LlamaConfig,
     train_cfg: TrainConfig,
@@ -135,15 +142,14 @@ def make_train_step(
         def attn_fn(q, k, v, causal=True):  # noqa: F811
             return ring(q, k, v, causal=causal)
 
-    batch_spec = spec_for(("batch", "seq"), rules)
-    batch_sharding = NamedSharding(mesh, batch_spec)
+    tokens_sharding = batch_sharding(mesh, rules)
 
     def loss_fn(params, tokens):
         logits = llama_forward(params, tokens, model_cfg, attn_fn=attn_fn)
         return next_token_loss(logits, tokens, train_cfg.z_loss)
 
     def step_fn(state, tokens):
-        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        tokens = jax.lax.with_sharding_constraint(tokens, tokens_sharding)
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], tokens
         )
