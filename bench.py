@@ -35,7 +35,7 @@ def main() -> None:
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if on_tpu:
         cfg = LlamaConfig.nexus_1b()
-        batch, seq, steps, warmup = 4, 2048, 20, 3
+        batch, seq, steps, warmup = 16, 2048, 10, 2
     else:  # CPU smoke: keep it honest but small
         cfg = LlamaConfig.tiny()
         batch, seq, steps, warmup = 8, 128, 10, 2

@@ -34,19 +34,24 @@ class TestFlashAttention:
         ref = dense_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
-    def test_grads_match_dense(self):
-        q, k, v = rand_qkv(jax.random.PRNGKey(2), s=128)
+    @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.parametrize("hq,hkv", [(2, 2), (4, 2)])
+    def test_grads_match_dense(self, causal, hq, hkv):
+        q, k, v = rand_qkv(jax.random.PRNGKey(2), s=256, hq=hq, hkv=hkv)
 
         def loss_flash(q, k, v):
-            return jnp.sum(flash_attention(q, k, v, causal=True, interpret=True) ** 2)
+            return jnp.sum(flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
 
         def loss_dense(q, k, v):
-            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+            return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
 
         gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
         gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
-        for a, b in zip(gf, gd):
-            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3)
+        for name, a, b in zip("qkv", gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-3, atol=1e-3,
+                err_msg=f"d{name} mismatch (causal={causal}, hq={hq}, hkv={hkv})",
+            )
 
     def test_bf16(self):
         q, k, v = rand_qkv(jax.random.PRNGKey(3), dtype=jnp.bfloat16)

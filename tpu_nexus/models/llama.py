@@ -30,6 +30,11 @@ import jax.numpy as jnp
 from tpu_nexus.ops import attention as _ops_attention
 from tpu_nexus.ops.rmsnorm import rms_norm
 
+try:  # moved across jax versions
+    from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
+except ImportError:  # pragma: no cover
+    from jax.experimental.checkpoint_name import checkpoint_name as _checkpoint_name
+
 AttnFn = Callable[..., jax.Array]
 
 
@@ -48,6 +53,14 @@ class LlamaConfig:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     remat: bool = True
+    #: what the layer-scan checkpoint keeps for the backward pass:
+    #:  "dots"      — every matmul output (XLA's dots_with_no_batch_dims):
+    #:                least recompute, ~800 MB/layer at batch 8 / seq 2048;
+    #:  "attn_out"  — only the attention output (the one op whose recompute
+    #:                needs the flash kernel again): ~67 MB/layer, the
+    #:                memory/compute sweet spot that buys 2-4x batch;
+    #:  "nothing"   — full per-layer recompute, minimal memory.
+    remat_policy: str = "dots"
     tied_embeddings: bool = False
 
     # -- presets ------------------------------------------------------------
@@ -78,7 +91,7 @@ class LlamaConfig:
         return LlamaConfig(
             vocab_size=32768, hidden=2048, n_layers=14, n_heads=16, n_kv_heads=8,
             head_dim=128, intermediate=8192, tied_embeddings=True,
-            param_dtype=jnp.bfloat16, max_seq_len=4096,
+            param_dtype=jnp.bfloat16, max_seq_len=4096, remat_policy="attn_out",
         )
 
     @staticmethod
@@ -163,7 +176,14 @@ def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def llama_forward(
+def llama_head(params: Dict[str, Any], cfg: LlamaConfig) -> jax.Array:
+    """The output projection ``[E, vocab]`` (tied or untied)."""
+    if cfg.tied_embeddings:
+        return params["embed"]["tokens"].astype(cfg.dtype).T
+    return params["lm_head"].astype(cfg.dtype)
+
+
+def llama_hidden(
     params: Dict[str, Any],
     tokens: jax.Array,
     cfg: LlamaConfig,
@@ -172,10 +192,12 @@ def llama_forward(
     attn_fn: Optional[AttnFn] = None,
     attn_impl: str = "auto",
 ) -> jax.Array:
-    """Logits ``[B, S, vocab]`` for token ids ``[B, S]``.
+    """Final-norm hidden states ``[B, S, E]`` — the pre-head forward.
 
-    ``attn_fn(q, k, v, causal=...)`` overrides attention dispatch — the
-    harness injects ring attention when the mesh shards the sequence.
+    Split from :func:`llama_forward` so the training loss can project to
+    vocab in CHUNKS (chunked cross-entropy): materializing full f32 logits
+    ``[B, S, vocab]`` plus their gradient costs gigabytes at 32k+ vocab and
+    caps the batch size a chip can hold.
     """
     if positions is None:
         positions = jnp.broadcast_to(
@@ -197,6 +219,7 @@ def llama_forward(
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
         o = attn_fn(q, k, v, causal=True)
+        o = _checkpoint_name(o, "attn_out")
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
@@ -206,17 +229,35 @@ def llama_forward(
 
     body = block
     if cfg.remat:
-        body = jax.checkpoint(
-            block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        )
+        policies = {
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+        }
+        body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
     x, _ = jax.lax.scan(body, x, params["layers"])
 
-    x = rms_norm(x, params["out_norm"], cfg.norm_eps)
-    if cfg.tied_embeddings:
-        head = params["embed"]["tokens"].astype(ct).T
-    else:
-        head = params["lm_head"].astype(ct)
-    return jnp.einsum("bse,ev->bsv", x, head)
+    return rms_norm(x, params["out_norm"], cfg.norm_eps)
+
+
+def llama_forward(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    attn_fn: Optional[AttnFn] = None,
+    attn_impl: str = "auto",
+) -> jax.Array:
+    """Logits ``[B, S, vocab]`` for token ids ``[B, S]``.
+
+    ``attn_fn(q, k, v, causal=...)`` overrides attention dispatch — the
+    harness injects ring attention when the mesh shards the sequence.
+    """
+    x = llama_hidden(
+        params, tokens, cfg, positions=positions, attn_fn=attn_fn, attn_impl=attn_impl
+    )
+    return jnp.einsum("bse,ev->bsv", x, llama_head(params, cfg))
 
 
 def param_count(cfg: LlamaConfig) -> int:

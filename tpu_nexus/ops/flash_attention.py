@@ -1,17 +1,20 @@
-"""Pallas TPU flash attention (forward kernel + recompute backward).
+"""Pallas TPU flash attention: fused forward AND backward kernels.
 
-Fused online-softmax attention: scores never materialize in HBM, the K/V
-stream is consumed block-by-block from VMEM, accumulation is f32 on the MXU.
-Kernel follows the pallas_guide playbook: grid over (batch, q-head, q-block),
-K/V blocked per kv-head (GQA via index_map integer division), causal blocks
-past the diagonal skipped entirely via a dynamic fori_loop trip count.
+Forward: online-softmax attention — scores never materialize in HBM, K/V
+stream through VMEM block-by-block, f32 accumulation on the MXU; emits the
+per-row logsumexp ``L`` as a residual.  Backward: the standard flash
+recurrence (Dao et al. formulation) as two kernels — dQ (grid over Q blocks,
+streaming K/V) and dK/dV (grid over KV blocks, streaming Q/dO per GQA
+group) — recomputing probabilities from ``L`` so the ``[S, S]`` score matrix
+never exists in either pass.  This is what keeps HBM flat at long sequence:
+the XLA fallback backward materializes B·H·S² f32, which at seq 2048 / batch
+8 is gigabytes.
 
-Backward is recompute-based (jax.vjp over the XLA reference): correct and
-memory-light under ``jax.checkpoint``-style training; a dedicated pallas
-backward kernel is a later optimization.
+Causality skips whole blocks on both sides of the diagonal via dynamic
+fori_loop trip counts.
 
 Shapes: q [B, S, Hq, D], k/v [B, S, Hkv, D]; Hq % Hkv == 0; D % 128 == 0;
-S % BLOCK == 0.
+S % 128 == 0; self-attention (sq == sk).
 """
 
 from __future__ import annotations
@@ -39,7 +42,7 @@ def _on_tpu() -> bool:
 
 
 def flash_supported(q, k, v) -> bool:
-    """Shapes the kernel handles; callers fall back to XLA otherwise."""
+    """Shapes the kernels handle; callers fall back to XLA otherwise."""
     b, s, hq, d = q.shape
     sk = k.shape[1]
     return (
@@ -47,8 +50,8 @@ def flash_supported(q, k, v) -> bool:
         and d % 128 == 0
         and s % BLOCK_Q == 0
         and sk % BLOCK_K == 0
-        # kernel masks with q_pos anchored at 0: self-attention only (decode
-        # shapes sq != sk would mis-mask — they take the XLA path)
+        # masks anchor q_pos at 0: self-attention only (decode shapes take
+        # the XLA path)
         and s == sk
         and hq % k.shape[2] == 0
         # full K/V per kv-head must sit in VMEM next to q/acc blocks
@@ -56,7 +59,10 @@ def flash_supported(q, k, v) -> bool:
     )
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, s_k: int):
+# -- forward -------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, l_ref, *, scale: float, causal: bool, s_k: int):
     qi = pl.program_id(2)
     q = q_ref[0, 0, :, :]  # [BLOCK_Q, D]
     n_k_blocks = s_k // BLOCK_K
@@ -69,27 +75,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, s_k
         k_blk = k_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]  # [BLOCK_K, D]
         v_blk = v_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]
         scores = jax.lax.dot_general(
-            q,
-            k_blk,
-            dimension_numbers=(((1,), (1,)), ((), ())),
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [BLOCK_Q, BLOCK_K]
-        scores = scores * scale
+        ) * scale  # [BLOCK_Q, BLOCK_K]
         if causal:
             q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
         m_blk = jnp.max(scores, axis=1, keepdims=True)  # [BLOCK_Q, 1]
         m_new = jnp.maximum(m, m_blk)
-        # masked rows produce m=-inf on the diagonal path only when the row
-        # has no visible keys, which cannot happen under causal (self-key);
-        # the exp() is therefore safe, but keep the guard for robustness
         alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
-        p = jnp.exp(scores - m_new)  # [BLOCK_Q, BLOCK_K] f32
+        p = jnp.exp(scores - m_new)
         l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
-            p.astype(v_blk.dtype),
-            v_blk,
+            p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
@@ -102,8 +101,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale: float, causal: bool, s_k
         jnp.full((BLOCK_Q, 1), _NEG_INF, jnp.float32),
         jnp.zeros((BLOCK_Q, 1), jnp.float32),
     )
-    acc, _, l = jax.lax.fori_loop(0, n_k_blocks, body, init)
-    o_ref[0, 0, :, :] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    acc, m, l = jax.lax.fori_loop(0, n_k_blocks, body, init)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0, :, :] = (acc / l_safe).astype(o_ref.dtype)
+    # logsumexp residual for the backward recomputation: L = m + log(l).
+    # Kept [..., 1]-shaped: TPU block tiling wants the last two dims to be
+    # (8k, array-dim) — (BLOCK_Q, 1) qualifies, a bare [S] block would not.
+    l_ref[0, 0, :, :] = m + jnp.log(l_safe)
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
@@ -115,23 +119,21 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     grid = (b, hq, s // BLOCK_Q)
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal, s_k=s_k),
-        out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, s_k=s_k),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, s, 1), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(
-                (1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM
-            ),
-            pl.BlockSpec(
-                (1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM
-            ),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM
+        out_specs=(
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
         ),
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * s * s_k * d // (2 if causal else 1),
@@ -140,22 +142,190 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
         ),
         interpret=interpret,
     )(qt, kt, vt)
-    return jnp.swapaxes(out, 1, 2)
+    return out, lse  # both in [B, H, ...] layout
+
+
+# -- backward ------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dq_ref,
+    *, scale: float, causal: bool, s_k: int,
+):
+    """dQ = (P ∘ (dO·Vᵀ − D)) · K · scale, streamed over K blocks."""
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :]
+    do = do_ref[0, 0, :, :]
+    lse = l_ref[0, 0, :, :]  # [BLOCK_Q, 1]
+    dsum = dsum_ref[0, 0, :, :]  # [BLOCK_Q, 1]
+    n_k_blocks = s_k // BLOCK_K
+    if causal:
+        n_k_blocks = jnp.minimum(n_k_blocks, ((qi + 1) * BLOCK_Q + BLOCK_K - 1) // BLOCK_K)
+
+    def body(kb, dq_acc):
+        k_blk = k_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]
+        v_blk = v_ref[0, 0, pl.ds(kb * BLOCK_K, BLOCK_K), :]
+        scores = jax.lax.dot_general(
+            q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        if causal:
+            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        p = jnp.exp(scores - lse)  # [BLOCK_Q, BLOCK_K]
+        dp = jax.lax.dot_general(
+            do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dsum) * scale
+        return dq_acc + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(0, n_k_blocks, body, jnp.zeros_like(q, jnp.float32))
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dk_ref, dv_ref,
+    *, scale: float, causal: bool, s_q: int, group: int,
+):
+    """dK/dV for one KV block, streaming Q/dO blocks of every head in the
+    GQA group (grid is over KV heads, so group heads accumulate in-kernel
+    without cross-program races)."""
+    kb = pl.program_id(2)
+    k_blk = k_ref[0, 0, :, :]  # [BLOCK_K, D]
+    v_blk = v_ref[0, 0, :, :]
+    n_q_blocks = s_q // BLOCK_Q
+    qb_start = (kb * BLOCK_K) // BLOCK_Q if causal else 0
+
+    def head_body(gi, carry):
+        dk_acc, dv_acc = carry
+
+        def q_body(qi, carry2):
+            dk_a, dv_a = carry2
+            q_blk = q_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
+            do_blk = do_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
+            lse = l_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
+            dsum = dsum_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
+            scores = jax.lax.dot_general(
+                q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ) * scale  # [BLOCK_Q, BLOCK_K]
+            if causal:
+                q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+                k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+                scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+            p = jnp.exp(scores - lse)
+            # dV += Pᵀ · dO
+            dv_a = dv_a + jax.lax.dot_general(
+                p.astype(do_blk.dtype), do_blk,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            dp = jax.lax.dot_general(
+                do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            ds = p * (dp - dsum) * scale
+            # dK += dSᵀ · Q
+            dk_a = dk_a + jax.lax.dot_general(
+                ds.astype(q_blk.dtype), q_blk,
+                dimension_numbers=(((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            return dk_a, dv_a
+
+        return jax.lax.fori_loop(qb_start, n_q_blocks, q_body, (dk_acc, dv_acc))
+
+    d = k_blk.shape[-1]
+    init = (jnp.zeros((BLOCK_K, d), jnp.float32), jnp.zeros((BLOCK_K, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(0, group, head_body, init)
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
+    """q/k/v/g_out in model layout [B, S, H, D]; out/lse in kernel layout
+    [B, H, S, D] / [B, H, S].  Returns (dq, dk, dv) in model layout."""
+    b, s, hq, d = q.shape
+    s_k, hkv = k.shape[1], k.shape[2]
+    group = hq // hkv
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    dot = jnp.swapaxes(g_out, 1, 2)
+    # D_i = rowsum(dO ∘ O) — cheap elementwise+reduce, XLA fuses it
+    dsum = jnp.sum(
+        dot.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B, Hq, S, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal, s_k=s_k),
+        out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
+        grid=(b, hq, s // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // group, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // group, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BLOCK_Q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dsum)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, s_q=s, group=group),
+        out_shape=(
+            jax.ShapeDtypeStruct((b, hkv, s_k, d), k.dtype),
+            jax.ShapeDtypeStruct((b, hkv, s_k, d), v.dtype),
+        ),
+        grid=(b, hkv, s_k // BLOCK_K),
+        in_specs=[
+            # per program: ALL q/do/lse/dsum rows of this kv head's group
+            pl.BlockSpec((1, group, s, d), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, group, s, d), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, group, s, 1), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, group, s, 1), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+        ),
+        interpret=interpret,
+    )(qt, kt, vt, dot, lse, dsum)
+
+    return (
+        jnp.swapaxes(dq, 1, 2),
+        jnp.swapaxes(dk, 1, 2),
+        jnp.swapaxes(dv, 1, 2),
+    )
+
+
+# -- custom VJP ---------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash(q, k, v, scale, causal, interpret):
-    return _flash_forward(q, k, v, scale, causal, interpret)
+    out, _ = _flash_forward(q, k, v, scale, causal, interpret)
+    return jnp.swapaxes(out, 1, 2)
 
 
 def _flash_fwd(q, k, v, scale, causal, interpret):
-    return _flash_forward(q, k, v, scale, causal, interpret), (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale, causal, interpret)
+    return jnp.swapaxes(out, 1, 2), (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: dense_attention(q_, k_, v_, causal=causal, scale=scale), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = residuals
+    return _flash_backward(q, k, v, out, lse, g, scale, causal, interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -170,9 +340,9 @@ def flash_attention(
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Flash attention, ``[B, S, H, D]`` in and out.
+    """Flash attention, ``[B, S, H, D]`` in and out, fused fwd+bwd.
 
-    ``interpret`` defaults to True off-TPU so the kernel logic is testable on
+    ``interpret`` defaults to True off-TPU so the kernels are testable on
     the CPU mesh (pallas interpreter mode).
     """
     if scale is None:

@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_nexus.models import LlamaConfig, llama_axes, llama_forward, llama_init
+from tpu_nexus.models import LlamaConfig, llama_axes, llama_init
+from tpu_nexus.models.llama import llama_head, llama_hidden
 from tpu_nexus.parallel.ring import ring_attention_sharded
 from tpu_nexus.parallel.sharding import RuleTable, sharding_tree, spec_for
 
@@ -62,6 +63,55 @@ def next_token_loss(
     loss = ce
     if z_loss:
         loss = loss + z_loss * jnp.mean(jnp.square(logz))
+    return loss, {"ce_loss": ce, "perplexity": jnp.exp(ce)}
+
+
+def chunked_next_token_loss(
+    hidden: jax.Array,
+    head: jax.Array,
+    tokens: jax.Array,
+    z_loss: float = 0.0,
+    chunk: int = 256,
+) -> Tuple[jax.Array, Dict[str, jax.Array]]:
+    """Same loss as :func:`next_token_loss` but projecting to vocab chunk by
+    chunk over the sequence, inside a scan — full f32 logits ``[B, S, V]``
+    (and their cotangent) never exist in HBM.  At 32k vocab / seq 2048 /
+    batch 8 that is ~4 GB of peak memory back, which buys batch size.
+
+    hidden ``[B, S, E]`` (final-norm), head ``[E, V]``, tokens ``[B, S]``.
+    Position s predicts token s+1; the last position is masked out.
+    """
+    b, s, e = hidden.shape
+    if s % chunk:
+        chunk = s  # fall back to one chunk for ragged sequence lengths
+    n_chunks = s // chunk
+    # shift targets: target[s] = tokens[s+1]; last position gets a dummy 0
+    # and weight 0
+    targets = jnp.concatenate([tokens[:, 1:], jnp.zeros((b, 1), tokens.dtype)], axis=1)
+    h_chunks = jnp.moveaxis(hidden.reshape(b, n_chunks, chunk, e), 1, 0)
+    t_chunks = jnp.moveaxis(targets.reshape(b, n_chunks, chunk), 1, 0)
+    pos = jnp.arange(s).reshape(n_chunks, chunk)
+
+    def body(carry, xs):
+        ce_sum, z_sum, n = carry
+        h, t, p = xs
+        logits = jnp.einsum("bce,ev->bcv", h, head, preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)  # [B, chunk]
+        true_logit = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        weight = (p < s - 1).astype(jnp.float32)[None, :]  # mask final position
+        ce_sum = ce_sum + jnp.sum((logz - true_logit) * weight)
+        z_sum = z_sum + jnp.sum(jnp.square(logz) * weight)
+        return (ce_sum, z_sum, n + jnp.sum(weight) * b), None
+
+    # remat the body: without it, scan's backward saves each chunk's f32
+    # logits as residuals and the memory saving evaporates
+    body = jax.checkpoint(body)
+    init = (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+    (ce_sum, z_sum, n), _ = jax.lax.scan(body, init, (h_chunks, t_chunks, pos))
+    ce = ce_sum / n
+    loss = ce
+    if z_loss:
+        loss = loss + z_loss * z_sum / n
     return loss, {"ce_loss": ce, "perplexity": jnp.exp(ce)}
 
 
@@ -145,8 +195,9 @@ def make_train_step(
     tokens_sharding = batch_sharding(mesh, rules)
 
     def loss_fn(params, tokens):
-        logits = llama_forward(params, tokens, model_cfg, attn_fn=attn_fn)
-        return next_token_loss(logits, tokens, train_cfg.z_loss)
+        hidden = llama_hidden(params, tokens, model_cfg, attn_fn=attn_fn)
+        head = llama_head(params, model_cfg)
+        return chunked_next_token_loss(hidden, head, tokens, train_cfg.z_loss)
 
     def step_fn(state, tokens):
         tokens = jax.lax.with_sharding_constraint(tokens, tokens_sharding)
