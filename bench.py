@@ -35,10 +35,12 @@ def main() -> None:
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if on_tpu:
         cfg = LlamaConfig.nexus_1b()
-        batch, seq, steps, warmup = 16, 2048, 10, 2
+        batch, seq, steps, warmup = 16 * n_chips, 2048, 10, 2
     else:  # CPU smoke: keep it honest but small
         cfg = LlamaConfig.tiny()
-        batch, seq, steps, warmup = 8, 128, 10, 2
+        batch, seq, steps, warmup = 1 * n_chips, 128, 10, 2
+    # per-chip batch is fixed and the batch shards over dp*fsdp = all chips,
+    # so the global batch divides the mesh at any chip count
 
     tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
     mesh = build_mesh(MeshSpec(fsdp=-1))

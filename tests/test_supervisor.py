@@ -439,3 +439,34 @@ async def test_pod_failure_reenriched_from_fresh_cache():
     assert cp.lifecycle_stage == LifecycleStage.FAILED
     assert "compile" in cp.algorithm_failure_cause.lower()
     assert "XLA compilation failed" in cp.algorithm_failure_details
+
+
+async def test_pod_without_job_name_label_cannot_trigger_collection_delete():
+    """A run-labeled pod missing its batch.kubernetes.io/job-name backlink
+    classifies with request_id="" — that must be dropped at classification,
+    and even if it slipped through, delete_object must refuse empty names
+    (a DELETE at the collection URL is a namespace-wide deletecollection)."""
+    rid = str(uuid.uuid4())
+    pod = pod_obj(rid)
+    del pod["metadata"]["labels"][POD_JOB_NAME_LABEL]
+    objects = {
+        "Pod": [pod],
+        "Event": [event_obj("Failed", "boom", "Pod", pod["metadata"]["name"])],
+    }
+    fx = Fixture(objects)
+    await fx.run_until_idle()
+    # no delete of any kind happened — especially not an empty-name one
+    assert not [a for a in fx.client.actions if a[0] == "delete"], fx.client.actions
+    assert fx.store.read_checkpoint(ALGORITHM, rid) is None
+
+
+async def test_delete_object_refuses_empty_name():
+    from tpu_nexus.k8s.client import KubeClientError
+    from tpu_nexus.k8s.rest import RestKubeClient
+
+    fake = FakeKubeClient({})
+    with pytest.raises(KubeClientError):
+        await fake.delete_object("Job", NS, "")
+    rest = RestKubeClient("https://127.0.0.1:1")  # guard fires before any I/O
+    with pytest.raises(KubeClientError):
+        await rest.delete_object("Job", NS, "")

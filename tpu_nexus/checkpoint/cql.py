@@ -32,7 +32,12 @@ from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from tpu_nexus.checkpoint.models import CheckpointedRequest
-from tpu_nexus.checkpoint.store import CheckpointStore, CheckpointStoreError, _COLUMNS
+from tpu_nexus.checkpoint.store import (
+    CheckpointStore,
+    CheckpointStoreError,
+    _COLUMNS,
+    _validate_field_names,
+)
 from tpu_nexus.core.telemetry import VLogger, get_logger
 
 # -- opcodes -------------------------------------------------------------------
@@ -437,8 +442,9 @@ class CqlCheckpointStore(CheckpointStore):
     def update_fields(self, algorithm: str, id: str, fields: Dict[str, Any]) -> None:
         """Column-level UPDATE — CQL writes are per-cell, so columns not
         named (per_chip_steps especially) are untouched."""
-        if "per_chip_steps" in fields:
-            raise ValueError("use merge_chip_steps for per_chip_steps")
+        # field names are interpolated into the statement text — the shared
+        # guard keeps an unknown key from becoming arbitrary CQL
+        _validate_field_names(fields)
         if not fields:
             return
         sets = ", ".join(f"{k} = {to_literal(v)}" for k, v in fields.items())

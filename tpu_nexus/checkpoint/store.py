@@ -63,6 +63,17 @@ class CheckpointStoreError(Exception):
     pass
 
 
+def _validate_field_names(fields: Dict[str, object]) -> None:
+    """Shared update_fields guard: every backend must reject per_chip_steps
+    (concurrent hosts merge it) and unknown columns — a typo'd key must fail
+    identically against the in-memory test store and production CQL."""
+    if "per_chip_steps" in fields:
+        raise ValueError("use merge_chip_steps for per_chip_steps")
+    for key in fields:
+        if key not in _COLUMNS:
+            raise ValueError(f"unknown column {key!r}")
+
+
 class CheckpointStore:
     """Abstract store interface (sync; the supervisor hot path wraps calls
     in the actor's worker, and CQL/sqlite calls are fast or offloaded)."""
@@ -101,8 +112,7 @@ class CheckpointStore:
         particular never rewrites ``per_chip_steps``, which concurrent hosts
         are merging).  Backends override with a real partial write; this
         default RMW is only safe single-writer."""
-        if "per_chip_steps" in fields:
-            raise ValueError("use merge_chip_steps for per_chip_steps")
+        _validate_field_names(fields)
         cp = self.read_checkpoint(algorithm, id)
         if cp is None:
             return
@@ -151,8 +161,7 @@ class InMemoryCheckpointStore(CheckpointStore):
                 cp.per_chip_steps.update(steps)
 
     def update_fields(self, algorithm: str, id: str, fields: Dict[str, object]) -> None:
-        if "per_chip_steps" in fields:
-            raise ValueError("use merge_chip_steps for per_chip_steps")
+        _validate_field_names(fields)
         with self._lock:
             cp = self._rows.get((algorithm, id))
             if cp is not None:
@@ -252,19 +261,29 @@ class SqliteCheckpointStore(CheckpointStore):
                 conn.commit()
 
     def update_fields(self, algorithm: str, id: str, fields: Dict[str, object]) -> None:
-        if "per_chip_steps" in fields:
-            raise ValueError("use merge_chip_steps for per_chip_steps")
+        _validate_field_names(fields)
         if not fields:
             return
-        for key in fields:
-            if key not in _COLUMNS:
-                raise ValueError(f"unknown column {key!r}")
+
+        def normalize(value):
+            # bind the same representations to_row() produces — sqlite3's
+            # implicit datetime adapter is deprecated (removal slated) and
+            # dicts aren't bindable at all
+            import json
+            from datetime import datetime
+
+            if isinstance(value, datetime):
+                return value.isoformat()
+            if isinstance(value, dict):
+                return json.dumps(value, sort_keys=True)
+            return value
+
         sets = ", ".join(f"{k}=?" for k in fields)
         with self._lock:
             conn = self._connection()
             conn.execute(
                 f"UPDATE checkpoints SET {sets} WHERE algorithm=? AND id=?",
-                [*fields.values(), algorithm, id],
+                [*(normalize(v) for v in fields.values()), algorithm, id],
             )
             conn.commit()
 

@@ -17,6 +17,7 @@ from tpu_nexus.k8s.client import (
     KIND_API,
     PROPAGATION_BACKGROUND,
     KubeClient,
+    KubeClientError,
     NotFoundError,
 )
 from tpu_nexus.checkpoint.models import POD_JOB_NAME_LABEL
@@ -95,6 +96,9 @@ class FakeKubeClient(KubeClient):
         name: str,
         propagation: str = PROPAGATION_BACKGROUND,
     ) -> None:
+        if not name:
+            # parity with RestKubeClient: empty name addresses the collection
+            raise KubeClientError(f"refusing DELETE with empty name (kind={kind!r}, ns={namespace!r})")
         store = self._objects.get(kind, {})
         obj = store.get((namespace, name))
         self.actions.append(("delete", kind, namespace, name, {"propagation": propagation}))

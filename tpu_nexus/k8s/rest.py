@@ -225,6 +225,11 @@ class RestKubeClient(KubeClient):
         name: str,
         propagation: str = PROPAGATION_BACKGROUND,
     ) -> None:
+        if not name:
+            # _path(name="") is the COLLECTION url — a DELETE there is a
+            # namespace-wide deletecollection, never what a supervisor
+            # decision means.  Refuse loudly.
+            raise KubeClientError(f"refusing DELETE with empty name (kind={kind!r}, ns={namespace!r})")
         session = await self._ensure_session()
         body = {"kind": "DeleteOptions", "apiVersion": "v1", "propagationPolicy": propagation}
         async with session.delete(

@@ -4,9 +4,10 @@ Forward: online-softmax attention — scores never materialize in HBM, K/V
 stream through VMEM block-by-block, f32 accumulation on the MXU; emits the
 per-row logsumexp ``L`` as a residual.  Backward: the standard flash
 recurrence (Dao et al. formulation) as two kernels — dQ (grid over Q blocks,
-streaming K/V) and dK/dV (grid over KV blocks, streaming Q/dO per GQA
-group) — recomputing probabilities from ``L`` so the ``[S, S]`` score matrix
-never exists in either pass.  This is what keeps HBM flat at long sequence:
+streaming K/V) and dK/dV (grid over KV blocks × (GQA head, Q block), one
+BLOCK_Q tile in VMEM at a time with f32 scratch accumulation) — recomputing
+probabilities from ``L`` so the ``[S, S]`` score matrix never exists in
+either pass.  This is what keeps HBM flat at long sequence:
 the XLA fallback backward materializes B·H·S² f32, which at seq 2048 / batch
 8 is gigabytes.
 
@@ -191,61 +192,66 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dk_ref, dv_ref,
-    *, scale: float, causal: bool, s_q: int, group: int,
+    dk_acc, dv_acc,
+    *, scale: float, causal: bool, n_q_blocks: int, group: int,
 ):
-    """dK/dV for one KV block, streaming Q/dO blocks of every head in the
-    GQA group (grid is over KV heads, so group heads accumulate in-kernel
-    without cross-program races)."""
+    """dK/dV for one KV block.  The grid's two minor axes stream (GQA head,
+    Q block) pairs through VMEM one BLOCK_Q tile at a time, accumulating
+    into f32 scratch that persists across those axes; the output block is
+    written once on the final pair.  Per-program VMEM is O(BLOCK) —
+    whole-sequence-per-program BlockSpecs here would exceed VMEM at
+    flagship shapes (group 4, seq 8k, d 128 ⇒ 16 MB+ just for q/do)."""
     kb = pl.program_id(2)
-    k_blk = k_ref[0, 0, :, :]  # [BLOCK_K, D]
-    v_blk = v_ref[0, 0, :, :]
-    n_q_blocks = s_q // BLOCK_Q
-    qb_start = (kb * BLOCK_K) // BLOCK_Q if causal else 0
+    gi = pl.program_id(3)
+    qi = pl.program_id(4)
 
-    def head_body(gi, carry):
-        dk_acc, dv_acc = carry
+    @pl.when(jnp.logical_and(gi == 0, qi == 0))
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
 
-        def q_body(qi, carry2):
-            dk_a, dv_a = carry2
-            q_blk = q_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
-            do_blk = do_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
-            lse = l_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
-            dsum = dsum_ref[0, gi, pl.ds(qi * BLOCK_Q, BLOCK_Q), :]
-            scores = jax.lax.dot_general(
-                q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ) * scale  # [BLOCK_Q, BLOCK_K]
-            if causal:
-                q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-                k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-                scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-            p = jnp.exp(scores - lse)
-            # dV += Pᵀ · dO
-            dv_a = dv_a + jax.lax.dot_general(
-                p.astype(do_blk.dtype), do_blk,
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            dp = jax.lax.dot_general(
-                do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            ds = p * (dp - dsum) * scale
-            # dK += dSᵀ · Q
-            dk_a = dk_a + jax.lax.dot_general(
-                ds.astype(q_blk.dtype), q_blk,
-                dimension_numbers=(((0,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            return dk_a, dv_a
+    # causal: a q block strictly above the diagonal contributes nothing
+    live = ((qi + 1) * BLOCK_Q > kb * BLOCK_K) if causal else (qi >= 0)
 
-        return jax.lax.fori_loop(qb_start, n_q_blocks, q_body, (dk_acc, dv_acc))
+    @pl.when(live)
+    def _compute():
+        k_blk = k_ref[0, 0, :, :]  # [BLOCK_K, D]
+        v_blk = v_ref[0, 0, :, :]
+        q_blk = q_ref[0, 0, :, :]  # [BLOCK_Q, D]
+        do_blk = do_ref[0, 0, :, :]
+        lse = l_ref[0, 0, :, :]  # [BLOCK_Q, 1]
+        dsum = dsum_ref[0, 0, :, :]
+        scores = jax.lax.dot_general(
+            q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [BLOCK_Q, BLOCK_K]
+        if causal:
+            q_pos = qi * BLOCK_Q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            k_pos = kb * BLOCK_K + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        p = jnp.exp(scores - lse)
+        # dV += Pᵀ · dO
+        dv_acc[...] += jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dsum) * scale
+        # dK += dSᵀ · Q
+        dk_acc[...] += jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk,
+            dimension_numbers=(((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
 
-    d = k_blk.shape[-1]
-    init = (jnp.zeros((BLOCK_K, d), jnp.float32), jnp.zeros((BLOCK_K, d), jnp.float32))
-    dk, dv = jax.lax.fori_loop(0, group, head_body, init)
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    @pl.when(jnp.logical_and(gi == group - 1, qi == n_q_blocks - 1))
+    def _flush():
+        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
 
 
 def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
@@ -279,26 +285,43 @@ def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dsum)
 
+    # grid minor axes (gi, qi) stream BLOCK_Q tiles of this kv head's group
+    # through VMEM; dk/dv accumulate in f32 scratch across them.  Under
+    # causal masking, q blocks above the diagonal are dead — clamp their
+    # index maps to the first live block so pallas's revisit optimization
+    # skips the DMA (the kernel's pl.when already skips the compute).
+    if causal:
+        def _q_index(bi, h, kb, gi, qi):
+            return (bi, h * group + gi, jnp.maximum(qi, kb * BLOCK_K // BLOCK_Q), 0)
+    else:
+        def _q_index(bi, h, kb, gi, qi):
+            return (bi, h * group + gi, qi, 0)
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal, s_q=s, group=group),
+        functools.partial(
+            _bwd_dkv_kernel, scale=scale, causal=causal,
+            n_q_blocks=s // BLOCK_Q, group=group,
+        ),
         out_shape=(
             jax.ShapeDtypeStruct((b, hkv, s_k, d), k.dtype),
             jax.ShapeDtypeStruct((b, hkv, s_k, d), v.dtype),
         ),
-        grid=(b, hkv, s_k // BLOCK_K),
+        grid=(b, hkv, s_k // BLOCK_K, group, s // BLOCK_Q),
         in_specs=[
-            # per program: ALL q/do/lse/dsum rows of this kv head's group
-            pl.BlockSpec((1, group, s, d), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, group, s, d), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, group, s, 1), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, group, s, 1), lambda bi, h, kb: (bi, h, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), _q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb, gi, qi: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb, gi, qi: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), _q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), _q_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), _q_index, memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb, gi, qi: (bi, h, kb, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda bi, h, kb, gi, qi: (bi, h, kb, 0), memory_space=pltpu.VMEM),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((BLOCK_K, d), jnp.float32),
+            pltpu.VMEM((BLOCK_K, d), jnp.float32),
+        ],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dsum)
 

@@ -212,7 +212,28 @@ def classify_event(
 ) -> Optional[RunStatusAnalysisResult]:
     """The reference's onEvent switch (services/supervisor.go:159-258),
     with a TPU-signature pass layered in front of the generic mapping for
-    failure-ish events.  Returns None for drops/no-ops."""
+    failure-ish events.  Returns None for drops/no-ops.
+
+    Results with an empty ``request_id`` are dropped: a run-labeled pod
+    missing its ``batch.kubernetes.io/job-name`` backlink would otherwise
+    flow downstream and turn the missing-checkpoint delete into a
+    collection-URL DELETE.  An empty ``algorithm_name`` alone still flows —
+    the checkpoint read misses and the orphaned Job is deleted by name,
+    matching the reference's missing-checkpoint path
+    (services/supervisor.go:265-273).
+    """
+    result = _classify_event(event, namespace, informers, detected_at)
+    if result is not None and not result.request_id:
+        return None
+    return result
+
+
+def _classify_event(
+    event: EventObj,
+    namespace: str,
+    informers: Dict[str, Informer],
+    detected_at: float,
+) -> Optional[RunStatusAnalysisResult]:
     ref = event.involved_object
     obj_ns = ref.namespace or event.meta.namespace
 

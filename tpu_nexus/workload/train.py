@@ -139,27 +139,32 @@ def init_train_state(
 
 def state_shardings(init_fn, key, model_cfg, mesh, rules) -> Any:
     """Sharding pytree for the train state: params follow llama_axes; the
-    optimizer state's param-shaped leaves (adam mu/nu) follow their param."""
+    optimizer state's param-tree-structured subtrees (adam mu/nu) mirror the
+    param shardings BY TREE STRUCTURE — matching by array shape would
+    silently hand two same-shaped params with different logical axes the
+    same (last-seen) sharding."""
     axes = llama_axes(model_cfg)
     param_shardings = sharding_tree(axes, mesh, rules)
     state_shape = jax.eval_shape(init_fn, key)
-
-    flat_params, _ = jax.tree.flatten(state_shape["params"])
-    flat_shardings = jax.tree.leaves(
-        param_shardings, is_leaf=lambda x: isinstance(x, NamedSharding)
-    )
-    by_shape: Dict[Tuple, NamedSharding] = {}
-    for leaf, sh in zip(flat_params, flat_shardings):
-        by_shape[leaf.shape] = sh
-
     replicated = NamedSharding(mesh, P())
+    params_structure = jax.tree.structure(state_shape["params"])
 
-    def opt_leaf_sharding(leaf):
-        return by_shape.get(getattr(leaf, "shape", None), replicated)
+    def is_param_tree(subtree) -> bool:
+        try:
+            return jax.tree.structure(subtree) == params_structure
+        except Exception:  # unhashable/exotic nodes: not a param mirror
+            return False
+
+    def subtree_sharding(subtree):
+        # param-mirroring subtree (mu/nu) -> the full param sharding tree;
+        # anything else (step counts, schedule state scalars) -> replicated
+        return param_shardings if is_param_tree(subtree) else replicated
 
     return {
         "params": param_shardings,
-        "opt_state": jax.tree.map(opt_leaf_sharding, state_shape["opt_state"]),
+        "opt_state": jax.tree.map(
+            subtree_sharding, state_shape["opt_state"], is_leaf=is_param_tree
+        ),
         "step": replicated,
     }
 
