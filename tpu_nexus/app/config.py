@@ -58,3 +58,7 @@ class SupervisorConfig:
     failure_lane_workers: int = 4
     watch_jobsets: bool = True
     statsd_address: str = ""
+    #: hung-run watchdog: flag RUNNING rows with a frozen ledger progress
+    #: fingerprint after this window (0 disables)
+    heartbeat_stale_after: timedelta = timedelta(0)
+    watchdog_interval: timedelta = timedelta(seconds=30)

@@ -154,6 +154,8 @@ class ApplicationServices:
             workers=config.workers,
             failure_lane_rate_per_second=config.failure_lane_rate_per_second,
             failure_lane_workers=config.failure_lane_workers,
+            heartbeat_stale_after=config.heartbeat_stale_after,
+            watchdog_interval=config.watchdog_interval,
         )
         try:
             self._supervisor.init(processing)

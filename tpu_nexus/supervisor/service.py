@@ -68,6 +68,14 @@ class ProcessingConfig:
     #: limit) so detection latency is not rate-limiter-bound
     failure_lane_rate_per_second: float = 0.0
     failure_lane_workers: int = 4
+    #: TPU extension: flag RUNNING rows whose ledger progress fingerprint
+    #: (per_chip_steps / last_modified) stalls past this window as hung
+    #: (ToFailStuckInRunning).  None/0 disables the watchdog.
+    heartbeat_stale_after: Optional[timedelta] = None
+    watchdog_interval: timedelta = timedelta(seconds=30)
+    #: leash for runs that have never heartbeated (long first XLA compile);
+    #: None = 3x the stale window
+    watchdog_first_progress_grace: Optional[timedelta] = None
 
 
 class Supervisor:
@@ -104,6 +112,7 @@ class Supervisor:
             self._factory.informer_for(kind)
         self._actor: Optional[PipelineStageActor] = None
         self._fail_actor: Optional[PipelineStageActor] = None
+        self.watchdog = None  # built in init() when the stale window is set
         # per-run serialization: a 16-host event storm produces N concurrent
         # decisions for one run; first-writer-wins requires the guard-read and
         # the commit to be atomic per (algorithm, id) (SURVEY §7.4)
@@ -145,6 +154,27 @@ class Supervisor:
         # handler on the Event informer only; pods/jobs/jobsets informers are
         # lookup caches (reference services/supervisor.go:124-128)
         self._factory.informer_for("Event").add_event_handler(self._on_event)
+        if config.heartbeat_stale_after and config.heartbeat_stale_after.total_seconds() > 0:
+            from tpu_nexus.supervisor.watchdog import HeartbeatWatchdog
+
+            self.watchdog = HeartbeatWatchdog(
+                self._store,
+                enqueue=self._fail_actor.receive,
+                stale_after=config.heartbeat_stale_after,
+                interval=config.watchdog_interval,
+                first_progress_grace=config.watchdog_first_progress_grace,
+                kind_resolver=self._resolve_run_kind,
+                logger=self._log,
+                metrics=self._metrics,
+            )
+
+    def _resolve_run_kind(self, request_id: str) -> str:
+        """JobSet when the run's resource is a cached JobSet, else Job —
+        decides which resource a watchdog-initiated delete targets."""
+        jobsets = self._factory.informers.get("JobSet")
+        if jobsets is not None and jobsets.get(request_id) is not None:
+            return "JobSet"
+        return "Job"
 
     # -- hot loop (reference onEvent, services/supervisor.go:137-258) --------
 
@@ -343,6 +373,9 @@ class Supervisor:
             self._log.info("supervisor started", namespace=self.namespace)
 
         fail_task = asyncio.create_task(self._fail_actor.start(ctx))
+        watchdog_task = (
+            asyncio.create_task(self.watchdog.run(ctx)) if self.watchdog is not None else None
+        )
         try:
             await self._actor.start(ctx, post_start)
         finally:
@@ -351,6 +384,8 @@ class Supervisor:
             # informers unwind instead of deadlocking on ctx.wait()
             ctx.cancel()
             await fail_task
+            if watchdog_task is not None:
+                await watchdog_task
             await self._factory.shutdown()
 
     # -- test support ---------------------------------------------------------

@@ -42,6 +42,9 @@ class DecisionAction:
     TO_FAIL_HBM_OOM = "ToFailHbmOom"
     TO_FAIL_ICI_LINK_DOWN = "ToFailIciLinkDown"
     TO_PREEMPT_RESTARTABLE = "ToPreemptRestartable"
+    #: emitted by the heartbeat watchdog, not event classification: a RUNNING
+    #: run whose ledger progress fingerprint stalled past the stale window
+    TO_FAIL_STUCK_IN_RUNNING = "ToFailStuckInRunning"
 
 
 #: decision -> resulting lifecycle stage (SURVEY §2.2 classification table +
@@ -55,6 +58,7 @@ DECISION_STAGE: Dict[str, str] = {
     DecisionAction.TO_FAIL_HBM_OOM: LifecycleStage.FAILED,
     DecisionAction.TO_FAIL_ICI_LINK_DOWN: LifecycleStage.FAILED,
     DecisionAction.TO_PREEMPT_RESTARTABLE: LifecycleStage.PREEMPTED,
+    DecisionAction.TO_FAIL_STUCK_IN_RUNNING: LifecycleStage.FAILED,
 }
 
 #: decisions that delete the k8s Job (all reference fail paths delete with
@@ -67,6 +71,7 @@ DELETES_JOB = frozenset(
         DecisionAction.TO_FAIL_COMPILE_ABORT,
         DecisionAction.TO_FAIL_HBM_OOM,
         DecisionAction.TO_FAIL_ICI_LINK_DOWN,
+        DecisionAction.TO_FAIL_STUCK_IN_RUNNING,
     }
 )
 
@@ -81,6 +86,9 @@ MSG_COMPILE_ABORT = "Algorithm failed to compile for TPU (XLA compile abort) - r
 MSG_HBM_OOM = "Algorithm exhausted TPU HBM memory - reduce batch/model size or increase sharding."
 MSG_ICI_LINK_DOWN = "TPU interconnect (ICI) link failure - the slice is unhealthy; run cannot continue."
 MSG_PREEMPTED = "TPU slice was preempted - run will restart from its last tensor checkpoint."
+MSG_STUCK_IN_RUNNING = (
+    "Algorithm stopped reporting progress (heartbeat stale) - the run appears hung and was terminated."
+)
 
 
 @dataclass

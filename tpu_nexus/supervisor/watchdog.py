@@ -1,0 +1,149 @@
+"""Heartbeat watchdog: detects runs that are RUNNING but no longer alive.
+
+The taxonomy (tpu_nexus.supervisor.taxonomy) covers every failure class that
+*emits a k8s event* — but a hung workload (deadlocked collective, stuck data
+loader, the ``hang`` fault mode in tpu_nexus.workload.faults) emits nothing:
+its pod stays Running and its ledger row stays RUNNING forever.  The
+reference has no analogue (its nearest is stuck-in-pending,
+services/supervisor.go:172-182); the TPU-native ledger makes the detector
+cheap: workloads heartbeat ``per_chip_steps`` (and column writes bump
+``last_modified``), so a RUNNING row whose progress fingerprint is frozen
+beyond a window is hung.
+
+Staleness is judged by *fingerprint change observed by this process*
+(monotonic clock), not by comparing wall-clock columns — workload hosts and
+the supervisor need not share a clock, and ``merge_chip_steps`` deliberately
+does not touch ``last_modified``.
+
+A stale run becomes a ``ToFailStuckInRunning`` decision on the supervisor's
+failure lane and flows through the exact same commit path as every other
+decision (stage partial order, job delete, trace, latency metric).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass
+from datetime import timedelta
+from typing import Callable, Dict, Optional, Tuple
+
+from tpu_nexus.checkpoint.models import LifecycleStage
+from tpu_nexus.checkpoint.store import CheckpointStore
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.core.telemetry import Metrics, NullMetrics, VLogger, get_logger
+from tpu_nexus.supervisor.taxonomy import (
+    DecisionAction,
+    MSG_STUCK_IN_RUNNING,
+    RunStatusAnalysisResult,
+)
+
+
+@dataclass
+class _Observation:
+    fingerprint: Tuple
+    since: float  # monotonic timestamp when this fingerprint was first seen
+
+
+class HeartbeatWatchdog:
+    """Periodic sweep over RUNNING ledger rows; emits stuck-in-running
+    decisions for rows whose progress fingerprint stalls past the window."""
+
+    def __init__(
+        self,
+        store: CheckpointStore,
+        enqueue: Callable[[RunStatusAnalysisResult], None],
+        stale_after: timedelta,
+        interval: timedelta = timedelta(seconds=30),
+        first_progress_grace: Optional[timedelta] = None,
+        kind_resolver: Optional[Callable[[str], str]] = None,
+        logger: Optional[VLogger] = None,
+        metrics: Optional[Metrics] = None,
+    ) -> None:
+        if stale_after.total_seconds() <= 0:
+            raise ValueError("stale_after must be positive (omit the watchdog to disable)")
+        if interval.total_seconds() <= 0:
+            raise ValueError("watchdog interval must be positive")
+        self._store = store
+        self._enqueue = enqueue
+        self._stale_after = stale_after.total_seconds()
+        # a run that has never heartbeated may legitimately sit in RUNNING
+        # through a long first XLA compile — give it a longer leash before
+        # calling it hung (default 3x the steady-state window)
+        self._first_progress_grace = (
+            first_progress_grace.total_seconds()
+            if first_progress_grace is not None
+            else 3 * self._stale_after
+        )
+        self._interval = interval.total_seconds()
+        self._kind_resolver = kind_resolver or (lambda request_id: "Job")
+        self._log = logger or get_logger("tpu_nexus.watchdog")
+        self._metrics = metrics or NullMetrics()
+        self._observations: Dict[Tuple[str, str], _Observation] = {}
+        self.flagged = 0  # observability counter (tests + metrics)
+
+    @staticmethod
+    def _fingerprint(cp) -> Tuple:
+        steps = tuple(sorted(cp.per_chip_steps.items()))
+        return (steps, cp.last_modified, cp.tensor_checkpoint_uri)
+
+    async def sweep(self, now: Optional[float] = None) -> None:
+        """One pass; test-callable without the loop."""
+        now = time.monotonic() if now is None else now
+        rows = await asyncio.to_thread(self._store.query_by_stage, LifecycleStage.RUNNING)
+        live_keys = set()
+        for cp in rows:
+            key = (cp.algorithm, cp.id)
+            live_keys.add(key)
+            fp = self._fingerprint(cp)
+            obs = self._observations.get(key)
+            if obs is None or obs.fingerprint != fp:
+                self._observations[key] = _Observation(fingerprint=fp, since=now)
+                continue
+            stalled_for = now - obs.since
+            window = self._stale_after if cp.per_chip_steps else self._first_progress_grace
+            if stalled_for < window:
+                continue
+            self._log.info(
+                "run heartbeat stale; flagging stuck-in-running",
+                algorithm=cp.algorithm,
+                request_id=cp.id,
+                stalled_seconds=round(stalled_for, 1),
+            )
+            self._metrics.count("watchdog_stale_runs")
+            self.flagged += 1
+            self._enqueue(
+                RunStatusAnalysisResult(
+                    action=DecisionAction.TO_FAIL_STUCK_IN_RUNNING,
+                    algorithm_name=cp.algorithm,
+                    request_id=cp.id,
+                    run_status_message=MSG_STUCK_IN_RUNNING,
+                    run_status_trace=(
+                        f"no ledger progress for {stalled_for:.1f}s "
+                        f"(window {window:.1f}s); "
+                        f"per_chip_steps={dict(cp.per_chip_steps)!r}"
+                    ),
+                    object_kind=self._kind_resolver(cp.id),
+                    object_name=cp.id,
+                    detected_at=time.perf_counter(),
+                )
+            )
+            # the decision owns the run now; if its commit fails the actor
+            # retries — re-observing from scratch would double-flag
+            del self._observations[key]
+        # forget rows that left RUNNING (completed/failed/cancelled)
+        for key in list(self._observations):
+            if key not in live_keys:
+                del self._observations[key]
+
+    async def run(self, ctx: LifecycleContext) -> None:
+        """Sweep every interval until the lifecycle context cancels."""
+        while not ctx.cancelled:
+            try:
+                await self.sweep()
+            except Exception:  # noqa: BLE001 - the watchdog must outlive hiccups
+                self._log.exception("watchdog sweep failed; will retry")
+            try:
+                await asyncio.wait_for(ctx.wait(), timeout=self._interval)
+            except asyncio.TimeoutError:
+                continue
