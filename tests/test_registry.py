@@ -1,0 +1,161 @@
+"""Model registry: every zoo model runs the same harness/train-step/ledger
+contract (VERDICT r1 missing #5 — BASELINE config #3: MNIST demo workload,
+classify an injected XLA compile abort)."""
+
+import asyncio
+import uuid
+from datetime import timedelta
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.models import (
+    LlamaAdapter,
+    LlamaConfig,
+    MnistAdapter,
+    MnistConfig,
+    adapter_for,
+    get_adapter,
+)
+from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+
+class TestRegistry:
+    def test_adapter_dispatch(self):
+        assert isinstance(adapter_for(LlamaConfig.tiny()), LlamaAdapter)
+        assert isinstance(adapter_for(MnistConfig()), MnistAdapter)
+        adapter = MnistAdapter()
+        assert adapter_for(adapter) is adapter
+        with pytest.raises(TypeError):
+            adapter_for(object())
+
+    def test_preset_lookup(self):
+        assert isinstance(get_adapter("mnist"), MnistAdapter)
+        assert get_adapter("tiny").config == LlamaConfig.tiny()
+        assert get_adapter("nexus_1b").config == LlamaConfig.nexus_1b()
+        with pytest.raises(KeyError, match="known"):
+            get_adapter("nope")
+
+    def test_from_env_selects_mnist(self):
+        cfg = WorkloadConfig.from_env({"NEXUS_MODEL_PRESET": "mnist", "NEXUS_STEPS": "5"})
+        assert isinstance(cfg.model, MnistAdapter)
+
+
+class TestMnistTrainStep:
+    def test_loss_decreases_and_accuracy_rises_sharded(self):
+        adapter = MnistAdapter()
+        tcfg = TrainConfig(warmup_steps=2, total_steps=100, learning_rate=3e-3)
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        state = init_train_state(jax.random.PRNGKey(0), adapter, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        step_fn = make_train_step(adapter, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        data = adapter.data(32, 0, seed=0)
+        losses, accs = [], []
+        with mesh:
+            for _ in range(30):
+                batch = jax.tree.map(jnp.asarray, next(data))
+                state, m = step_fn(state, batch)
+                losses.append(float(m["loss"]))
+                accs.append(float(m["accuracy"]))
+        assert losses[-1] < losses[0] - 0.5, losses[:3] + losses[-3:]
+        assert accs[-1] > 0.8, accs[-5:]
+
+    def test_mnist_params_sharded(self):
+        adapter = MnistAdapter()
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        state = init_train_state(
+            jax.random.PRNGKey(0), adapter, TrainConfig(), mesh, LOGICAL_RULES_FSDP_TP
+        )
+        w = state["params"]["hidden"]["w"]  # [L, hidden(embed->fsdp), hidden(mlp->tp)]
+        shard = w.addressable_shards[0].data
+        assert shard.shape[1] == w.shape[1] // 4
+        assert shard.shape[2] == w.shape[2] // 2
+
+
+class TestMnistThroughHarness:
+    """BASELINE config #3 end to end: the MNIST demo runs the full harness
+    (ledger RUNNING/heartbeat/COMPLETED), and an injected XLA compile abort
+    surfaces with a classifiable message + trace ref."""
+
+    def _config(self, **over):
+        base = dict(
+            model=MnistAdapter(),
+            train=TrainConfig(warmup_steps=2, total_steps=50, learning_rate=1e-3),
+            mesh=MeshSpec(fsdp=-1),
+            batch_size=16,
+            seq_len=0,
+            steps=8,
+            heartbeat_every=2,
+        )
+        base.update(over)
+        return WorkloadConfig(**base)
+
+    def test_clean_run_completes_with_heartbeats(self):
+        rid = str(uuid.uuid4())
+        store = InMemoryCheckpointStore()
+        store.upsert_checkpoint(
+            CheckpointedRequest(algorithm="mnist-train", id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+        )
+        ctx = ProcessContext(run_id=rid, algorithm="mnist-train", process_id=0, num_processes=1, coordinator=None)
+        summary = run_workload(self._config(), store=store, ctx=ctx)
+        assert summary["final_step"] == 8
+        assert summary["accuracy"] >= 0.0
+        cp = store.read_checkpoint("mnist-train", rid)
+        assert cp.lifecycle_stage == LifecycleStage.COMPLETED
+        assert cp.per_chip_steps  # heartbeats landed
+
+    def test_injected_xla_abort_classified(self, monkeypatch):
+        from tpu_nexus.supervisor.taxonomy import DecisionAction, classify_tpu_failure
+        from tpu_nexus.workload.faults import ENV_FAULT_MODE, ENV_FAULT_STEP
+
+        monkeypatch.setenv(ENV_FAULT_MODE, "xla-abort")
+        monkeypatch.setenv(ENV_FAULT_STEP, "3")
+        rid = str(uuid.uuid4())
+        store = InMemoryCheckpointStore()
+        store.upsert_checkpoint(
+            CheckpointedRequest(algorithm="mnist-train", id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+        )
+        ctx = ProcessContext(run_id=rid, algorithm="mnist-train", process_id=0, num_processes=1, coordinator=None)
+        with pytest.raises(RuntimeError, match="hlo_trace") as ei:
+            run_workload(self._config(), store=store, ctx=ctx)
+        # the raised message is what lands in the pod termination text /
+        # k8s event — it must classify as a compile abort
+        assert classify_tpu_failure(str(ei.value)) == DecisionAction.TO_FAIL_COMPILE_ABORT
+        cp = store.read_checkpoint("mnist-train", rid)
+        assert cp.hlo_trace_ref.startswith("file://")
+
+
+async def test_mnist_xla_abort_supervised_to_failed():
+    """Full loop for config #3: the MNIST workload dies with the compile
+    abort, its message becomes a pod Failed event, and the supervisor lands
+    FAILED + compile-abort cause in the ledger."""
+    from tests.test_supervisor import (
+        ALGORITHM,
+        Fixture,
+        event_obj,
+        job_obj,
+        pod_obj,
+        seed_checkpoint,
+    )
+    from tpu_nexus.supervisor.taxonomy import MSG_COMPILE_ABORT
+    from tpu_nexus.workload.faults import MSG_XLA_ABORT
+
+    rid = str(uuid.uuid4())
+    pod = pod_obj(rid)
+    objects = {
+        "Job": [job_obj(rid)],
+        "Pod": [pod],
+        "Event": [event_obj("Failed", MSG_XLA_ABORT, "Pod", pod["metadata"]["name"])],
+    }
+    fx = Fixture(objects)
+    seed_checkpoint(fx.store, rid, LifecycleStage.RUNNING)
+    await fx.run_until_idle()
+    cp = fx.store.read_checkpoint(ALGORITHM, rid)
+    assert cp.lifecycle_stage == LifecycleStage.FAILED
+    assert cp.algorithm_failure_cause == MSG_COMPILE_ABORT
+    assert rid in fx.client.deleted("Job")

@@ -9,6 +9,13 @@ jax.distributed pretrain); MNIST covers the small single-slice demo
 
 from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_forward, llama_init
 from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
+from tpu_nexus.models.registry import (
+    LlamaAdapter,
+    MnistAdapter,
+    ModelAdapter,
+    adapter_for,
+    get_adapter,
+)
 
 __all__ = [
     "LlamaConfig",
@@ -19,4 +26,9 @@ __all__ = [
     "mnist_axes",
     "mnist_forward",
     "mnist_init",
+    "ModelAdapter",
+    "LlamaAdapter",
+    "MnistAdapter",
+    "adapter_for",
+    "get_adapter",
 ]

@@ -1,0 +1,174 @@
+"""Model registry: one adapter contract for every zoo model.
+
+The reference supervises *opaque* algorithm containers — any workload that
+carries the run labels (SURVEY.md §2.2).  The TPU-native framework keeps that
+property at the harness level: the training loop, ledger protocol, fault
+injection, checkpointing, and launcher contract are model-agnostic, and each
+model plugs in through a :class:`ModelAdapter` (init / logical axes / loss /
+data / batch layout).  ``NEXUS_MODEL_PRESET`` selects an adapter by name
+(the launcher env contract), so the MNIST demo workload (BASELINE config #3)
+and the Llama flagship run through the exact same code path.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_head, llama_hidden, llama_init
+from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
+
+
+class ModelAdapter:
+    """Contract the harness/train-step consume.  A batch is an arbitrary
+    pytree of arrays; every method below must agree on its structure."""
+
+    name: str = ""
+    config: Any = None
+
+    def init(self, key: jax.Array) -> Any:
+        """Model params pytree."""
+        raise NotImplementedError
+
+    def axes(self) -> Any:
+        """Logical-axis pytree mirroring the params structure."""
+        raise NotImplementedError
+
+    def batch_axes(self) -> Any:
+        """Logical-axis pytree mirroring one batch."""
+        raise NotImplementedError
+
+    def make_loss(self, train_cfg: Any, mesh: Any) -> Callable[[Any, Any], Tuple[jax.Array, Dict]]:
+        """(params, batch) -> (scalar loss, metrics dict), jit-traceable."""
+        raise NotImplementedError
+
+    def data(self, batch: int, seq_len: int, seed: int) -> Iterator[Any]:
+        """Infinite iterator of process-local batch pytrees (numpy)."""
+        raise NotImplementedError
+
+    def items_in(self, batch: Any) -> int:
+        """Throughput denominator: tokens (LM) or examples (classifier)."""
+        raise NotImplementedError
+
+
+@dataclass(frozen=True)
+class LlamaAdapter(ModelAdapter):
+    """Flagship decoder family.  Batches are int32 token arrays [B, S]."""
+
+    config: LlamaConfig = field(default_factory=LlamaConfig.tiny)
+    name: str = "llama"
+
+    def init(self, key):
+        return llama_init(key, self.config)
+
+    def axes(self):
+        return llama_axes(self.config)
+
+    def batch_axes(self):
+        return ("batch", "seq")
+
+    def make_loss(self, train_cfg, mesh):
+        import functools
+
+        from tpu_nexus.parallel.ring import ring_attention_sharded
+        from tpu_nexus.workload.train import chunked_next_token_loss
+
+        # ring attention rides in when the mesh shards the sequence
+        attn_fn = None
+        if mesh is not None and mesh.shape.get("sp", 1) > 1:
+            head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+            ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
+
+            def attn_fn(q, k, v, causal=True):  # noqa: F811
+                return ring(q, k, v, causal=causal)
+
+        cfg = self.config
+        z_loss = getattr(train_cfg, "z_loss", 0.0)
+
+        def loss_fn(params, tokens):
+            hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
+            head = llama_head(params, cfg)
+            return chunked_next_token_loss(hidden, head, tokens, z_loss)
+
+        return loss_fn
+
+    def data(self, batch, seq_len, seed):
+        from tpu_nexus.workload.data import synthetic_tokens
+
+        return synthetic_tokens(batch, seq_len, self.config.vocab_size, seed=seed)
+
+    def items_in(self, batch):
+        return int(np.prod(batch.shape))
+
+
+@dataclass(frozen=True)
+class MnistAdapter(ModelAdapter):
+    """Small demo classifier (BASELINE config #3).  Batches are
+    ``{"x": [B, 784] f32, "y": [B] i32}`` dicts."""
+
+    config: MnistConfig = field(default_factory=MnistConfig)
+    name: str = "mnist"
+
+    def init(self, key):
+        return mnist_init(key, self.config)
+
+    def axes(self):
+        return mnist_axes(self.config)
+
+    def batch_axes(self):
+        return {"x": ("batch", None), "y": ("batch",)}
+
+    def make_loss(self, train_cfg, mesh):
+        cfg = self.config
+
+        def loss_fn(params, batch):
+            logits = mnist_forward(params, batch["x"], cfg).astype(jnp.float32)
+            labels = batch["y"]
+            logz = jax.nn.logsumexp(logits, axis=-1)
+            true_logit = jnp.take_along_axis(logits, labels[:, None], axis=-1)[:, 0]
+            loss = jnp.mean(logz - true_logit)
+            accuracy = jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+            return loss, {"ce_loss": loss, "accuracy": accuracy}
+
+        return loss_fn
+
+    def data(self, batch, seq_len, seed):
+        from tpu_nexus.workload.data import synthetic_mnist
+
+        def gen():
+            for images, labels in synthetic_mnist(batch, seed=seed):
+                yield {"x": images, "y": labels}
+
+        return gen()
+
+    def items_in(self, batch):
+        return int(batch["y"].shape[0])
+
+
+def adapter_for(model_config: Any) -> ModelAdapter:
+    """Dispatch a model config object to its adapter."""
+    if isinstance(model_config, ModelAdapter):
+        return model_config
+    if isinstance(model_config, LlamaConfig):
+        return LlamaAdapter(config=model_config)
+    if isinstance(model_config, MnistConfig):
+        return MnistAdapter(config=model_config)
+    raise TypeError(f"no adapter for model config {type(model_config).__name__}")
+
+
+def get_adapter(preset: str) -> ModelAdapter:
+    """Resolve a preset name from the launcher env contract
+    (``NEXUS_MODEL_PRESET``): ``mnist`` or any LlamaConfig preset."""
+    if preset == "mnist":
+        return MnistAdapter()
+    factory = getattr(LlamaConfig, preset, None)
+    if factory is None:
+        known = ["mnist"] + [
+            n for n in vars(LlamaConfig) if isinstance(vars(LlamaConfig)[n], staticmethod)
+        ]
+        raise KeyError(f"unknown model preset {preset!r}; known: {sorted(known)}")
+    return LlamaAdapter(config=factory())

@@ -30,15 +30,15 @@ import numpy as np
 from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
 from tpu_nexus.checkpoint.store import CheckpointStore
 from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.registry import adapter_for, get_adapter
 from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
 from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
 from tpu_nexus.parallel.sharding import RuleTable
-from tpu_nexus.workload.data import synthetic_tokens
 from tpu_nexus.workload.faults import FaultPlan, maybe_inject
 from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
 from tpu_nexus.workload.train import (
     TrainConfig,
-    batch_sharding,
+    batch_shardings,
     init_train_state,
     make_train_step,
 )
@@ -48,7 +48,9 @@ logger = logging.getLogger(__name__)
 
 @dataclass(frozen=True)
 class WorkloadConfig:
-    model: LlamaConfig = field(default_factory=LlamaConfig.tiny)
+    #: a model config (LlamaConfig, MnistConfig) or a ModelAdapter — resolved
+    #: through the model registry, so any zoo model runs this harness
+    model: Any = field(default_factory=LlamaConfig.tiny)
     train: TrainConfig = field(default_factory=TrainConfig)
     mesh: MeshSpec = field(default_factory=MeshSpec)
     rules: RuleTable = field(default_factory=lambda: dict(LOGICAL_RULES_FSDP_TP))
@@ -70,7 +72,7 @@ class WorkloadConfig:
         e = os.environ if env is None else env
         steps = int(e.get("NEXUS_STEPS", "100"))
         return WorkloadConfig(
-            model=getattr(LlamaConfig, e.get("NEXUS_MODEL_PRESET", "tiny"))(),
+            model=get_adapter(e.get("NEXUS_MODEL_PRESET", "tiny")),
             train=TrainConfig(
                 warmup_steps=int(e.get("NEXUS_WARMUP_STEPS", "10")),
                 total_steps=max(steps, 2),
@@ -185,11 +187,15 @@ def run_workload(
     ctx = initialize_distributed(ctx)
     reporter = LedgerReporter(store, ctx)
     plan = FaultPlan.from_env()
+    adapter = adapter_for(cfg.model)
     mesh = build_mesh(cfg.mesh)
-    logger.info("workload %s/%s: mesh %s", ctx.algorithm, ctx.run_id, dict(mesh.shape))
+    logger.info(
+        "workload %s/%s: model %s, mesh %s",
+        ctx.algorithm, ctx.run_id, adapter.name, dict(mesh.shape),
+    )
 
     key = jax.random.PRNGKey(cfg.seed)
-    state = init_train_state(key, cfg.model, cfg.train, mesh, cfg.rules)
+    state = init_train_state(key, adapter, cfg.train, mesh, cfg.rules)
     ckpt: Optional[TensorCheckpointer] = None
     start_step = 0
     if cfg.checkpoint_every and cfg.checkpoint_dir:
@@ -200,27 +206,29 @@ def run_workload(
             start_step = latest
             logger.info("restored tensor checkpoint at step %d", latest)
 
-    step_fn = make_train_step(cfg.model, cfg.train, mesh, cfg.rules)
+    step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules)
     # cfg.batch_size is GLOBAL; each process generates its own shard of the
     # batch (disjoint seeds) and multi-process runs assemble the global array
     # from process-local data
     if cfg.batch_size % ctx.num_processes:
         raise ValueError(f"batch {cfg.batch_size} not divisible by {ctx.num_processes} processes")
     local_batch = cfg.batch_size // ctx.num_processes
-    data = data or synthetic_tokens(
-        local_batch, cfg.seq_len, cfg.model.vocab_size, seed=cfg.seed + ctx.process_id
-    )
+    data = data or adapter.data(local_batch, cfg.seq_len, seed=cfg.seed + ctx.process_id)
     # restart-from-step must also restart-from-*data*: fast-forward the
     # stream so resumed steps see the batches they would have seen, not a
     # replay of batch 0..N (which silently corrupts the training trajectory)
     for _ in range(start_step):
         next(data)
-    tokens_sharding = batch_sharding(mesh, cfg.rules)
+    shardings = batch_shardings(adapter, mesh, cfg.rules)
 
     def to_global(raw):
         if ctx.num_processes > 1:
-            return jax.make_array_from_process_local_data(tokens_sharding, np.asarray(raw))
-        return jax.numpy.asarray(raw)
+            return jax.tree.map(
+                lambda sh, leaf: jax.make_array_from_process_local_data(sh, np.asarray(leaf)),
+                shardings,
+                raw,
+            )
+        return jax.tree.map(jax.numpy.asarray, raw)
 
     reporter.running()
     metrics: Dict[str, Any] = {}
@@ -233,7 +241,7 @@ def run_workload(
                 maybe_inject(plan, step)
                 batch = to_global(next(data))
                 state, m = step_fn(state, batch)
-                tokens_done += batch.size
+                tokens_done += adapter.items_in(batch)
                 if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
                     # pull metrics (device sync) only on heartbeat steps
                     metrics = {k: float(v) for k, v in m.items()}

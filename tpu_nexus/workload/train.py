@@ -6,12 +6,16 @@ tpu_nexus.parallel.sharding), the batch is sharded over (dp, fsdp) × sp, and
 every collective (gradient psum over dp/fsdp, tp partial-sum reductions,
 ring-attention ppermute over sp) is inserted by XLA/GSPMD from the sharding
 annotations — no hand-written communication in the training step.
+
+Model-agnostic: every entry point takes a model config OR a
+:class:`tpu_nexus.models.registry.ModelAdapter`; the adapter supplies init /
+logical axes / loss / batch layout, so the MNIST demo and the Llama flagship
+share this exact step (harness parity, BASELINE configs #2-#5).
 """
 
 from __future__ import annotations
 
-import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -19,9 +23,6 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpu_nexus.models import LlamaConfig, llama_axes, llama_init
-from tpu_nexus.models.llama import llama_head, llama_hidden
-from tpu_nexus.parallel.ring import ring_attention_sharded
 from tpu_nexus.parallel.sharding import RuleTable, sharding_tree, spec_for
 
 
@@ -115,9 +116,17 @@ def chunked_next_token_loss(
     return loss, {"ce_loss": ce, "perplexity": jnp.exp(ce)}
 
 
+def _as_adapter(model: Any):
+    """Accept a ModelAdapter or a raw model config (LlamaConfig, MnistConfig).
+    Import is lazy: the registry imports this module's loss helpers."""
+    from tpu_nexus.models.registry import adapter_for
+
+    return adapter_for(model)
+
+
 def init_train_state(
     key: jax.Array,
-    model_cfg: LlamaConfig,
+    model: Any,
     train_cfg: TrainConfig,
     mesh: Optional[Mesh] = None,
     rules: Optional[RuleTable] = None,
@@ -125,25 +134,26 @@ def init_train_state(
     """State = {params, opt_state, step}.  With a mesh, params are *initialized
     sharded* (jit with out_shardings) so the full f32 model never materializes
     on one device — required for 8B+ params."""
+    adapter = _as_adapter(model)
     optimizer = make_optimizer(train_cfg)
 
     def init(key):
-        params = llama_init(key, model_cfg)
+        params = adapter.init(key)
         return {"params": params, "opt_state": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
 
     if mesh is None:
         return init(key)
-    shardings = state_shardings(init, key, model_cfg, mesh, rules)
+    shardings = state_shardings(init, key, adapter, mesh, rules)
     return jax.jit(init, out_shardings=shardings)(key)
 
 
-def state_shardings(init_fn, key, model_cfg, mesh, rules) -> Any:
-    """Sharding pytree for the train state: params follow llama_axes; the
-    optimizer state's param-tree-structured subtrees (adam mu/nu) mirror the
-    param shardings BY TREE STRUCTURE — matching by array shape would
-    silently hand two same-shaped params with different logical axes the
-    same (last-seen) sharding."""
-    axes = llama_axes(model_cfg)
+def state_shardings(init_fn, key, model, mesh, rules) -> Any:
+    """Sharding pytree for the train state: params follow the adapter's
+    logical axes; the optimizer state's param-tree-structured subtrees (adam
+    mu/nu) mirror the param shardings BY TREE STRUCTURE — matching by array
+    shape would silently hand two same-shaped params with different logical
+    axes the same (last-seen) sharding."""
+    axes = _as_adapter(model).axes()
     param_shardings = sharding_tree(axes, mesh, rules)
     state_shape = jax.eval_shape(init_fn, key)
     replicated = NamedSharding(mesh, P())
@@ -170,44 +180,43 @@ def state_shardings(init_fn, key, model_cfg, mesh, rules) -> Any:
 
 
 def batch_sharding(mesh: Mesh, rules: RuleTable) -> NamedSharding:
-    """Sharding of the global token batch ``[B, S]`` (batch over dp×fsdp,
-    sequence over sp) — also what multi-host data loading assembles into via
-    ``jax.make_array_from_process_local_data``."""
+    """Sharding of a global token batch ``[B, S]`` (batch over dp×fsdp,
+    sequence over sp) — the LM-batch special case of :func:`batch_shardings`."""
     return NamedSharding(mesh, spec_for(("batch", "seq"), rules))
 
 
+def batch_shardings(model: Any, mesh: Mesh, rules: RuleTable) -> Any:
+    """NamedSharding pytree mirroring the adapter's batch structure — also
+    what multi-host data loading assembles into via
+    ``jax.make_array_from_process_local_data`` (leaf by leaf)."""
+    return jax.tree.map(
+        lambda axes: NamedSharding(mesh, spec_for(axes, rules)),
+        _as_adapter(model).batch_axes(),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+
 def make_train_step(
-    model_cfg: LlamaConfig,
+    model: Any,
     train_cfg: TrainConfig,
     mesh: Mesh,
     rules: RuleTable,
-) -> Callable[[Dict[str, Any], jax.Array], Tuple[Dict[str, Any], Dict[str, jax.Array]]]:
-    """Jitted (state, tokens) -> (state, metrics); donates state buffers.
+) -> Callable[[Dict[str, Any], Any], Tuple[Dict[str, Any], Dict[str, jax.Array]]]:
+    """Jitted (state, batch) -> (state, metrics); donates state buffers.
 
-    Ring attention is injected automatically when the mesh's ``sp`` axis is
-    non-trivial; otherwise attention dispatches to the pallas flash kernel
-    (TPU) or XLA.
+    The adapter builds the loss (for Llama that includes injecting ring
+    attention when the mesh's ``sp`` axis is non-trivial; otherwise attention
+    dispatches to the pallas flash kernel on TPU or XLA).
     """
+    adapter = _as_adapter(model)
     optimizer = make_optimizer(train_cfg)
-    attn_fn = None
-    if mesh.shape.get("sp", 1) > 1:
-        head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
-        ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
+    loss_fn = adapter.make_loss(train_cfg, mesh)
+    shardings = batch_shardings(adapter, mesh, rules)
 
-        def attn_fn(q, k, v, causal=True):  # noqa: F811
-            return ring(q, k, v, causal=causal)
-
-    tokens_sharding = batch_sharding(mesh, rules)
-
-    def loss_fn(params, tokens):
-        hidden = llama_hidden(params, tokens, model_cfg, attn_fn=attn_fn)
-        head = llama_head(params, model_cfg)
-        return chunked_next_token_loss(hidden, head, tokens, train_cfg.z_loss)
-
-    def step_fn(state, tokens):
-        tokens = jax.lax.with_sharding_constraint(tokens, tokens_sharding)
+    def step_fn(state, batch):
+        batch = jax.lax.with_sharding_constraint(batch, shardings)
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state["params"], tokens
+            state["params"], batch
         )
         updates, opt_state = optimizer.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
