@@ -28,11 +28,26 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+import logging  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _restore_tpu_nexus_logger():
+    """configure_logger() sets propagate=False on the package logger; restore
+    it after every test so later tests' caplog captures aren't order-dependent."""
+    lg = logging.getLogger("tpu_nexus")
+    saved = (lg.propagate, list(lg.handlers), lg.level)
+    yield
+    lg.propagate, lg.handlers[:] = saved[0], saved[1]
+    lg.setLevel(saved[2])  # setLevel, not .level: flushes the isEnabledFor cache
+
 
 def pytest_pyfunc_call(pyfuncitem):
     func = pyfuncitem.obj
     if inspect.iscoroutinefunction(func):
         kwargs = {name: pyfuncitem.funcargs[name] for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=120))
+        asyncio.run(asyncio.wait_for(func(**kwargs), timeout=360))
         return True
     return None

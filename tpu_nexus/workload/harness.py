@@ -198,12 +198,14 @@ def run_workload(
     state = init_train_state(key, adapter, cfg.train, mesh, cfg.rules)
     ckpt: Optional[TensorCheckpointer] = None
     start_step = 0
+    resumed_from: Optional[int] = None
     if cfg.checkpoint_every and cfg.checkpoint_dir:
         ckpt = TensorCheckpointer(cfg.checkpoint_dir)
         latest = ckpt.latest_step()
         if latest is not None:
             state = ckpt.restore(state, latest)
             start_step = latest
+            resumed_from = latest
             logger.info("restored tensor checkpoint at step %d", latest)
 
     step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules)
@@ -280,6 +282,7 @@ def run_workload(
         reporter.completed()
     return {
         "final_step": final_step,
+        "resumed_from": resumed_from,
         "elapsed_s": elapsed,
         "tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
         **metrics,
