@@ -4,22 +4,75 @@ Runs the full workload harness path (sharded train step, flash-attention
 kernel, remat, heartbeats into an in-memory ledger) on the real device(s) and
 prints ONE JSON line:
 
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "mfu": F, ...}
 
 ``vs_baseline``: the reference (SneaksAndData/nexus-supervisor) publishes no
 performance numbers (BASELINE.md — its `published` map is empty), so there is
 no reference number to ratio against; by convention we report the ratio vs
 the recorded target in BASELINE.json `published` when present, else 1.0.
 
+``mfu``: model FLOPs utilization — the standard 6N-parameter + causal
+attention FLOP model (forward + 2x backward; remat recompute deliberately
+EXCLUDED, per the usual MFU convention) divided by the chip's peak bf16
+FLOP/s.  Peak is looked up from the device kind and can be overridden with
+``NEXUS_BENCH_PEAK_TFLOPS``.
+
 Model: ``LlamaConfig.nexus_1b`` — ~1B params, head_dim 128 (pallas flash
 kernel on the hot path), bf16 params+optimizer, sized for one v5e chip.
+
+Tuning knobs (all env, all optional — defaults are the tuned configuration):
+  NEXUS_BENCH_BATCH     per-chip batch size (default 16)
+  NEXUS_BENCH_SEQ       sequence length (default 2048)
+  NEXUS_BENCH_STEPS     timed steps (default 10)
+  NEXUS_BENCH_REMAT     remat policy: dots | attn_out | nothing
+  NEXUS_BENCH_PROFILE   directory: capture a jax.profiler trace of the timed
+                        window into it (artifact for perf archaeology)
 """
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import time
+
+# Chip-kind substring -> peak bf16 TFLOP/s (dense).  Public numbers:
+# v5e 197, v5p 459, v4 275, v6e (Trillium) 918.
+_PEAK_BF16_TFLOPS = (
+    ("v5 lite", 197.0),
+    ("v5e", 197.0),
+    ("v5p", 459.0),
+    ("v6", 918.0),
+    ("v4", 275.0),
+)
+
+
+def _chip_peak_tflops(device) -> float:
+    env = os.environ.get("NEXUS_BENCH_PEAK_TFLOPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, peak in _PEAK_BF16_TFLOPS:
+        if sub in kind:
+            return peak
+    return 0.0  # unknown chip: MFU reported as 0 rather than a wrong number
+
+
+def model_flops_per_token(cfg, seq: int) -> float:
+    """Training FLOPs per token: 6 x matmul params + causal attention.
+
+    Per layer/token forward: 2x(wq + wk + wv + wo + 3 mlp) matmul FLOPs;
+    attention scores QK^T + PV add 4*s*hq*d, halved by causality.  Training
+    = 3x forward (fwd + 2x backward).  Embedding lookup is a gather (no
+    FLOPs); the (tied or untied) head projection is a real matmul.
+    """
+    e, f, hq, hkv, d, l, v = (
+        cfg.hidden, cfg.intermediate, cfg.n_heads, cfg.n_kv_heads,
+        cfg.head_dim, cfg.n_layers, cfg.vocab_size,
+    )
+    matmul_params = l * (e * hq * d + 2 * e * hkv * d + hq * d * e + 3 * e * f) + e * v
+    attn = 2 * seq * hq * d * l  # causal: 4*s*hq*d / 2, per layer
+    return 3.0 * (2.0 * matmul_params + attn)
 
 
 def main() -> None:
@@ -35,12 +88,18 @@ def main() -> None:
     on_tpu = jax.default_backend() in ("tpu", "axon")
     if on_tpu:
         cfg = LlamaConfig.nexus_1b()
-        batch, seq, steps, warmup = 16 * n_chips, 2048, 10, 2
+        per_chip_batch, seq, steps, warmup = 16, 2048, 10, 2
     else:  # CPU smoke: keep it honest but small
         cfg = LlamaConfig.tiny()
-        batch, seq, steps, warmup = 1 * n_chips, 128, 10, 2
+        per_chip_batch, seq, steps, warmup = 1, 128, 10, 2
+    per_chip_batch = int(os.environ.get("NEXUS_BENCH_BATCH", per_chip_batch))
+    seq = int(os.environ.get("NEXUS_BENCH_SEQ", seq))
+    steps = int(os.environ.get("NEXUS_BENCH_STEPS", steps))
+    if os.environ.get("NEXUS_BENCH_REMAT"):
+        cfg = dataclasses.replace(cfg, remat_policy=os.environ["NEXUS_BENCH_REMAT"])
     # per-chip batch is fixed and the batch shards over dp*fsdp = all chips,
     # so the global batch divides the mesh at any chip count
+    batch = per_chip_batch * n_chips
 
     tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
     mesh = build_mesh(MeshSpec(fsdp=-1))
@@ -48,6 +107,8 @@ def main() -> None:
     state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, rules)
     step_fn = make_train_step(cfg, tcfg, mesh, rules)
     data = synthetic_tokens(batch, seq, cfg.vocab_size, seed=0)
+
+    profile_dir = os.environ.get("NEXUS_BENCH_PROFILE")
 
     # sync via float() (device->host transfer): steps chain through the
     # donated state, so pulling the final loss waits for the whole window.
@@ -57,14 +118,21 @@ def main() -> None:
         for _ in range(warmup):
             state, metrics = step_fn(state, jnp.asarray(next(data)))
         float(metrics["loss"])
+        if profile_dir:
+            jax.profiler.start_trace(profile_dir)
         t0 = time.perf_counter()
         for _ in range(steps):
             state, metrics = step_fn(state, jnp.asarray(next(data)))
         float(metrics["loss"])
         elapsed = time.perf_counter() - t0
+        if profile_dir:
+            jax.profiler.stop_trace()
 
     tokens_per_sec = batch * seq * steps / elapsed
     per_chip = tokens_per_sec / n_chips
+
+    peak = _chip_peak_tflops(jax.devices()[0]) * 1e12
+    mfu = per_chip * model_flops_per_token(cfg, seq) / peak if peak else 0.0
 
     baseline = 0.0
     try:
@@ -82,6 +150,11 @@ def main() -> None:
                 "value": round(per_chip, 1),
                 "unit": "tokens/s/chip",
                 "vs_baseline": round(vs_baseline, 3),
+                "mfu": round(mfu, 4),
+                "batch_per_chip": per_chip_batch,
+                "seq": seq,
+                "remat_policy": cfg.remat_policy,
+                "chips": n_chips,
             }
         )
     )

@@ -30,11 +30,6 @@ import jax.numpy as jnp
 from tpu_nexus.ops import attention as _ops_attention
 from tpu_nexus.ops.rmsnorm import rms_norm
 
-try:  # moved across jax versions
-    from jax.ad_checkpoint import checkpoint_name as _checkpoint_name
-except ImportError:  # pragma: no cover
-    from jax.experimental.checkpoint_name import checkpoint_name as _checkpoint_name
-
 AttnFn = Callable[..., jax.Array]
 
 
@@ -62,6 +57,10 @@ class LlamaConfig:
     #:  "nothing"   — full per-layer recompute, minimal memory.
     remat_policy: str = "dots"
     tied_embeddings: bool = False
+    #: unroll factor for the layer scan.  >1 trades HLO size (compile time)
+    #: for better scheduling — notably the backward's grad-stacking
+    #: dynamic-update-slices become static-index writes that fuse away.
+    scan_unroll: int = 1
 
     # -- presets ------------------------------------------------------------
 
@@ -92,6 +91,10 @@ class LlamaConfig:
             vocab_size=32768, hidden=2048, n_layers=14, n_heads=16, n_kv_heads=8,
             head_dim=128, intermediate=8192, tied_embeddings=True,
             param_dtype=jnp.bfloat16, max_seq_len=4096, remat_policy="attn_out",
+            # unroll=2 turns the backward's grad-stacking dynamic-update-
+            # slices into static writes: +13% tokens/s on v5e (56% vs 50%
+            # MFU); higher unrolls OOM the 16 GB HBM at batch 16
+            scan_unroll=2,
         )
 
     @staticmethod
@@ -218,8 +221,10 @@ def llama_hidden(
         v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
+        # each attention impl (flash VJP residual, dense, ring) names its own
+        # output "attn_out"; naming again here would store the buffer twice
+        # under the save_only_these_names remat policy
         o = attn_fn(q, k, v, causal=True)
-        o = _checkpoint_name(o, "attn_out")
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
@@ -231,11 +236,14 @@ def llama_hidden(
     if cfg.remat:
         policies = {
             "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out"),
+            # "attn_lse" rides along: the flash kernel's logsumexp residual
+            # ([B,H,S,1] f32, ~2 MB/layer) — saving it lets the backward
+            # replay skip re-running the flash forward kernel entirely
+            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
             "nothing": jax.checkpoint_policies.nothing_saveable,
         }
         body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, _ = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
 
     return rms_norm(x, params["out_norm"], cfg.norm_eps)
 

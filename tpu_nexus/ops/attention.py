@@ -13,6 +13,13 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+try:  # moved across jax versions; the ONE compat shim — other modules
+    # (flash_attention, ring, llama) import checkpoint_name from here
+    from jax.ad_checkpoint import checkpoint_name
+except ImportError:  # pragma: no cover
+    from jax.experimental.checkpoint_name import checkpoint_name
+
+_checkpoint_name = checkpoint_name
 _NEG_INF = -1e30
 
 
@@ -51,7 +58,10 @@ def dense_attention(
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", w, v, preferred_element_type=jnp.float32)
-    return out.reshape(b, sq, hq, d).astype(q.dtype)
+    # named so the "attn_out" remat policy saves this path's output too —
+    # each attention impl names its OWN output exactly once (naming again at
+    # the call site would double the saved buffer)
+    return _checkpoint_name(out.reshape(b, sq, hq, d).astype(q.dtype), "attn_out")
 
 
 def attention(

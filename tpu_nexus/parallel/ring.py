@@ -26,6 +26,8 @@ try:  # jax >= 0.8
 except ImportError:  # pragma: no cover - older jax
     from jax.experimental.shard_map import shard_map
 
+from tpu_nexus.ops.attention import checkpoint_name as _checkpoint_name
+
 _NEG_INF = -1e30
 
 
@@ -129,7 +131,8 @@ def ring_attention(
     state, k_last, v_last = jax.lax.fori_loop(0, n - 1, step, (init, k, v))
     acc, m, l = visit(state, k_last, v_last, n - 1)
     out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return out.astype(q.dtype)
+    # named like every attention impl: the "attn_out" remat policy saves it
+    return _checkpoint_name(out.astype(q.dtype), "attn_out")
 
 
 def ring_attention_sharded(

@@ -335,7 +335,28 @@ class Supervisor:
             latency = time.perf_counter() - result.detected_at
             self.commit_latencies.append(latency)
             self._metrics.timing("detect_to_commit_seconds", latency, tags={"action": result.action})
+        # durable export of the north-star percentile (SURVEY §6: p50 <5s):
+        # gauges every 16th decision so the number lives in the metrics plane,
+        # not only in this process's deque.  Outside the detected_at gate —
+        # watchdog/resync decisions without a detect timestamp must not
+        # swallow export slots.
+        if self.decisions_executed % 16 == 0 and self.commit_latencies:
+            summary = self.latency_summary()
+            self._metrics.gauge("detect_to_commit_p50_seconds", summary["p50"])
+            self._metrics.gauge("detect_to_commit_p95_seconds", summary["p95"])
         return result
+
+    def latency_summary(self) -> Dict[str, float]:
+        """Percentiles of the detect→commit window over the rolling deque."""
+        lat = sorted(self.commit_latencies)
+        if not lat:
+            return {"count": 0, "p50": 0.0, "p95": 0.0, "max": 0.0}
+        return {
+            "count": len(lat),
+            "p50": lat[len(lat) // 2],
+            "p95": lat[min(len(lat) - 1, int(len(lat) * 0.95))],
+            "max": lat[-1],
+        }
 
     async def _delete_run_object(self, result: RunStatusAnalysisResult) -> None:
         """Delete the run's Job or JobSet with background propagation;
