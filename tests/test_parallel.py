@@ -91,6 +91,87 @@ class TestRingAttention:
         ref = dense_attention(q, k, v, causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_grads_match_dense(self, causal):
+        """The ring's custom VJP (rotating dK/dV accumulators, O(local)
+        residuals) must produce dense-attention gradients (VERDICT r1 #8)."""
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4))
+        key = jax.random.PRNGKey(3)
+        kq, kk, kv = jax.random.split(key, 3)
+        b, s, h, d = 2, 64, 4, 8
+        q = jax.random.normal(kq, (b, s, h, d), jnp.float32)
+        k = jax.random.normal(kk, (b, s, h, d), jnp.float32)
+        v = jax.random.normal(kv, (b, s, h, d), jnp.float32)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=causal) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=causal) ** 2)
+
+        with mesh:
+            gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for name, a, b_ in zip("qkv", gr, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-3,
+                err_msg=f"d{name} mismatch (causal={causal})",
+            )
+
+    def test_gqa_grads_match_dense(self):
+        mesh = build_mesh(MeshSpec(fsdp=1, sp=4, tp=2))
+        key = jax.random.PRNGKey(4)
+        kq, kk, kv = jax.random.split(key, 3)
+        b, s, hq, hkv, d = 1, 32, 4, 2, 8
+        q = jax.random.normal(kq, (b, s, hq, d), jnp.float32)
+        k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+        v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_attention_sharded(q, k, v, mesh, causal=True, head_axis=None) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True) ** 2)
+
+        with mesh:
+            gr = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(gr, gd):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_), rtol=2e-3, atol=2e-3)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_pallas_blocks_inside_ring(self, causal):
+        """Flash kernels run INSIDE the ring (interpret mode on the CPU
+        mesh): kernel-compatible local shards (seq 128, d 128) route each
+        ring step through the pallas fwd/bwd kernels — the long-context path
+        is flash-grade end to end."""
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=4, tp=1))
+        key = jax.random.PRNGKey(5)
+        kq, kk, kv = jax.random.split(key, 3)
+        b, s, hq, hkv, d = 2, 512, 2, 1, 128  # local seq 128 per sp shard
+        q = jax.random.normal(kq, (b, s, hq, d), jnp.float32)
+        k = jax.random.normal(kk, (b, s, hkv, d), jnp.float32)
+        v = jax.random.normal(kv, (b, s, hkv, d), jnp.float32)
+
+        def ring(q, k, v):
+            return ring_attention_sharded(
+                q, k, v, mesh, causal=causal, head_axis=None, impl="pallas", interpret=True
+            )
+
+        with mesh:
+            out = jax.jit(ring)(q, k, v)
+            gr = jax.jit(jax.grad(lambda *a: jnp.sum(ring(*a) ** 2), argnums=(0, 1, 2)))(q, k, v)
+        ref = dense_attention(q, k, v, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+        gd = jax.grad(
+            lambda *a: jnp.sum(dense_attention(*a, causal=causal) ** 2), argnums=(0, 1, 2)
+        )(q, k, v)
+        for name, a, b_ in zip("qkv", gr, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b_), rtol=5e-3, atol=5e-3,
+                err_msg=f"d{name} mismatch (pallas ring, causal={causal})",
+            )
+
     def test_bf16_inputs(self):
         mesh = build_mesh(MeshSpec(fsdp=2, sp=2, tp=2))
         key = jax.random.PRNGKey(2)

@@ -2,11 +2,25 @@
 
 No reference counterpart (SURVEY.md §5.7 — long-context ABSENT in the
 reference); this is a first-class capability of the TPU-native framework.
-Design: the sequence is sharded over ``sp``; each device keeps its Q shard
-resident and the K/V shards rotate around the ring via ``ppermute`` (one hop
-per step, riding ICI on a real slice).  Attention is accumulated block-by-block
-with the flash-attention online-softmax recurrence, so memory stays
-O(local_seq²) per step and the full sequence never materializes on one chip.
+
+Design (flash-grade end to end):
+
+* The sequence is sharded over ``sp``; each device keeps its Q shard resident
+  and the K/V shards rotate around the ring via ``ppermute`` (one hop per
+  step, riding ICI on a real slice).
+* **Per-step compute is the pallas flash kernel** when shapes allow
+  (d % 128 == 0, local seq % 128 == 0): the causal diagonal step is pulled
+  out of the rotation loop (it is always t == 0), so every remaining step is
+  either a *fully unmasked* block (causal=False kernel — no mask VPU passes)
+  or wholly masked (skipped under ``lax.cond``).  Kernel-incompatible shapes
+  fall back to a dense per-block implementation with identical semantics.
+* **Custom VJP**: the backward re-rotates K/V around the ring and circulates
+  (dK, dV) accumulators along with them, so residuals are O(local) —
+  (q, k, v, out, lse) only.  Differentiating through the forward's
+  ``fori_loop`` (the previous design) saved every step's rotated K/V as
+  residuals: O(n · local) memory that defeated the point of the ring.
+* Per-step results merge with the standard two-level flash combination on
+  (normalized out, logsumexp): running ``acc = Σ_b e^{lse_b − L} out_b``.
 
 All math accumulates in float32 regardless of input dtype (bf16 inputs are
 fine — the MXU consumes bf16, the running softmax state is f32).
@@ -15,7 +29,7 @@ fine — the MXU consumes bf16, the running softmax state is f32).
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -31,33 +45,218 @@ from tpu_nexus.ops.attention import checkpoint_name as _checkpoint_name
 _NEG_INF = -1e30
 
 
-def _block_attention(q, k, v, q_offset, k_offset, causal, scale):
-    """One (Q-block × KV-block) attention step with GQA support.
+# -- per-block primitives ------------------------------------------------------
 
-    Shapes: q [B, Sq, Hq, D]; k, v [B, Sk, Hkv, D], Hq % Hkv == 0.
-    Returns (scores-exp @ v partial [B, Sq, Hq, D] in f32,
-             row max  [B, Sq, Hq] f32,
-             row sum  [B, Sq, Hq] f32).
+
+def _pallas_block_ok(q: jax.Array, k: jax.Array) -> bool:
+    """Shapes the flash kernels handle for one ring block (local shards)."""
+    b, s, hq, d = q.shape
+    return (
+        d % 128 == 0
+        and s % 128 == 0
+        and k.shape[1] == s  # equal local shards
+        and hq % k.shape[2] == 0
+    )
+
+
+def _block_fwd(q, k, v, causal, scale, use_pallas, interpret):
+    """One ring step: returns (normalized out [B,S,Hq,D] f32, lse [B,S,Hq] f32).
+
+    ``causal`` here means the *diagonal* block (q/k offsets equal); full
+    off-diagonal blocks pass causal=False and pay no masking.
     """
+    if use_pallas:
+        from tpu_nexus.ops.flash_attention import _flash_forward
+
+        out_kern, lse_kern = _flash_forward(q, k, v, scale, causal, interpret)
+        out = jnp.swapaxes(out_kern, 1, 2).astype(jnp.float32)
+        lse = jnp.swapaxes(lse_kern[..., 0], 1, 2)  # [B,S,Hq] f32
+        return out, lse
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
     qg = q.reshape(b, sq, hkv, g, d)
-    # [B, Hkv, G, Sq, Sk] in f32 straight off the MXU
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
-    scores = scores * scale
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
     if causal:
-        q_pos = q_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
-        k_pos = k_offset + jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
         scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-    m = jnp.max(scores, axis=-1)  # [B, Hkv, G, Sq]
+    m = jnp.max(scores, axis=-1)  # [B,Hkv,G,Sq]
     p = jnp.exp(scores - m[..., None])
-    l = jnp.sum(p, axis=-1)  # [B, Hkv, G, Sq]
-    pv = jnp.einsum("bhgqk,bkhd->bqhgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    pv = pv.reshape(b, sq, hq, d)
-    m = jnp.moveaxis(m, 3, 1).reshape(b, sq, hq)
-    l = jnp.moveaxis(l, 3, 1).reshape(b, sq, hq)
-    return pv, m, l
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v, preferred_element_type=jnp.float32)
+    out = out / l[..., None].transpose(0, 3, 1, 2, 4)  # -> [B,Sq,Hkv,G,1]
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return (
+        out.reshape(b, sq, hq, d),
+        jnp.moveaxis(lse, 3, 1).reshape(b, sq, hq),
+    )
+
+
+def _block_bwd(q, k, v, out, lse, dsum, g_out, causal, scale, use_pallas, interpret):
+    """One ring step of the backward: (dq, dk, dv) contributions in f32.
+
+    ``lse``/``dsum`` are the GLOBAL per-row statistics ([B,S,Hq] f32), so the
+    per-block probabilities are w.r.t. the final softmax — the flash
+    backward recurrence.
+    """
+    if use_pallas:
+        from tpu_nexus.ops.flash_attention import _flash_backward
+
+        out_kern = jnp.swapaxes(out, 1, 2)
+        lse_kern = jnp.swapaxes(lse, 1, 2)[..., None]
+        dq, dk, dv = _flash_backward(
+            q, k, v, out_kern, lse_kern, g_out, scale, causal, interpret
+        )
+        return dq.astype(jnp.float32), dk.astype(jnp.float32), dv.astype(jnp.float32)
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    gg = g_out.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 0)
+        k_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, k.shape[1]), 1)
+        scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+    lse_r = jnp.moveaxis(lse.reshape(b, sq, hkv, g), 1, 3)  # [B,Hkv,G,Sq]
+    dsum_r = jnp.moveaxis(dsum.reshape(b, sq, hkv, g), 1, 3)
+    p = jnp.exp(scores - lse_r[..., None])
+    dp = jnp.einsum("bqhgd,bkhd->bhgqk", gg, v.astype(jnp.float32))
+    ds = p * (dp - dsum_r[..., None])
+    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, k.astype(jnp.float32)) * scale
+    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg.astype(jnp.float32)) * scale
+    dv = jnp.einsum("bhgqk,bqhgd->bkhd", p, gg)
+    return dq.reshape(b, sq, hq, d), dk, dv
+
+
+def _combine(acc, big_l, out_b, lse_b):
+    """Two-level flash merge of (normalized out, lse) pairs."""
+    m_new = jnp.maximum(big_l, lse_b)
+    alpha = jnp.where(big_l == _NEG_INF, 0.0, jnp.exp(big_l - m_new))
+    beta = jnp.where(lse_b == _NEG_INF, 0.0, jnp.exp(lse_b - m_new))
+    denom = jnp.maximum(alpha + beta, 1e-30)
+    acc_new = (acc * alpha[..., None] + out_b * beta[..., None]) / denom[..., None]
+    return acc_new, m_new + jnp.log(denom)
+
+
+# -- ring forward/backward (per-device code, inside shard_map) -----------------
+
+
+def _ring_forward(q, k, v, axis_name, causal, scale, use_pallas, interpret):
+    """Returns (out [B,S,Hq,D] f32 normalized, lse [B,S,Hq] f32)."""
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    block = functools.partial(
+        _block_fwd, scale=scale, use_pallas=use_pallas, interpret=interpret
+    )
+
+    # t = 0 is ALWAYS the diagonal block: causal masking stays out of the loop
+    acc, big_l = block(q, k, v, causal=causal)
+    if n == 1:
+        return acc, big_l
+
+    def visit(acc, big_l, k_c, v_c, src):
+        def go(args):
+            a, L = args
+            out_b, lse_b = block(q, k_c, v_c, causal=False)
+            return _combine(a, L, out_b, lse_b)
+
+        if not causal:
+            return go((acc, big_l))
+        # src > my ⇒ every key position follows every query position: the
+        # whole block is masked — skip its kernels (≈2x FLOPs at large sp)
+        return jax.lax.cond(src < my, go, lambda args: args, (acc, big_l))
+
+    def step(t, carry):
+        acc, big_l, k_c, v_c = carry
+        acc, big_l = visit(acc, big_l, k_c, v_c, (my + t) % n)
+        # rotate AFTER the visit: the ppermute and the block kernels both
+        # depend only on (k_c, v_c), so XLA can overlap ICI with compute
+        return acc, big_l, jax.lax.ppermute(k_c, axis_name, perm), jax.lax.ppermute(v_c, axis_name, perm)
+
+    carry = (acc, big_l, jax.lax.ppermute(k, axis_name, perm), jax.lax.ppermute(v, axis_name, perm))
+    acc, big_l, k_last, v_last = jax.lax.fori_loop(1, n - 1, step, carry) if n > 2 else carry
+    # final block: no trailing rotation to discard
+    acc, big_l = visit(acc, big_l, k_last, v_last, (my + n - 1) % n)
+    return acc, big_l
+
+
+def _ring_backward(q, k, v, out, lse, g_out, axis_name, causal, scale, use_pallas, interpret):
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    # global per-row D_i = rowsum(dO ∘ O), computed once
+    dsum = jnp.sum(g_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)
+    block = functools.partial(
+        _block_bwd, scale=scale, use_pallas=use_pallas, interpret=interpret
+    )
+
+    dq, dk, dv = block(q, k, v, out, lse, dsum, g_out, causal=causal)
+    if n == 1:
+        return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+    def visit(dq_a, dk_a, dv_a, k_c, v_c, src):
+        def go(args):
+            dq_a, dk_a, dv_a = args
+            dqc, dkc, dvc = block(q, k_c, v_c, out, lse, dsum, g_out, causal=False)
+            return dq_a + dqc, dk_a + dkc, dv_a + dvc
+
+        if not causal:
+            return go((dq_a, dk_a, dv_a))
+        return jax.lax.cond(src < my, go, lambda args: args, (dq_a, dk_a, dv_a))
+
+    def rotate(k_c, v_c, dk_a, dv_a):
+        # (dK, dV) accumulators travel WITH the K/V block they belong to;
+        # after n total rotations every accumulator is home
+        return tuple(jax.lax.ppermute(x, axis_name, perm) for x in (k_c, v_c, dk_a, dv_a))
+
+    def step(t, carry):
+        dq_a, dk_a, dv_a, k_c, v_c = carry
+        k_c, v_c, dk_a, dv_a = rotate(k_c, v_c, dk_a, dv_a)
+        dq_a, dk_a, dv_a = visit(dq_a, dk_a, dv_a, k_c, v_c, (my + t) % n)
+        return dq_a, dk_a, dv_a, k_c, v_c
+
+    dq, dk, dv, k_c, v_c = jax.lax.fori_loop(1, n, step, (dq, dk, dv, k, v))
+    # one final hop brings each accumulator back to its owner
+    _, _, dk, dv = rotate(k_c, v_c, dk, dv)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# -- custom VJP ----------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring(q, k, v, axis_name, causal, scale, use_pallas, interpret):
+    out, _ = _ring_forward(q, k, v, axis_name, causal, scale, use_pallas, interpret)
+    return out.astype(q.dtype)
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, use_pallas, interpret):
+    out, lse = _ring_forward(q, k, v, axis_name, causal, scale, use_pallas, interpret)
+    out = _checkpoint_name(out.astype(q.dtype), "attn_out")
+    lse = _checkpoint_name(lse, "attn_lse")
+    # residuals are O(local): q, k, v, out, lse — NOT the per-step rotated
+    # K/V copies that differentiating through the forward loop would save
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, scale, use_pallas, interpret, residuals, g):
+    q, k, v, out, lse = residuals
+    return _ring_backward(
+        q, k, v, out, lse, g, axis_name, causal, scale, use_pallas, interpret
+    )
+
+
+_ring.defvjp(_ring_fwd, _ring_bwd)
+
+
+# -- public API ----------------------------------------------------------------
 
 
 def ring_attention(
@@ -68,71 +267,31 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     scale: Optional[float] = None,
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Attention over a sequence sharded on ``axis_name``.
 
     Must be called inside ``shard_map`` (or ``jit`` with the axis bound);
-    q/k/v are the *local* shards ``[B, S_local, H, D]``.  K/V blocks rotate
-    ring-wise; each step combines via the online-softmax recurrence:
-
-        m' = max(m, m_blk); l' = l·e^{m−m'} + l_blk·e^{m_blk−m'}
-        acc' = acc·e^{m−m'} + pv_blk·e^{m_blk−m'}
+    q/k/v are the *local* shards ``[B, S_local, H, D]``.  ``impl``:
+    "auto" (pallas flash blocks when shapes allow, else dense blocks),
+    "pallas" (force), "xla" (force dense blocks).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    n = jax.lax.axis_size(axis_name)
-    my = jax.lax.axis_index(axis_name)
-    b, s, h, d = q.shape
-    q_offset = my * s
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown ring attention impl {impl!r}; use auto|pallas|xla")
+    from tpu_nexus.ops.flash_attention import _on_tpu
 
-    # derive the init carry from q so its varying-manual-axes (vma) type
-    # matches the loop body's output under shard_map's tracking
-    zero = q[..., 0].astype(jnp.float32) * 0.0  # [B, S, H]
-    init = (q.astype(jnp.float32) * 0.0, zero + _NEG_INF, zero)
-    # backward rotation: after step t the local block is the one that
-    # originated on device (my + t) % n, so every device sees every KV shard.
-    perm = [(i, (i - 1) % n) for i in range(n)]
-
-    def accumulate(state, k_blk, v_blk, src):
-        acc, m, l = state
-        pv, m_blk, l_blk = _block_attention(q, k_blk, v_blk, q_offset, src * s, causal, scale)
-        m_new = jnp.maximum(m, m_blk)
-        # guard fully-masked rows: e^{-inf - -inf} -> e^0 would poison acc
-        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
-        beta = jnp.where(m_blk == _NEG_INF, 0.0, jnp.exp(m_blk - m_new))
-        return (
-            acc * alpha[..., None] + pv * beta[..., None],
-            m_new,
-            l * alpha + l_blk * beta,
-        )
-
-    def visit(state, k_blk, v_blk, t):
-        src = (my + t) % n
-        if not causal:
-            return accumulate(state, k_blk, v_blk, src)
-        # src > my ⇒ every key position follows every query position: the
-        # whole block is masked — skip its einsums (≈2x FLOPs at large sp).
-        # The predicate is device-local, which is fine: no collectives inside.
-        return jax.lax.cond(
-            src > my,
-            lambda st: st,
-            lambda st: accumulate(st, k_blk, v_blk, src),
-            state,
-        )
-
-    def step(t, carry):
-        state, k_blk, v_blk = carry
-        state = visit(state, k_blk, v_blk, t)
-        k_next = jax.lax.ppermute(k_blk, axis_name, perm)
-        v_next = jax.lax.ppermute(v_blk, axis_name, perm)
-        return state, k_next, v_next
-
-    # n-1 rotated steps, then the final block without the discarded rotation
-    state, k_last, v_last = jax.lax.fori_loop(0, n - 1, step, (init, k, v))
-    acc, m, l = visit(state, k_last, v_last, n - 1)
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    # named like every attention impl: the "attn_out" remat policy saves it
-    return _checkpoint_name(out.astype(q.dtype), "attn_out")
+    if interpret is None:
+        interpret = not _on_tpu()
+    if impl == "pallas":
+        use_pallas = True
+    elif impl == "xla":
+        use_pallas = False
+    else:
+        use_pallas = _pallas_block_ok(q, k) and (_on_tpu() or interpret)
+    return _ring(q, k, v, axis_name, bool(causal), float(scale), use_pallas, bool(interpret))
 
 
 def ring_attention_sharded(
@@ -142,17 +301,27 @@ def ring_attention_sharded(
     mesh: Mesh,
     *,
     causal: bool = True,
-    batch_axes=("dp", "fsdp"),
+    batch_axes: Tuple[str, ...] = ("dp", "fsdp"),
     seq_axis: str = "sp",
-    head_axis: str = "tp",
+    head_axis: Optional[str] = "tp",
+    impl: str = "auto",
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
     """shard_map entry point: global ``[B, S, H, D]`` arrays, sequence sharded
     over ``sp``, heads over ``tp``, batch over ``(dp, fsdp)``."""
     spec = P(batch_axes, seq_axis, head_axis, None)
-    fn = shard_map(
-        functools.partial(ring_attention, axis_name=seq_axis, causal=causal),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
+    body = functools.partial(
+        ring_attention, axis_name=seq_axis, causal=causal, impl=impl, interpret=interpret
     )
+    # pallas_call out_shapes carry no vma annotations, which the varying-
+    # manual-axes checker requires for nested pallas kernels — disable it
+    # (spelled check_rep on jax < 0.8, where the fallback import lands)
+    try:
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
+        )
+    except TypeError:  # pragma: no cover - older jax
+        fn = shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
+        )
     return fn(q, k, v)

@@ -351,10 +351,14 @@ class Supervisor:
         lat = sorted(self.commit_latencies)
         if not lat:
             return {"count": 0, "p50": 0.0, "p95": 0.0, "max": 0.0}
+        import math
+
         return {
             "count": len(lat),
             "p50": lat[len(lat) // 2],
-            "p95": lat[min(len(lat) - 1, int(len(lat) * 0.95))],
+            # nearest-rank: ceil(0.95 n) - 1; int(0.95 n) overshoots by one
+            # and reads as max for any window of <= 20 samples
+            "p95": lat[max(0, math.ceil(len(lat) * 0.95) - 1)],
             "max": lat[-1],
         }
 
