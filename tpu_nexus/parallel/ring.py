@@ -48,15 +48,44 @@ _NEG_INF = -1e30
 # -- per-block primitives ------------------------------------------------------
 
 
-def _pallas_block_ok(q: jax.Array, k: jax.Array) -> bool:
-    """Shapes the flash kernels handle for one ring block (local shards)."""
-    b, s, hq, d = q.shape
+def _pallas_block_ok(s: int, sk: int, hq: int, hkv: int, d: int, itemsize: int) -> bool:
+    """Shapes the flash kernels handle for one ring block (LOCAL shards) —
+    including flash_supported's VMEM cap: the kernel stages the whole local
+    K/V per kv-head in VMEM, so very long local shards must fall back."""
     return (
         d % 128 == 0
         and s % 128 == 0
-        and k.shape[1] == s  # equal local shards
-        and hq % k.shape[2] == 0
+        and sk == s  # equal local shards
+        and hq % hkv == 0
+        and sk * d * itemsize <= 4 * 1024 * 1024
     )
+
+
+def _decide_use_pallas(impl: str, s: int, sk: int, hq: int, hkv: int, d: int, itemsize: int) -> bool:
+    """One decision point shared by ring_attention (local shards) and
+    ring_attention_sharded (local shapes derived from the mesh) so the
+    check_vma exemption below cannot drift from the kernel choice.
+
+    auto → pallas only on a real TPU (interpret mode is orders of magnitude
+    slower than the dense XLA blocks — it must be an explicit request);
+    forced pallas with incompatible shapes raises instead of silently
+    running a zero-program grid.
+    """
+    from tpu_nexus.ops.flash_attention import _on_tpu
+
+    if impl == "xla":
+        return False
+    ok = _pallas_block_ok(s, sk, hq, hkv, d, itemsize)
+    if impl == "pallas":
+        if not ok:
+            raise ValueError(
+                f"ring attention impl='pallas' unsupported for local shards "
+                f"(s={s}, sk={sk}, hq={hq}, hkv={hkv}, d={d}): need d%128==0, "
+                "s%128==0, equal local shards, hq%hkv==0, and local K/V "
+                "<= 4MB/kv-head — use impl='auto' to fall back to dense blocks"
+            )
+        return True
+    return ok and _on_tpu()
 
 
 def _block_fwd(q, k, v, causal, scale, use_pallas, interpret):
@@ -86,7 +115,11 @@ def _block_fwd(q, k, v, causal, scale, use_pallas, interpret):
     m = jnp.max(scores, axis=-1)  # [B,Hkv,G,Sq]
     p = jnp.exp(scores - m[..., None])
     l = jnp.sum(p, axis=-1)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, v, preferred_element_type=jnp.float32)
+    # p cast to v.dtype so the MXU consumes bf16 (f32 accumulation via
+    # preferred_element_type), mirroring the flash kernels
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
     out = out / l[..., None].transpose(0, 3, 1, 2, 4)  # -> [B,Sq,Hkv,G,1]
     lse = m + jnp.log(jnp.maximum(l, 1e-30))
     return (
@@ -115,7 +148,7 @@ def _block_bwd(q, k, v, out, lse, dsum, g_out, causal, scale, use_pallas, interp
     hkv = k.shape[2]
     g = hq // hkv
     qg = q.reshape(b, sq, hkv, g, d)
-    gg = g_out.astype(jnp.float32).reshape(b, sq, hkv, g, d)
+    gg = g_out.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum(
         "bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32
     ) * scale
@@ -126,11 +159,19 @@ def _block_bwd(q, k, v, out, lse, dsum, g_out, causal, scale, use_pallas, interp
     lse_r = jnp.moveaxis(lse.reshape(b, sq, hkv, g), 1, 3)  # [B,Hkv,G,Sq]
     dsum_r = jnp.moveaxis(dsum.reshape(b, sq, hkv, g), 1, 3)
     p = jnp.exp(scores - lse_r[..., None])
-    dp = jnp.einsum("bqhgd,bkhd->bhgqk", gg, v.astype(jnp.float32))
+    # matmul inputs stay in the model dtype (bf16 on the MXU), f32 accumulate
+    # via preferred_element_type — the flash kernels' precision contract
+    dp = jnp.einsum("bqhgd,bkhd->bhgqk", gg, v, preferred_element_type=jnp.float32)
     ds = p * (dp - dsum_r[..., None])
-    dq = jnp.einsum("bhgqk,bkhd->bqhgd", ds, k.astype(jnp.float32)) * scale
-    dk = jnp.einsum("bhgqk,bqhgd->bkhd", ds, qg.astype(jnp.float32)) * scale
-    dv = jnp.einsum("bhgqk,bqhgd->bkhd", p, gg)
+    dq = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", ds.astype(k.dtype), k, preferred_element_type=jnp.float32
+    ) * scale
+    dk = jnp.einsum(
+        "bhgqk,bqhgd->bkhd", ds.astype(q.dtype), qg, preferred_element_type=jnp.float32
+    ) * scale
+    dv = jnp.einsum(
+        "bhgqk,bqhgd->bkhd", p.astype(gg.dtype), gg, preferred_element_type=jnp.float32
+    )
     return dq.reshape(b, sq, hq, d), dk, dv
 
 
@@ -285,12 +326,9 @@ def ring_attention(
 
     if interpret is None:
         interpret = not _on_tpu()
-    if impl == "pallas":
-        use_pallas = True
-    elif impl == "xla":
-        use_pallas = False
-    else:
-        use_pallas = _pallas_block_ok(q, k) and (_on_tpu() or interpret)
+    use_pallas = _decide_use_pallas(
+        impl, q.shape[1], k.shape[1], q.shape[2], k.shape[2], q.shape[3], q.dtype.itemsize
+    )
     return _ring(q, k, v, axis_name, bool(causal), float(scale), use_pallas, bool(interpret))
 
 
@@ -313,15 +351,28 @@ def ring_attention_sharded(
     body = functools.partial(
         ring_attention, axis_name=seq_axis, causal=causal, impl=impl, interpret=interpret
     )
-    # pallas_call out_shapes carry no vma annotations, which the varying-
-    # manual-axes checker requires for nested pallas kernels — disable it
-    # (spelled check_rep on jax < 0.8, where the fallback import lands)
-    try:
-        fn = shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False
-        )
-    except TypeError:  # pragma: no cover - older jax
-        fn = shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_rep=False
-        )
+    # Decide (from the mesh-derived LOCAL shapes, via the same predicate the
+    # inner call uses) whether pallas kernels will run: pallas_call
+    # out_shapes carry no vma annotations, which the varying-manual-axes
+    # checker requires — but the checker stays ON for the dense path, where
+    # it catches collective/sharding bugs at trace time.
+    n_sp = mesh.shape.get(seq_axis, 1)
+    n_tp = mesh.shape.get(head_axis, 1) if head_axis else 1
+    will_use_pallas = _decide_use_pallas(
+        impl,
+        q.shape[1] // n_sp,
+        k.shape[1] // n_sp,
+        q.shape[2] // n_tp,
+        max(1, k.shape[2] // n_tp),
+        q.shape[3],
+        q.dtype.itemsize,
+    )
+    kwargs = {"mesh": mesh, "in_specs": (spec, spec, spec), "out_specs": spec}
+    if will_use_pallas:
+        try:
+            fn = shard_map(body, check_vma=False, **kwargs)
+        except TypeError:  # pragma: no cover - jax < 0.8 spells it check_rep
+            fn = shard_map(body, check_rep=False, **kwargs)
+    else:
+        fn = shard_map(body, **kwargs)
     return fn(q, k, v)

@@ -10,10 +10,34 @@ uncaught errors.
 from __future__ import annotations
 
 import logging
+import os
+import re
 import sys
 
 
+def _apply_platform_env() -> None:
+    """Make the JAX_PLATFORMS/XLA_FLAGS env contract authoritative.
+
+    On hosts with a TPU plugin (axon tunnel), the plugin pins the platform
+    before env vars are consulted — setting JAX_PLATFORMS=cpu in the pod env
+    silently has no effect.  Apply the env through jax.config (the recipe
+    __graft_entry__.dryrun_multichip and tests/conftest.py use) so a
+    CPU-forced workload (CI, rehearsal) really runs on the virtual mesh."""
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if not platforms:
+        return
+    import jax
+
+    jax.config.update("jax_platforms", platforms)
+    m = re.search(
+        r"xla_force_host_platform_device_count=(\d+)", os.environ.get("XLA_FLAGS", "")
+    )
+    if m and "cpu" in platforms:
+        jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+
+
 def main() -> int:
+    _apply_platform_env()
     from tpu_nexus.app.config import SupervisorConfig
     from tpu_nexus.app.dependencies import ApplicationServices
     from tpu_nexus.core.config import load_config
