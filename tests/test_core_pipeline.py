@@ -81,6 +81,24 @@ async def test_token_bucket_throttles():
     assert elapsed >= 0.04
 
 
+async def test_token_bucket_burst_admits_concurrently():
+    """Waiters sleep OUTSIDE the bucket lock: concurrent acquirers on a
+    drained bucket share the refill stream instead of serializing behind a
+    single lock-holding sleeper, and burst capacity is spendable at once."""
+    import asyncio
+
+    bucket = TokenBucket(rate=100.0, burst=8)
+    t0 = time.monotonic()
+    await asyncio.gather(*(bucket.acquire() for _ in range(8)))
+    assert time.monotonic() - t0 < 0.05  # all 8 burst tokens spent at once
+    # drained: 4 concurrent waiters need 4 refills at 100/s ~= 40ms total,
+    # which also proves no waiter sat behind another's full sleep chain
+    t0 = time.monotonic()
+    await asyncio.gather(*(bucket.acquire() for _ in range(4)))
+    elapsed = time.monotonic() - t0
+    assert 0.02 <= elapsed < 0.5
+
+
 async def test_rate_limited_actor_respects_rate():
     done = []
     actor = PipelineStageActor(

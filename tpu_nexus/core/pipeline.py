@@ -55,15 +55,20 @@ class TokenBucket:
     async def acquire(self) -> None:
         if self.rate <= 0:
             return
-        async with self._lock:
-            while True:
+        while True:
+            # the lock guards only the token arithmetic; the SLEEP happens
+            # outside it, so waiters park concurrently and a refilled bucket
+            # admits newcomers immediately instead of queueing them behind a
+            # sleeper — burst stays meaningful under contention
+            async with self._lock:
                 now = time.monotonic()
                 self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
                 self._last = now
                 if self._tokens >= 1.0:
                     self._tokens -= 1.0
                     return
-                await asyncio.sleep((1.0 - self._tokens) / self.rate)
+                wait = (1.0 - self._tokens) / self.rate
+            await asyncio.sleep(wait)
 
 
 class PipelineStageActor(Generic[In, Out]):

@@ -4,15 +4,18 @@ The reference supervises opaque algorithm containers (SURVEY.md §2.7); the
 TPU-native framework ships the algorithms themselves as JAX programs.  The
 flagship family is Llama-3 (BASELINE.json configs #4/#5: Llama-3-8B
 jax.distributed pretrain); MNIST covers the small single-slice demo
-(config #3).
+(config #3); the MoE family (Mixtral-style) exercises expert parallelism
+over the ``ep`` mesh axis.
 """
 
 from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_forward, llama_init
 from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
+from tpu_nexus.models.moe import MoeConfig, moe_axes, moe_hidden, moe_init
 from tpu_nexus.models.registry import (
     LlamaAdapter,
     MnistAdapter,
     ModelAdapter,
+    MoeAdapter,
     adapter_for,
     get_adapter,
 )
@@ -26,9 +29,14 @@ __all__ = [
     "mnist_axes",
     "mnist_forward",
     "mnist_init",
+    "MoeConfig",
+    "moe_axes",
+    "moe_hidden",
+    "moe_init",
     "ModelAdapter",
     "LlamaAdapter",
     "MnistAdapter",
+    "MoeAdapter",
     "adapter_for",
     "get_adapter",
 ]

@@ -1,0 +1,289 @@
+"""Mixture-of-Experts decoder family (Mixtral-style), TPU-first.
+
+No reference counterpart (the reference supervises opaque containers); this
+is the model family that exercises the ``ep`` mesh axis end to end — expert
+weights and expert token buffers shard over ``ep``, and XLA/GSPMD inserts
+the dispatch all-to-alls from the sharding annotations alone.
+
+Design choices, all for the XLA compilation model:
+
+* **Attention/backbone is Llama** — same GQA + RoPE + RMSNorm blocks (reused
+  from models/llama.py), same stacked-params ``lax.scan`` over layers, same
+  remat policies, same flash/ring attention dispatch.
+* **Static-capacity scatter dispatch** (GShard-style, no ``[T, E, C]``
+  one-hot): tokens pick top-k experts; a cumsum assigns each (token, k) a
+  position in its expert's fixed-capacity buffer; a scatter-add builds
+  ``[E, C, emb]`` buffers; the per-expert SwiGLU runs as one batched einsum
+  over the leading (ep-sharded) expert axis; a gather combines outputs with
+  the renormalized gate weights.  Everything is static-shaped — capacity is
+  computed from the (static) token count at trace time, overflow tokens are
+  dropped (their residual stream passes through, standard practice).
+* **Router in f32** with the standard auxiliary losses: Switch load-balance
+  loss (E · Σ fᵢ·pᵢ) and router z-loss — both returned in metrics and added
+  to the training loss by the adapter.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpu_nexus.models.llama import _rope, rope_tables
+from tpu_nexus.ops.rmsnorm import rms_norm
+
+AttnFn = Any
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    vocab_size: int = 32000
+    hidden: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    intermediate: int = 14336  # PER-EXPERT ffn width
+    n_experts: int = 8
+    experts_per_token: int = 2
+    capacity_factor: float = 1.25
+    rope_theta: float = 500_000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    remat: bool = True
+    remat_policy: str = "dots"
+    scan_unroll: int = 1
+    tied_embeddings: bool = False
+    load_balance_coef: float = 0.01
+    router_z_coef: float = 1e-3
+
+    @staticmethod
+    def mixtral_8x7b() -> "MoeConfig":
+        return MoeConfig()
+
+    @staticmethod
+    def nexus_moe() -> "MoeConfig":
+        """Bench-sized MoE: ~8x220M expert params, one v5e chip or a small
+        ep mesh."""
+        return MoeConfig(
+            vocab_size=32768, hidden=1024, n_layers=8, n_heads=8, n_kv_heads=4,
+            head_dim=128, intermediate=2048, n_experts=8, experts_per_token=2,
+            tied_embeddings=True, param_dtype=jnp.bfloat16, max_seq_len=4096,
+            remat_policy="attn_out",
+        )
+
+    @staticmethod
+    def tiny(vocab_size: int = 256) -> "MoeConfig":
+        return MoeConfig(
+            vocab_size=vocab_size, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=16, intermediate=96, n_experts=4, experts_per_token=2,
+            max_seq_len=256, remat=False,
+        )
+
+
+def moe_axes(cfg: MoeConfig) -> Dict[str, Any]:
+    """Logical-axis pytree mirroring :func:`moe_init`.  Expert weights carry
+    the "expert" logical axis -> the ``ep`` mesh axis (parallel/sharding.py)."""
+    layers = {
+        "attn_norm": (None, "embed"),
+        "wq": (None, "embed", "heads", "head_dim"),
+        "wk": (None, "embed", "kv_heads", "head_dim"),
+        "wv": (None, "embed", "kv_heads", "head_dim"),
+        "wo": (None, "heads", "head_dim", "embed"),
+        "mlp_norm": (None, "embed"),
+        "router": (None, "embed", None),  # [L, e, E] — E is tiny, replicate
+        "w_gate": (None, "expert", "embed", "mlp"),
+        "w_up": (None, "expert", "embed", "mlp"),
+        "w_down": (None, "expert", "mlp", "embed"),
+    }
+    axes: Dict[str, Any] = {
+        "embed": {"tokens": ("vocab", "embed")},
+        "layers": layers,
+        "out_norm": ("embed",),
+    }
+    if not cfg.tied_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def moe_init(key: jax.Array, cfg: MoeConfig) -> Dict[str, Any]:
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    e, f, hq, hkv, d, l, ne = (
+        cfg.hidden, cfg.intermediate, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.n_layers, cfg.n_experts,
+    )
+    pd = cfg.param_dtype
+
+    def normal(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) * fan_in**-0.5).astype(pd)
+
+    ks = jax.random.split(k_layers, 8)
+    params: Dict[str, Any] = {
+        "embed": {"tokens": normal(k_embed, (cfg.vocab_size, e), e)},
+        "layers": {
+            "attn_norm": jnp.ones((l, e), pd),
+            "wq": normal(ks[0], (l, e, hq, d), e),
+            "wk": normal(ks[1], (l, e, hkv, d), e),
+            "wv": normal(ks[2], (l, e, hkv, d), e),
+            "wo": normal(ks[3], (l, hq, d, e), hq * d),
+            "mlp_norm": jnp.ones((l, e), pd),
+            "router": normal(ks[4], (l, e, ne), e),
+            "w_gate": normal(ks[5], (l, ne, e, f), e),
+            "w_up": normal(ks[6], (l, ne, e, f), e),
+            "w_down": normal(ks[7], (l, ne, f, e), f),
+        },
+        "out_norm": jnp.ones((e,), pd),
+    }
+    if not cfg.tied_embeddings:
+        params["lm_head"] = normal(k_head, (e, cfg.vocab_size), e)
+    return params
+
+
+def expert_capacity(n_tokens: int, cfg: MoeConfig) -> int:
+    """Static per-expert buffer size; computed from shapes at trace time."""
+    return max(
+        1,
+        int(math.ceil(cfg.capacity_factor * cfg.experts_per_token * n_tokens / cfg.n_experts)),
+    )
+
+
+def moe_ffn(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
+    """The expert layer: [B, S, e] -> ([B, S, e], aux dict).
+
+    Static-capacity scatter dispatch; overflow tokens contribute nothing
+    (their residual connection carries them through).
+    """
+    ct = cfg.dtype
+    b, s, e = x.shape
+    t = b * s
+    ne, k = cfg.n_experts, cfg.experts_per_token
+    cap = expert_capacity(t, cfg)
+    flat = x.reshape(t, e)
+
+    # router fully in f32 (inputs, not just accumulation): near-tied expert
+    # scores in bf16 make top_k routing flap between steps
+    logits = jnp.einsum(
+        "te,ek->tk",
+        flat.astype(jnp.float32),
+        layer["router"].astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )  # [T, E] f32
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate, eidx = jax.lax.top_k(probs, k)  # [T, K]
+    gate = gate / jnp.maximum(jnp.sum(gate, axis=-1, keepdims=True), 1e-9)
+
+    # position of each (token, k) assignment within its expert's buffer:
+    # cumsum of one-hot assignments in flattened (k-major) order
+    onehot = jax.nn.one_hot(eidx, ne, dtype=jnp.int32)  # [T, K, E]
+    flat_oh = onehot.transpose(1, 0, 2).reshape(t * k, ne)  # k-major: k=0 first
+    pos_flat = jnp.cumsum(flat_oh, axis=0) - flat_oh  # [T*K, E]
+    pos = jnp.sum(pos_flat * flat_oh, axis=-1).reshape(k, t).T  # [T, K]
+    keep = (pos < cap).astype(jnp.float32)  # [T, K]
+
+    # scatter tokens into [E, C, e] buffers (overflow lands in a dumpster
+    # row C that is sliced off)
+    cap_idx = jnp.minimum(pos, cap)  # overflow -> row `cap`
+    buf = jnp.zeros((ne, cap + 1, e), ct)
+    updates = (flat.astype(ct)[:, None, :] * keep[..., None].astype(ct)).reshape(t * k, e)
+    buf = buf.at[eidx.reshape(-1), cap_idx.reshape(-1)].add(updates)
+    buf = buf[:, :cap, :]  # [E, C, e]
+
+    # per-expert SwiGLU as batched einsums over the ep-sharded expert axis
+    g = jnp.einsum("Ece,Eef->Ecf", buf, layer["w_gate"].astype(ct))
+    u = jnp.einsum("Ece,Eef->Ecf", buf, layer["w_up"].astype(ct))
+    out_buf = jnp.einsum("Ecf,Efe->Ece", jax.nn.silu(g) * u, layer["w_down"].astype(ct))
+
+    # gather each assignment's expert output, weight by its gate
+    picked = out_buf[eidx.reshape(-1), cap_idx.reshape(-1)].reshape(t, k, e)
+    combined = jnp.sum(picked * (gate * keep)[..., None].astype(ct), axis=1)
+
+    # aux losses (Switch): load balance on ALL assignments, z-loss on logits
+    density = jnp.mean(onehot.astype(jnp.float32).sum(axis=1), axis=0)  # frac tokens/expert
+    router_prob = jnp.mean(probs, axis=0)
+    load_balance = ne * jnp.sum(density / k * router_prob)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    dropped = 1.0 - jnp.mean(keep)
+    aux = {"load_balance": load_balance, "router_z": z, "dropped_frac": dropped}
+    return combined.reshape(b, s, e).astype(x.dtype), aux
+
+
+def moe_hidden(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    attn_fn: Optional[AttnFn] = None,
+    attn_impl: str = "auto",
+):
+    """Final-norm hidden states [B, S, e] + accumulated router aux losses."""
+    from tpu_nexus.ops import attention as _ops_attention
+
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+        )
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal=True):
+            return _ops_attention(q, k, v, causal=causal, impl=attn_impl)
+
+    ct = cfg.dtype
+    x = params["embed"]["tokens"].astype(ct)[tokens]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def block(carry, layer):
+        x, lb, rz = carry
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
+        kk = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = _rope(q, cos, sin)
+        kk = _rope(kk, cos, sin)
+        o = attn_fn(q, kk, v, causal=True)
+        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        ffn_out, aux = moe_ffn(h, layer, cfg)
+        x = x + ffn_out
+        return (x, lb + aux["load_balance"], rz + aux["router_z"]), aux["dropped_frac"]
+
+    body = block
+    if cfg.remat:
+        policies = {
+            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
+            "nothing": jax.checkpoint_policies.nothing_saveable,
+        }
+        body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
+    zero = jnp.zeros((), jnp.float32)
+    (x, lb, rz), dropped = jax.lax.scan(
+        body, (x, zero, zero), params["layers"], unroll=cfg.scan_unroll
+    )
+    aux = {
+        "load_balance": lb / cfg.n_layers,
+        "router_z": rz / cfg.n_layers,
+        "dropped_frac": jnp.mean(dropped),
+    }
+    return rms_norm(x, params["out_norm"], cfg.norm_eps), aux
+
+
+def moe_head(params: Dict[str, Any], cfg: MoeConfig) -> jax.Array:
+    if cfg.tied_embeddings:
+        return params["embed"]["tokens"].astype(cfg.dtype).T
+    return params["lm_head"].astype(cfg.dtype)
+
+
+def moe_param_count(cfg: MoeConfig) -> int:
+    e, f, hq, hkv, d, l, v, ne = (
+        cfg.hidden, cfg.intermediate, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim,
+        cfg.n_layers, cfg.vocab_size, cfg.n_experts,
+    )
+    per_layer = 2 * e + e * hq * d + 2 * e * hkv * d + hq * d * e + e * ne + ne * 3 * e * f
+    total = v * e + l * per_layer + e
+    if not cfg.tied_embeddings:
+        total += e * v
+    return total

@@ -21,6 +21,25 @@ import numpy as np
 
 from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_head, llama_hidden, llama_init
 from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
+from tpu_nexus.models.moe import MoeConfig, moe_axes, moe_head, moe_hidden, moe_init
+
+
+def _ring_attn_fn(mesh):
+    """Ring attention when the mesh shards the sequence, else None (the
+    model dispatches to flash/XLA attention itself)."""
+    import functools
+
+    from tpu_nexus.parallel.ring import ring_attention_sharded
+
+    if mesh is None or mesh.shape.get("sp", 1) <= 1:
+        return None
+    head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
+    ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
+
+    def attn_fn(q, k, v, causal=True):
+        return ring(q, k, v, causal=causal)
+
+    return attn_fn
 
 
 class ModelAdapter:
@@ -72,20 +91,9 @@ class LlamaAdapter(ModelAdapter):
         return ("batch", "seq")
 
     def make_loss(self, train_cfg, mesh):
-        import functools
-
-        from tpu_nexus.parallel.ring import ring_attention_sharded
         from tpu_nexus.workload.train import chunked_next_token_loss
 
-        # ring attention rides in when the mesh shards the sequence
-        attn_fn = None
-        if mesh is not None and mesh.shape.get("sp", 1) > 1:
-            head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
-            ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
-
-            def attn_fn(q, k, v, causal=True):  # noqa: F811
-                return ring(q, k, v, causal=causal)
-
+        attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
         z_loss = getattr(train_cfg, "z_loss", 0.0)
 
@@ -93,6 +101,60 @@ class LlamaAdapter(ModelAdapter):
             hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
             head = llama_head(params, cfg)
             return chunked_next_token_loss(hidden, head, tokens, z_loss)
+
+        return loss_fn
+
+    def data(self, batch, seq_len, seed):
+        from tpu_nexus.workload.data import synthetic_tokens
+
+        return synthetic_tokens(batch, seq_len, self.config.vocab_size, seed=seed)
+
+    def items_in(self, batch):
+        return int(np.prod(batch.shape))
+
+
+@dataclass(frozen=True)
+class MoeAdapter(ModelAdapter):
+    """Mixture-of-Experts decoder (Mixtral-style): the ``ep`` mesh axis
+    user.  Batches are int32 token arrays [B, S]; the router's auxiliary
+    losses (load balance + z) join the training loss here and surface in the
+    harness metrics/heartbeats."""
+
+    config: MoeConfig = field(default_factory=MoeConfig.tiny)
+    name: str = "moe"
+
+    def init(self, key):
+        return moe_init(key, self.config)
+
+    def axes(self):
+        return moe_axes(self.config)
+
+    def batch_axes(self):
+        return ("batch", "seq")
+
+    def make_loss(self, train_cfg, mesh):
+        from tpu_nexus.workload.train import chunked_next_token_loss
+
+        attn_fn = _ring_attn_fn(mesh)
+        cfg = self.config
+        z_loss = getattr(train_cfg, "z_loss", 0.0)
+
+        def loss_fn(params, tokens):
+            hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)
+            head = moe_head(params, cfg)
+            loss, metrics = chunked_next_token_loss(hidden, head, tokens, z_loss)
+            loss = (
+                loss
+                + cfg.load_balance_coef * aux["load_balance"]
+                + cfg.router_z_coef * aux["router_z"]
+            )
+            metrics = dict(
+                metrics,
+                load_balance=aux["load_balance"],
+                router_z=aux["router_z"],
+                dropped_frac=aux["dropped_frac"],
+            )
+            return loss, metrics
 
         return loss_fn
 
@@ -157,18 +219,34 @@ def adapter_for(model_config: Any) -> ModelAdapter:
         return LlamaAdapter(config=model_config)
     if isinstance(model_config, MnistConfig):
         return MnistAdapter(config=model_config)
+    if isinstance(model_config, MoeConfig):
+        return MoeAdapter(config=model_config)
     raise TypeError(f"no adapter for model config {type(model_config).__name__}")
 
 
 def get_adapter(preset: str) -> ModelAdapter:
     """Resolve a preset name from the launcher env contract
-    (``NEXUS_MODEL_PRESET``): ``mnist`` or any LlamaConfig preset."""
+    (``NEXUS_MODEL_PRESET``): ``mnist``, any LlamaConfig preset, or a
+    ``moe_``-prefixed / MoeConfig preset (``moe_tiny``, ``nexus_moe``,
+    ``mixtral_8x7b``)."""
+    def _factory(cls, name):
+        return getattr(cls, name) if isinstance(vars(cls).get(name), staticmethod) else None
+
     if preset == "mnist":
         return MnistAdapter()
-    factory = getattr(LlamaConfig, preset, None)
-    if factory is None:
-        known = ["mnist"] + [
-            n for n in vars(LlamaConfig) if isinstance(vars(LlamaConfig)[n], staticmethod)
-        ]
-        raise KeyError(f"unknown model preset {preset!r}; known: {sorted(known)}")
-    return LlamaAdapter(config=factory())
+    # Llama presets win bare names ("tiny" is Llama's); MoE presets resolve
+    # by their own names (nexus_moe, mixtral_8x7b) or a "moe_" prefix
+    # (moe_tiny) so both families' short names stay addressable
+    llama_factory = _factory(LlamaConfig, preset)
+    if llama_factory is not None:
+        return LlamaAdapter(config=llama_factory())
+    moe_name = preset[len("moe_"):] if preset.startswith("moe_") else preset
+    moe_factory = _factory(MoeConfig, moe_name)
+    if moe_factory is not None:
+        return MoeAdapter(config=moe_factory())
+    known = (
+        ["mnist"]
+        + [n for n in vars(LlamaConfig) if isinstance(vars(LlamaConfig)[n], staticmethod)]
+        + [f"moe_{n}" for n in vars(MoeConfig) if isinstance(vars(MoeConfig)[n], staticmethod)]
+    )
+    raise KeyError(f"unknown model preset {preset!r}; known: {sorted(known)}")
