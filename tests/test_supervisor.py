@@ -417,6 +417,57 @@ async def test_live_injected_event_storm_all_processed():
     assert summary["p50"] <= summary["p95"] <= summary["max"]
 
 
+async def test_duplicate_preemption_events_count_once():
+    """One preemption incident fans out to N hosts' events; restart_count
+    must record ONE preemption (PREEMPTED -> PREEMPTED duplicates are
+    suppressed — a genuine second preemption passes through RUNNING first)."""
+    rid = str(uuid.uuid4())
+    pod = pod_obj(rid)
+    fx = Fixture({"Job": [job_obj(rid)], "Pod": [pod]})
+    seed_checkpoint(fx.store, rid, LifecycleStage.RUNNING)
+    task = asyncio.create_task(fx.supervisor.start(fx.ctx))
+    await asyncio.sleep(0.05)
+    for host in range(8):
+        evt = event_obj("TPUPreempted", f"host-{host} preempted", "Pod", pod["metadata"]["name"])
+        evt["metadata"]["name"] = f"evt-preempt-{host}"
+        fx.client.inject("ADDED", "Event", evt)
+    assert await fx.supervisor.idle(timeout=10)
+    fx.ctx.cancel()
+    await task
+    cp = fx.store.read_checkpoint(ALGORITHM, rid)
+    assert cp.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert cp.restart_count == 1
+    assert not fx.client.deleted("Job")
+
+
+async def test_second_preemption_outside_window_counts_again():
+    """A preemption landing on a PREEMPTED run with a STALE ledger write is
+    a new incident (the replacement pod was reclaimed before the workload
+    ever heartbeated) — it must increment restart_count, not be suppressed."""
+    from datetime import datetime, timezone
+
+    rid = str(uuid.uuid4())
+    pod = pod_obj(rid)
+    fx = Fixture({"Job": [job_obj(rid)], "Pod": [pod]})
+    cp = CheckpointedRequest(
+        algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.PREEMPTED, restart_count=1
+    )
+    cp.last_modified = datetime(2026, 1, 1, tzinfo=timezone.utc)  # long ago
+    fx.store.upsert_checkpoint(cp)
+    task = asyncio.create_task(fx.supervisor.start(fx.ctx))
+    await asyncio.sleep(0.05)
+    fx.client.inject(
+        "ADDED", "Event",
+        event_obj("TPUPreempted", "reclaimed again", "Pod", pod["metadata"]["name"]),
+    )
+    assert await fx.supervisor.idle(timeout=10)
+    fx.ctx.cancel()
+    await task
+    got = fx.store.read_checkpoint(ALGORITHM, rid)
+    assert got.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert got.restart_count == 2
+
+
 async def test_latency_percentile_gauges_exported():
     """Every 16th executed decision exports p50/p95 gauges to the metrics
     plane (VERDICT r1 weak #8: the north-star number must not live only in an

@@ -42,32 +42,32 @@ ProcessFn = Callable[[In], Union[Out, Awaitable[Out]]]
 class TokenBucket:
     """Async token bucket: `rate` tokens/s, capacity `burst`.
 
+    Virtual-slot (GCRA-style) implementation: each acquire is assigned its
+    admission time under the lock — in strict arrival order, so waiters are
+    FIFO and cannot be starved by newcomers — then sleeps OUTSIDE the lock
+    until its slot.  One sleep per acquire, no re-check loop, no thundering
+    herd, and burst capacity is spendable at once (the slot floor trails
+    `now` by (burst-1)/rate, which is exactly "burst tokens available after
+    idle refill").
+
     rate <= 0 disables limiting (always admits immediately).
     """
 
     def __init__(self, rate: float, burst: int) -> None:
         self.rate = float(rate)
         self.burst = max(1, int(burst)) if rate > 0 else 0
-        self._tokens = float(self.burst)
-        self._last = time.monotonic()
+        self._next_slot = 0.0
         self._lock = asyncio.Lock()
 
     async def acquire(self) -> None:
         if self.rate <= 0:
             return
-        while True:
-            # the lock guards only the token arithmetic; the SLEEP happens
-            # outside it, so waiters park concurrently and a refilled bucket
-            # admits newcomers immediately instead of queueing them behind a
-            # sleeper — burst stays meaningful under contention
-            async with self._lock:
-                now = time.monotonic()
-                self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
-                self._last = now
-                if self._tokens >= 1.0:
-                    self._tokens -= 1.0
-                    return
-                wait = (1.0 - self._tokens) / self.rate
+        async with self._lock:
+            now = time.monotonic()
+            slot = max(self._next_slot, now - (self.burst - 1) / self.rate)
+            self._next_slot = slot + 1.0 / self.rate
+        wait = slot - now
+        if wait > 0:
             await asyncio.sleep(wait)
 
 

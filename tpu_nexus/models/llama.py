@@ -179,6 +179,23 @@ def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def attention_block(x, layer, cfg, cos, sin, attn_fn) -> jax.Array:
+    """Pre-norm GQA attention sub-block (norm → qkv → RoPE → attention →
+    output projection → residual), shared by the Llama and MoE families —
+    ``cfg`` needs only dtype/norm_eps.  The attention impl (flash VJP, dense,
+    ring) names its own output "attn_out" for the remat policy; naming it
+    again here would store the buffer twice."""
+    ct = cfg.dtype
+    h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
+    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
+    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+    q = _rope(q, cos, sin)
+    k = _rope(k, cos, sin)
+    o = attn_fn(q, k, v, causal=True)
+    return x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+
+
 def llama_head(params: Dict[str, Any], cfg: LlamaConfig) -> jax.Array:
     """The output projection ``[E, vocab]`` (tied or untied)."""
     if cfg.tied_embeddings:
@@ -215,17 +232,7 @@ def llama_hidden(
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     def block(x, layer):
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
-        q = _rope(q, cos, sin)
-        k = _rope(k, cos, sin)
-        # each attention impl (flash VJP residual, dense, ring) names its own
-        # output "attn_out"; naming again here would store the buffer twice
-        # under the save_only_these_names remat policy
-        o = attn_fn(q, k, v, causal=True)
-        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
         up = jnp.einsum("bse,ef->bsf", h, layer["w_up"].astype(ct))

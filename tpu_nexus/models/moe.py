@@ -32,7 +32,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
-from tpu_nexus.models.llama import _rope, rope_tables
+from tpu_nexus.models.llama import attention_block, rope_tables
 from tpu_nexus.ops.rmsnorm import rms_norm
 
 AttnFn = Any
@@ -238,14 +238,7 @@ def moe_hidden(
 
     def block(carry, layer):
         x, lb, rz = carry
-        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-        kk = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
-        q = _rope(q, cos, sin)
-        kk = _rope(kk, cos, sin)
-        o = attn_fn(q, kk, v, causal=True)
-        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         ffn_out, aux = moe_ffn(h, layer, cfg)
         x = x + ffn_out

@@ -76,6 +76,11 @@ class ProcessingConfig:
     #: leash for runs that have never heartbeated (long first XLA compile);
     #: None = 3x the stale window
     watchdog_first_progress_grace: Optional[timedelta] = None
+    #: preemption events landing on an already-PREEMPTED run within this
+    #: window of its last ledger write are the same incident's multi-host
+    #: fan-out (suppressed); outside it they count as a NEW preemption (the
+    #: replacement pod reclaimed before the workload ever heartbeated)
+    preemption_dedup_window: timedelta = timedelta(seconds=30)
 
 
 class Supervisor:
@@ -127,6 +132,7 @@ class Supervisor:
     # -- wiring (reference Init, services/supervisor.go:106-135) -------------
 
     def init(self, config: ProcessingConfig) -> None:
+        self._preempt_dedup_s = config.preemption_dedup_window.total_seconds()
         self._actor = PipelineStageActor(
             "run_status_analysis",
             tags={"namespace": self.namespace},
@@ -167,6 +173,19 @@ class Supervisor:
                 logger=self._log,
                 metrics=self._metrics,
             )
+
+    def _is_same_preemption(self, checkpoint: CheckpointedRequest) -> bool:
+        """Already-PREEMPTED + recent ledger write => same incident's
+        multi-host event fan-out; stale => a new preemption incident."""
+        if checkpoint.last_modified is None:
+            return True  # no timestamp to distinguish: safe side is suppress
+        from datetime import datetime, timezone
+
+        last = checkpoint.last_modified
+        if last.tzinfo is None:
+            last = last.replace(tzinfo=timezone.utc)
+        age = (datetime.now(timezone.utc) - last).total_seconds()
+        return age < self._preempt_dedup_s
 
     def _resolve_run_kind(self, request_id: str) -> str:
         """JobSet when the run's resource is a cached JobSet, else Job —
@@ -319,7 +338,18 @@ class Supervisor:
         elif result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
             # TPU policy axis: no delete — record preemption and let the
             # JobSet restart policy / launcher resume from the tensor
-            # checkpoint (SURVEY §7.4)
+            # checkpoint (SURVEY §7.4).
+            if checkpoint.lifecycle_stage == LifecycleStage.PREEMPTED and self._is_same_preemption(checkpoint):
+                # one preemption incident fans out to N hosts' events within
+                # seconds; counting each would inflate restart_count N-fold
+                # (found by the chaos storm test).  Outside the dedup window
+                # it IS a new incident — the replacement pod was reclaimed
+                # before the workload ever heartbeated RUNNING — and counts.
+                self._log.v(1).info(
+                    "duplicate preemption event; already PREEMPTED",
+                    request_id=result.request_id,
+                )
+                return result
             updated.lifecycle_stage = stage
             updated.algorithm_failure_cause = result.run_status_message
             updated.algorithm_failure_details = result.run_status_trace
