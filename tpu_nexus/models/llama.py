@@ -179,12 +179,15 @@ def _rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
     return out.astype(x.dtype)
 
 
-def attention_block(x, layer, cfg, cos, sin, attn_fn) -> jax.Array:
+def attention_block(x, layer, cfg, cos, sin, attn_fn, *, collect_kv: bool = False):
     """Pre-norm GQA attention sub-block (norm → qkv → RoPE → attention →
     output projection → residual), shared by the Llama and MoE families —
     ``cfg`` needs only dtype/norm_eps.  The attention impl (flash VJP, dense,
     ring) names its own output "attn_out" for the remat policy; naming it
-    again here would store the buffer twice."""
+    again here would store the buffer twice.
+
+    ``collect_kv=True`` additionally returns the (post-RoPE) K/V — the
+    prefill path of KV-cache decoding (models/generate.py)."""
     ct = cfg.dtype
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
@@ -193,7 +196,10 @@ def attention_block(x, layer, cfg, cos, sin, attn_fn) -> jax.Array:
     q = _rope(q, cos, sin)
     k = _rope(k, cos, sin)
     o = attn_fn(q, k, v, causal=True)
-    return x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+    x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+    if collect_kv:
+        return x, (k, v)
+    return x
 
 
 def llama_head(params: Dict[str, Any], cfg: LlamaConfig) -> jax.Array:
@@ -211,13 +217,17 @@ def llama_hidden(
     positions: Optional[jax.Array] = None,
     attn_fn: Optional[AttnFn] = None,
     attn_impl: str = "auto",
-) -> jax.Array:
+    return_kv: bool = False,
+):
     """Final-norm hidden states ``[B, S, E]`` — the pre-head forward.
 
     Split from :func:`llama_forward` so the training loss can project to
     vocab in CHUNKS (chunked cross-entropy): materializing full f32 logits
     ``[B, S, vocab]`` plus their gradient costs gigabytes at 32k+ vocab and
     caps the batch size a chip can hold.
+
+    ``return_kv=True`` → ``(hidden, (k, v))`` with K/V stacked per layer
+    ``[L, B, S, Hkv, D]`` (decode prefill).
     """
     if positions is None:
         positions = jnp.broadcast_to(
@@ -232,12 +242,12 @@ def llama_hidden(
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     def block(x, layer):
-        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
+        x, kv = attention_block(x, layer, cfg, cos, sin, attn_fn, collect_kv=True)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
         up = jnp.einsum("bse,ef->bsf", h, layer["w_up"].astype(ct))
         x = x + jnp.einsum("bsf,fe->bse", jax.nn.silu(gate) * up, layer["w_down"].astype(ct))
-        return x, None
+        return x, (kv if return_kv else None)
 
     body = block
     if cfg.remat:
@@ -250,9 +260,12 @@ def llama_hidden(
             "nothing": jax.checkpoint_policies.nothing_saveable,
         }
         body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
-    x, _ = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
+    x, kv = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
 
-    return rms_norm(x, params["out_norm"], cfg.norm_eps)
+    hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    if return_kv:
+        return hidden, kv
+    return hidden
 
 
 def llama_forward(
