@@ -99,6 +99,27 @@ async def test_token_bucket_burst_admits_concurrently():
     assert 0.02 <= elapsed < 0.5
 
 
+async def test_token_bucket_refunds_cancelled_waiters():
+    """A cancelled waiter must hand its admission slot back: a burst of
+    cancellations (task teardown) must not throttle later acquires for work
+    that never ran (ADVICE r2)."""
+    import asyncio
+
+    bucket = TokenBucket(rate=10.0, burst=1)
+    await bucket.acquire()  # spend the burst token; bucket now drained
+    # 20 waiters would reserve slots 2s into the future...
+    waiters = [asyncio.create_task(bucket.acquire()) for _ in range(20)]
+    await asyncio.sleep(0.01)
+    for w in waiters:
+        w.cancel()
+    await asyncio.gather(*waiters, return_exceptions=True)
+    # ...but every cancelled slot was refunded, so a fresh acquire waits at
+    # most ~1 refill (100ms), not the 2s the abandoned slots reserved
+    t0 = time.monotonic()
+    await bucket.acquire()
+    assert time.monotonic() - t0 < 0.5
+
+
 async def test_rate_limited_actor_respects_rate():
     done = []
     actor = PipelineStageActor(

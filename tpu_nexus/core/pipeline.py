@@ -68,7 +68,22 @@ class TokenBucket:
             self._next_slot = slot + 1.0 / self.rate
         wait = slot - now
         if wait > 0:
-            await asyncio.sleep(wait)
+            try:
+                await asyncio.sleep(wait)
+            except asyncio.CancelledError:
+                # refund the abandoned slot: without this a burst of cancelled
+                # waiters (task teardown) advances _next_slot far into the
+                # future and throttles later acquires for work that never ran.
+                # Single assignment on the event-loop thread — no lock needed.
+                # Accepted tradeoff: the freed instant is this waiter's, but
+                # the refund shrinks the TAIL, so when OTHER waiters are still
+                # sleeping a fresh acquire can land on the same instant as one
+                # of them — a transient simultaneous admission, bounded by the
+                # number of cancellations, with the average rate preserved.
+                # Exact hole tracking would need a reservation heap; not
+                # worth it for a work-queue throttle.
+                self._next_slot -= 1.0 / self.rate
+                raise
 
 
 class PipelineStageActor(Generic[In, Out]):

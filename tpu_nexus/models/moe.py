@@ -198,8 +198,12 @@ def moe_ffn(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     u = jnp.einsum("Ece,Eef->Ecf", buf, layer["w_up"].astype(ct))
     out_buf = jnp.einsum("Ecf,Efe->Ece", jax.nn.silu(g) * u, layer["w_down"].astype(ct))
 
-    # gather each assignment's expert output, weight by its gate
-    picked = out_buf[eidx.reshape(-1), cap_idx.reshape(-1)].reshape(t, k, e)
+    # gather each assignment's expert output, weight by its gate.  The gather
+    # uses an explicitly in-range index (overflow assignments are masked to
+    # zero by `keep` below anyway) — the dumpster row `cap` exists only for
+    # the scatter, and out_buf has already been sliced to [E, C, e].
+    gather_idx = jnp.minimum(cap_idx, cap - 1)
+    picked = out_buf[eidx.reshape(-1), gather_idx.reshape(-1)].reshape(t, k, e)
     combined = jnp.sum(picked * (gate * keep)[..., None].astype(ct), axis=1)
 
     # aux losses (Switch): load balance on ALL assignments, z-loss on logits

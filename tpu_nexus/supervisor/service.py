@@ -54,6 +54,17 @@ from tpu_nexus.supervisor.taxonomy import (
 DEFAULT_RESYNC = timedelta(seconds=30)  # reference services/supervisor.go:70
 
 
+class _RunLock:
+    """Per-run lock entry with an explicit holder/waiter refcount, so
+    eviction never has to introspect private asyncio.Lock attributes."""
+
+    __slots__ = ("lock", "refs")
+
+    def __init__(self) -> None:
+        self.lock = asyncio.Lock()
+        self.refs = 0
+
+
 @dataclass
 class ProcessingConfig:
     """Actor knobs (reference ProcessingConfig, services/supervisor.go:41-47;
@@ -121,7 +132,13 @@ class Supervisor:
         # per-run serialization: a 16-host event storm produces N concurrent
         # decisions for one run; first-writer-wins requires the guard-read and
         # the commit to be atomic per (algorithm, id) (SURVEY §7.4)
-        self._run_locks: Dict[tuple, asyncio.Lock] = {}
+        self._run_locks: Dict[tuple, _RunLock] = {}
+        # per-run monotonic timestamp of the last COUNTED preemption commit.
+        # The dedup decision must not depend on workload-written wall clocks
+        # (`last_modified` comes from the run hosts' clocks; a future-skewed
+        # host would suppress a genuine second preemption) — same reasoning
+        # as the watchdog's monotonic-only staleness rule (watchdog.py).
+        self._preempt_seen: Dict[tuple, float] = {}
         # observability counters (tests + metrics)
         self.events_seen = 0
         self.events_filtered = 0
@@ -174,18 +191,36 @@ class Supervisor:
                 metrics=self._metrics,
             )
 
-    def _is_same_preemption(self, checkpoint: CheckpointedRequest) -> bool:
-        """Already-PREEMPTED + recent ledger write => same incident's
-        multi-host event fan-out; stale => a new preemption incident."""
-        if checkpoint.last_modified is None:
-            return True  # no timestamp to distinguish: safe side is suppress
-        from datetime import datetime, timezone
+    def _is_same_preemption(self, key: tuple) -> bool:
+        """Already-PREEMPTED run: is this event the same incident's multi-host
+        fan-out, or a new preemption?
 
-        last = checkpoint.last_modified
-        if last.tzinfo is None:
-            last = last.replace(tzinfo=timezone.utc)
-        age = (datetime.now(timezone.utc) - last).total_seconds()
-        return age < self._preempt_dedup_s
+        Judged purely from this supervisor's monotonic clock at the moment it
+        COUNTED the last preemption for this run (`_preempt_seen`) — never
+        from ledger `last_modified`, which workload hosts write from their
+        own wall clocks.  No recorded commit (e.g. the row was PREEMPTED by a
+        previous supervisor process) => a new incident; it is counted, which
+        at worst over-counts one restart across a supervisor restart rather
+        than suppressing a real preemption indefinitely."""
+        seen = self._preempt_seen.get(key)
+        if seen is None:
+            return False
+        if (time.monotonic() - seen) >= self._preempt_dedup_s:
+            # outside the window the record is dead weight — prune on consult
+            del self._preempt_seen[key]
+            return False
+        return True
+
+    def _record_preemption(self, key: tuple) -> None:
+        now = time.monotonic()
+        # opportunistic sweep: entries older than the window can never
+        # suppress anything, so a run abandoned without a terminal decision
+        # must not pin its entry for the process lifetime
+        if len(self._preempt_seen) > 1024:
+            stale = [k for k, t in self._preempt_seen.items() if now - t >= self._preempt_dedup_s]
+            for k in stale:
+                del self._preempt_seen[k]
+        self._preempt_seen[key] = now
 
     def _resolve_run_kind(self, request_id: str) -> str:
         """JobSet when the run's resource is a cached JobSet, else Job —
@@ -237,19 +272,21 @@ class Supervisor:
 
     async def _supervise_action(self, result: RunStatusAnalysisResult) -> RunStatusAnalysisResult:
         key = (result.algorithm_name, result.request_id)
-        lock = self._run_locks.setdefault(key, asyncio.Lock())
+        entry = self._run_locks.get(key)
+        if entry is None:
+            entry = self._run_locks[key] = _RunLock()
+        entry.refs += 1  # holder-or-waiter count, maintained by us alone
         try:
-            async with lock:
-                return await self._supervise_action_locked(result)
+            async with entry.lock:
+                return await self._supervise_action_locked(result, key)
         finally:
-            # evict the lock when idle (no holder, no waiters) so per-run
+            # evict the entry when the last holder/waiter leaves, so per-run
             # state does not accumulate over the supervisor's lifetime; a
-            # later decision simply creates a fresh lock
-            if (
-                self._run_locks.get(key) is lock
-                and not lock.locked()
-                and not getattr(lock, "_waiters", None)
-            ):
+            # later decision simply creates a fresh lock.  Refcount is ours
+            # (no private asyncio.Lock attributes), so a stdlib change cannot
+            # turn this into a use-after-evict race.
+            entry.refs -= 1
+            if entry.refs == 0 and self._run_locks.get(key) is entry:
                 del self._run_locks[key]
 
     def _reenrich(self, result: RunStatusAnalysisResult) -> RunStatusAnalysisResult:
@@ -289,7 +326,9 @@ class Supervisor:
         result.hlo_trace_ref = extract_hlo_trace_ref(text) or result.hlo_trace_ref
         return result
 
-    async def _supervise_action_locked(self, result: RunStatusAnalysisResult) -> RunStatusAnalysisResult:
+    async def _supervise_action_locked(
+        self, result: RunStatusAnalysisResult, key: tuple
+    ) -> RunStatusAnalysisResult:
         result = self._reenrich(result)
         checkpoint = await asyncio.to_thread(
             self._store.read_checkpoint, result.algorithm_name, result.request_id
@@ -311,9 +350,9 @@ class Supervisor:
                 request_id=result.request_id,
                 stage=checkpoint.lifecycle_stage,
             )
-            # the run is terminal: its lock will never be needed again
-            # (stragglers re-read and hit this guard)
-            self._run_locks.pop((result.algorithm_name, result.request_id), None)
+            # the run is terminal: drop its dedup state (the refcounted lock
+            # entry evicts itself when the last straggler leaves)
+            self._preempt_seen.pop(key, None)
             return result
 
         updated = checkpoint.deep_copy()  # mutation discipline (reference :281)
@@ -339,7 +378,7 @@ class Supervisor:
             # TPU policy axis: no delete — record preemption and let the
             # JobSet restart policy / launcher resume from the tensor
             # checkpoint (SURVEY §7.4).
-            if checkpoint.lifecycle_stage == LifecycleStage.PREEMPTED and self._is_same_preemption(checkpoint):
+            if checkpoint.lifecycle_stage == LifecycleStage.PREEMPTED and self._is_same_preemption(key):
                 # one preemption incident fans out to N hosts' events within
                 # seconds; counting each would inflate restart_count N-fold
                 # (found by the chaos storm test).  Outside the dedup window
@@ -360,6 +399,16 @@ class Supervisor:
             updated.hlo_trace_ref = result.hlo_trace_ref
         updated.touch()
         await asyncio.to_thread(self._store.upsert_checkpoint, updated)
+        if updated.is_finished():
+            # run just went terminal: drop its preemption-dedup record too,
+            # or every preempted-then-terminated run would leak one entry
+            # for the supervisor's lifetime
+            self._preempt_seen.pop(key, None)
+        if result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
+            # record the COUNTED preemption only after the commit landed —
+            # a failed upsert is re-delivered by the actor and must not be
+            # suppressed as its own duplicate
+            self._record_preemption(key)
         self.decisions_executed += 1
         if result.detected_at:
             latency = time.perf_counter() - result.detected_at
