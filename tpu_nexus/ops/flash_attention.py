@@ -1,18 +1,26 @@
 """Pallas TPU flash attention: fused forward AND backward kernels.
 
 Forward: online-softmax attention — scores never materialize in HBM, K/V
-stream through VMEM block-by-block, f32 accumulation on the MXU; emits the
+stream through VMEM one BLOCK_K tile per grid step (a KV grid axis, minor-
+most so it iterates sequentially per Q block) with the softmax carry
+(acc/m/l) in f32 VMEM scratch that persists across KV steps; emits the
 per-row logsumexp ``L`` as a residual.  Backward: the standard flash
-recurrence (Dao et al. formulation) as two kernels — dQ (grid over Q blocks,
-streaming K/V) and dK/dV (grid over KV blocks × (GQA head, Q block), one
-BLOCK_Q tile in VMEM at a time with f32 scratch accumulation) — recomputing
-probabilities from ``L`` so the ``[S, S]`` score matrix never exists in
-either pass.  This is what keeps HBM flat at long sequence:
+recurrence (Dao et al. formulation) as two kernels — dQ (KV grid axis,
+f32 dQ scratch accumulator) and dK/dV (grid over KV blocks × (GQA head,
+Q block), one BLOCK_Q tile in VMEM at a time with f32 scratch accumulation)
+— recomputing probabilities from ``L`` so the ``[S, S]`` score matrix never
+exists in either pass.  This is what keeps HBM flat at long sequence:
 the XLA fallback backward materializes B·H·S² f32, which at seq 2048 / batch
 8 is gigabytes.
 
-Causality skips whole blocks on both sides of the diagonal via dynamic
-fori_loop trip counts.
+Every kernel holds O(BLOCK) state in VMEM — no whole-sequence K/V staging —
+so single-chip sequence length is HBM-bound, not VMEM-bound (the r2 16k cap
+is gone; 32k+ runs single-chip).
+
+Causality skips off-diagonal blocks two ways: dead (q above diagonal) grid
+steps clamp their BlockSpec index maps to the last live block, so pallas's
+revisit optimization elides the DMA, and `pl.when` elides the compute; only
+diagonal-band blocks pay the iota/compare/select mask passes.
 
 Shapes: q [B, S, Hq, D], k/v [B, S, Hkv, D]; Hq % Hkv == 0; D % 128 == 0;
 S % 128 == 0; self-attention (sq == sk).
@@ -31,12 +39,18 @@ from jax.experimental.pallas import tpu as pltpu
 from tpu_nexus.ops.attention import checkpoint_name as _checkpoint_name
 from tpu_nexus.ops.attention import dense_attention
 
-# Default tile edge.  512 is ~18x faster than 128 on v5e for the forward at
-# bench shapes (B16 H16 S2048 D128): small tiles leave the kernel bound on
-# fori_loop bookkeeping and VPU softmax passes instead of the MXU.  Shorter
-# sequences clamp down via _block_for (power-of-two divisor of S >= 128).
-BLOCK_Q = 512
-BLOCK_K = 512
+# Default tile edges.  Swept on a real v5e at r3 (PERF.md seq-scaling
+# section): 1024x1024 beats 512x512 at every seq 2k-32k (8% at 2k, 45% at
+# 32k) — the KV grid axis amortizes its per-step scratch carry
+# (read-modify-write of acc/m/l) over more MXU work per step, and fewer
+# steps mean less grid bookkeeping.  2048-wide K tiles blow the 16 MB
+# scoped-VMEM budget in the dK/dV kernel.  Tiny tiles (128) are ~18x slower
+# at bench shapes.  Shorter sequences clamp down via _block_for
+# (power-of-two divisor of S >= 128).  Env overrides for tuning sweeps.
+import os as _os
+
+BLOCK_Q = int(_os.environ.get("NEXUS_FLASH_BLOCK_Q", 1024))
+BLOCK_K = int(_os.environ.get("NEXUS_FLASH_BLOCK_K", 1024))
 _NEG_INF = -1e30
 
 
@@ -55,7 +69,10 @@ def _on_tpu() -> bool:
 
 
 def flash_supported(q, k, v) -> bool:
-    """Shapes the kernels handle; callers fall back to XLA otherwise."""
+    """Shapes the kernels handle; callers fall back to XLA otherwise.
+
+    No VMEM-budget clause: K/V stream block-by-block through a KV grid
+    axis, so per-program VMEM is O(BLOCK) at any sequence length."""
     b, s, hq, d = q.shape
     sk = k.shape[1]
     return (
@@ -68,73 +85,94 @@ def flash_supported(q, k, v) -> bool:
         # the XLA path)
         and s == sk
         and hq % k.shape[2] == 0
-        # full K/V per kv-head must sit in VMEM next to q/acc blocks
-        and sk * d * k.dtype.itemsize <= 4 * 1024 * 1024
     )
 
 
 # -- forward -------------------------------------------------------------------
 
 
-def _fwd_kernel(
-    q_ref, k_ref, v_ref, o_ref, l_ref,
-    *, scale: float, causal: bool, s_k: int, block_q: int, block_k: int,
-):
-    qi = pl.program_id(2)
-    # fold scale into q once ([block_q, D]) instead of into every
-    # [block_q, block_k] score block — saves a full VPU pass per block
-    q = (q_ref[0, 0, :, :].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    n_k_blocks = s_k // block_k
-    if causal:
-        # blocks wholly past the diagonal contribute nothing — don't visit;
-        # blocks wholly before it need no mask.  Only the diagonal band pays
-        # the iota/compare/select VPU passes.
-        n_full = qi * block_q // block_k
-        n_k_blocks = jnp.minimum(n_k_blocks, ((qi + 1) * block_q + block_k - 1) // block_k)
-    else:
-        n_full = n_k_blocks
+def _causal_band(qi, ki, block_q: int, block_k: int):
+    """(full, masked) liveness of KV block `ki` for Q block `qi`: `full`
+    blocks sit wholly at-or-below the diagonal (no mask needed), `masked`
+    blocks straddle it; anything else is dead."""
+    full = qi * block_q >= (ki + 1) * block_k
+    masked = jnp.logical_and((qi + 1) * block_q > ki * block_k, jnp.logical_not(full))
+    return full, masked
 
-    def body(kb, carry, *, masked):
-        acc, m, l = carry
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]  # [block_k, D]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+
+def _kv_index_fn(g: int, causal: bool, block_q: int, block_k: int):
+    """K/V BlockSpec index map over grid (b, h, qi, ki).  Under causal
+    masking, dead steps (ki past the diagonal) clamp to the last live block
+    so the revisit optimization skips their DMA."""
+    if causal:
+        def _index(bi, h, qi, ki):
+            return (bi, h // g, jnp.minimum(ki, ((qi + 1) * block_q - 1) // block_k), 0)
+    else:
+        def _index(bi, h, qi, ki):
+            return (bi, h // g, ki, 0)
+    return _index
+
+
+def _fwd_kernel(
+    q_ref, k_ref, v_ref, o_ref, l_ref, acc_ref, m_ref, lsum_ref,
+    *, causal: bool, n_kv_blocks: int, block_q: int, block_k: int,
+):
+    """One (Q block, KV block) grid step of the online softmax.  The carry
+    (acc/m/l) lives in f32 VMEM scratch persisting across the minor-most KV
+    grid axis; o/l flush once on the final KV step (their BlockSpecs ignore
+    ki, so the write stays in VMEM until the Q block changes)."""
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        lsum_ref[...] = jnp.zeros_like(lsum_ref)
+
+    def compute(masked):
+        # q arrives PRE-SCALED (folded once in XLA before the kernel, see
+        # _flash_forward) — no per-KV-step upcast/multiply/downcast here
+        q = q_ref[0, 0, :, :]
+        k_blk = k_ref[0, 0, :, :]  # [block_k, D]
+        v_blk = v_ref[0, 0, :, :]
         scores = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [block_q, block_k]; scale pre-folded into q
         if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
+        m = m_ref[...]
         m_blk = jnp.max(scores, axis=1, keepdims=True)  # [block_q, 1]
         m_new = jnp.maximum(m, m_blk)
         alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
         p = jnp.exp(scores - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=1, keepdims=True)
+        lsum_ref[...] = lsum_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        acc = acc * alpha + pv
-        return acc, m_new, l_new
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
 
-    d = q.shape[-1]
-    init = (
-        jnp.zeros((block_q, d), jnp.float32),
-        jnp.full((block_q, 1), _NEG_INF, jnp.float32),
-        jnp.zeros((block_q, 1), jnp.float32),
-    )
-    carry = jax.lax.fori_loop(0, n_full, functools.partial(body, masked=False), init)
-    acc, m, l = jax.lax.fori_loop(
-        n_full, n_k_blocks, functools.partial(body, masked=causal), carry
-    )
-    l_safe = jnp.maximum(l, 1e-30)
-    o_ref[0, 0, :, :] = (acc / l_safe).astype(o_ref.dtype)
-    # logsumexp residual for the backward recomputation: L = m + log(l).
-    # Kept [..., 1]-shaped: TPU block tiling wants the last two dims to be
-    # (8k, array-dim) — (BLOCK_Q, 1) qualifies, a bare [S] block would not.
-    l_ref[0, 0, :, :] = m + jnp.log(l_safe)
+    if causal:
+        full, masked = _causal_band(qi, ki, block_q, block_k)
+        pl.when(full)(lambda: compute(False))
+        pl.when(masked)(lambda: compute(True))
+    else:
+        compute(False)
+
+    @pl.when(ki == n_kv_blocks - 1)
+    def _finalize():
+        l_safe = jnp.maximum(lsum_ref[...], 1e-30)
+        o_ref[0, 0, :, :] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+        # logsumexp residual for the backward recomputation: L = m + log(l).
+        # Kept [..., 1]-shaped: TPU block tiling wants the last two dims to
+        # be (8k, array-dim) — (BLOCK_Q, 1) qualifies, a bare [S] would not.
+        l_ref[0, 0, :, :] = m_ref[...] + jnp.log(l_safe)
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
@@ -143,14 +181,18 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
     g = hq // hkv
     block_q = _block_for(s, BLOCK_Q)
     block_k = _block_for(s_k, BLOCK_K)
-    # kernel layout [B, H, S, D]
-    qt = jnp.swapaxes(q, 1, 2)
+    n_kv = s_k // block_k
+    # kernel layout [B, H, S, D]; softmax scale folded into q ONCE here (XLA
+    # fuses it into the transpose copy) instead of per KV grid step in the
+    # kernel — same f32-multiply-then-round as the in-kernel fold had
+    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale).astype(q.dtype)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    grid = (b, hq, s // block_q)
+    grid = (b, hq, s // block_q, n_kv)
+    kv_index = _kv_index_fn(g, causal, block_q, block_k)
     out, lse = pl.pallas_call(
         functools.partial(
-            _fwd_kernel, scale=scale, causal=causal, s_k=s_k,
+            _fwd_kernel, causal=causal, n_kv_blocks=n_kv,
             block_q=block_q, block_k=block_k,
         ),
         out_shape=(
@@ -159,14 +201,19 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // g, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi, ki: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), kv_index, memory_space=pltpu.VMEM),
         ],
         out_specs=(
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi, ki: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi, ki: (bi, h, qi, 0), memory_space=pltpu.VMEM),
         ),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * s * s_k * d // (2 if causal else 1),
             bytes_accessed=(qt.size + kt.size + vt.size) * q.dtype.itemsize * 2,
@@ -181,59 +228,64 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dq_ref,
-    *, scale: float, causal: bool, s_k: int, block_q: int, block_k: int,
+    q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dq_ref, dq_acc,
+    *, scale: float, causal: bool, n_kv_blocks: int, block_q: int, block_k: int,
 ):
-    """dQ = (P ∘ (dO·Vᵀ − D)) · K · scale, streamed over K blocks."""
+    """dQ = (P ∘ (dO·Vᵀ − D)) · K · scale, one KV block per grid step with
+    the dQ accumulator in f32 VMEM scratch across the minor-most KV axis."""
     qi = pl.program_id(2)
-    # scale folded into q (for the scores dot); the dS·K chain factor is
-    # applied once to the [block_q, D] accumulator at the end instead of to
-    # every [block_q, block_k] dS block
-    q = (q_ref[0, 0, :, :].astype(jnp.float32) * scale).astype(q_ref.dtype)
-    do = do_ref[0, 0, :, :]
-    lse = l_ref[0, 0, :, :]  # [block_q, 1]
-    dsum = dsum_ref[0, 0, :, :]  # [block_q, 1]
-    n_k_blocks = s_k // block_k
-    if causal:
-        n_full = qi * block_q // block_k
-        n_k_blocks = jnp.minimum(n_k_blocks, ((qi + 1) * block_q + block_k - 1) // block_k)
-    else:
-        n_full = n_k_blocks
+    ki = pl.program_id(3)
 
-    def body(kb, dq_acc, *, masked):
-        k_blk = k_ref[0, 0, pl.ds(kb * block_k, block_k), :]
-        v_blk = v_ref[0, 0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    def compute(masked):
+        # q arrives pre-scaled (for the scores dot); the dS·K chain factor
+        # is applied once to the [block_q, D] accumulator at flush instead
+        # of to every [block_q, block_k] dS block
+        q = q_ref[0, 0, :, :]
+        do = do_ref[0, 0, :, :]
+        lse = l_ref[0, 0, :, :]  # [block_q, 1]
+        dsum = dsum_ref[0, 0, :, :]  # [block_q, 1]
+        k_blk = k_ref[0, 0, :, :]
+        v_blk = v_ref[0, 0, :, :]
         scores = jax.lax.dot_general(
             q, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # scale pre-folded into q
         if masked:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
         p = jnp.exp(scores - lse)  # [block_q, block_k]
         dp = jax.lax.dot_general(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - dsum)  # dS·K chain scale applied once, at the end
-        return dq_acc + jax.lax.dot_general(
+        ds = p * (dp - dsum)
+        dq_acc[...] += jax.lax.dot_general(
             ds.astype(k_blk.dtype), k_blk,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
-    dq = jax.lax.fori_loop(
-        0, n_full, functools.partial(body, masked=False), jnp.zeros_like(q, jnp.float32)
-    )
-    dq = jax.lax.fori_loop(n_full, n_k_blocks, functools.partial(body, masked=causal), dq)
-    dq_ref[0, 0, :, :] = (dq * scale).astype(dq_ref.dtype)
+    if causal:
+        full, masked = _causal_band(qi, ki, block_q, block_k)
+        pl.when(full)(lambda: compute(False))
+        pl.when(masked)(lambda: compute(True))
+    else:
+        compute(False)
+
+    @pl.when(ki == n_kv_blocks - 1)
+    def _flush():
+        dq_ref[0, 0, :, :] = (dq_acc[...] * scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, l_ref, dsum_ref, dk_ref, dv_ref,
     dk_acc, dv_acc,
-    *, scale: float, causal: bool, n_q_blocks: int, group: int,
+    *, causal: bool, n_q_blocks: int, group: int,
     block_q: int, block_k: int,
 ):
     """dK/dV for one KV block.  The grid's two minor axes stream (GQA head,
@@ -254,11 +306,11 @@ def _bwd_dkv_kernel(
     def compute(masked):
         k_blk = k_ref[0, 0, :, :]  # [block_k, D]
         v_blk = v_ref[0, 0, :, :]
-        # scale folded into q: it feeds the scores dot (where S = scale·QKᵀ
+        # q arrives pre-scaled: it feeds the scores dot (where S = scale·QKᵀ
         # needs it) AND the dK accumulation (dK = scale·dSᵀ·Q — the same
         # factor), so no per-block [block_q, block_k] scale pass and no
         # flush-time multiply are needed anywhere
-        q_blk = (q_ref[0, 0, :, :].astype(jnp.float32) * scale).astype(q_ref.dtype)
+        q_blk = q_ref[0, 0, :, :]
         do_blk = do_ref[0, 0, :, :]
         lse = l_ref[0, 0, :, :]  # [block_q, 1]
         dsum = dsum_ref[0, 0, :, :]
@@ -293,8 +345,7 @@ def _bwd_dkv_kernel(
         # three-way split: dead blocks (q wholly above the diagonal) skipped,
         # diagonal-band blocks masked, blocks below the diagonal unmasked —
         # only the boundary pays the iota/compare/select VPU passes
-        full = qi * block_q >= (kb + 1) * block_k
-        live_masked = jnp.logical_and((qi + 1) * block_q > kb * block_k, jnp.logical_not(full))
+        full, live_masked = _causal_band(qi, kb, block_q, block_k)
         pl.when(full)(lambda: compute(False))
         pl.when(live_masked)(lambda: compute(True))
     else:
@@ -314,7 +365,9 @@ def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
     group = hq // hkv
     block_q = _block_for(s, BLOCK_Q)
     block_k = _block_for(s_k, BLOCK_K)
-    qt = jnp.swapaxes(q, 1, 2)
+    # scale folded into q once (as in the forward): serves the scores dots
+    # in both kernels and the dK = scale·dSᵀ·Q accumulation
+    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale).astype(q.dtype)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     dot = jnp.swapaxes(g_out, 1, 2)
@@ -323,22 +376,29 @@ def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
         dot.astype(jnp.float32) * out.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, Hq, S, 1]
 
+    n_kv = s_k // block_k
+    kv_index = _kv_index_fn(group, causal, block_q, block_k)
+
+    def _q_blk_index(bi, h, qi, ki):
+        return (bi, h, qi, 0)
+
     dq = pl.pallas_call(
         functools.partial(
-            _bwd_dq_kernel, scale=scale, causal=causal, s_k=s_k,
+            _bwd_dq_kernel, scale=scale, causal=causal, n_kv_blocks=n_kv,
             block_q=block_q, block_k=block_k,
         ),
         out_shape=jax.ShapeDtypeStruct((b, hq, s, d), q.dtype),
-        grid=(b, hq, s // block_q),
+        grid=(b, hq, s // block_q, n_kv),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // group, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, s_k, d), lambda bi, h, qi: (bi, h // group, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1, block_q, 1), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), _q_blk_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_k, d), kv_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, d), _q_blk_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), _q_blk_index, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, block_q, 1), _q_blk_index, memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, h, qi: (bi, h, qi, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec((1, 1, block_q, d), _q_blk_index, memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         interpret=interpret,
     )(qt, kt, vt, dot, lse, dsum)
 
@@ -355,7 +415,7 @@ def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
             return (bi, h * group + gi, qi, 0)
     dk, dv = pl.pallas_call(
         functools.partial(
-            _bwd_dkv_kernel, scale=scale, causal=causal,
+            _bwd_dkv_kernel, causal=causal,
             n_q_blocks=s // block_q, group=group,
             block_q=block_q, block_k=block_k,
         ),

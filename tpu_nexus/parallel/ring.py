@@ -48,20 +48,20 @@ _NEG_INF = -1e30
 # -- per-block primitives ------------------------------------------------------
 
 
-def _pallas_block_ok(s: int, sk: int, hq: int, hkv: int, d: int, itemsize: int) -> bool:
-    """Shapes the flash kernels handle for one ring block (LOCAL shards) —
-    including flash_supported's VMEM cap: the kernel stages the whole local
-    K/V per kv-head in VMEM, so very long local shards must fall back."""
+def _pallas_block_ok(s: int, sk: int, hq: int, hkv: int, d: int) -> bool:
+    """Shapes the flash kernels handle for one ring block (LOCAL shards).
+    No VMEM cap: the kernels stream K/V block-by-block over a KV grid axis
+    (flash_attention.py), so per-program VMEM is O(BLOCK) at any shard
+    length — the per-ring-step sequence ceiling is HBM-bound only."""
     return (
         d % 128 == 0
         and s % 128 == 0
         and sk == s  # equal local shards
         and hq % hkv == 0
-        and sk * d * itemsize <= 4 * 1024 * 1024
     )
 
 
-def _decide_use_pallas(impl: str, s: int, sk: int, hq: int, hkv: int, d: int, itemsize: int) -> bool:
+def _decide_use_pallas(impl: str, s: int, sk: int, hq: int, hkv: int, d: int) -> bool:
     """One decision point shared by ring_attention (local shards) and
     ring_attention_sharded (local shapes derived from the mesh) so the
     check_vma exemption below cannot drift from the kernel choice.
@@ -75,14 +75,14 @@ def _decide_use_pallas(impl: str, s: int, sk: int, hq: int, hkv: int, d: int, it
 
     if impl == "xla":
         return False
-    ok = _pallas_block_ok(s, sk, hq, hkv, d, itemsize)
+    ok = _pallas_block_ok(s, sk, hq, hkv, d)
     if impl == "pallas":
         if not ok:
             raise ValueError(
                 f"ring attention impl='pallas' unsupported for local shards "
                 f"(s={s}, sk={sk}, hq={hq}, hkv={hkv}, d={d}): need d%128==0, "
-                "s%128==0, equal local shards, hq%hkv==0, and local K/V "
-                "<= 4MB/kv-head — use impl='auto' to fall back to dense blocks"
+                "s%128==0, equal local shards, and hq%hkv==0 — use "
+                "impl='auto' to fall back to dense blocks"
             )
         return True
     return ok and _on_tpu()
@@ -327,7 +327,7 @@ def ring_attention(
     if interpret is None:
         interpret = not _on_tpu()
     use_pallas = _decide_use_pallas(
-        impl, q.shape[1], k.shape[1], q.shape[2], k.shape[2], q.shape[3], q.dtype.itemsize
+        impl, q.shape[1], k.shape[1], q.shape[2], k.shape[2], q.shape[3]
     )
     return _ring(q, k, v, axis_name, bool(causal), float(scale), use_pallas, bool(interpret))
 
@@ -365,7 +365,6 @@ def ring_attention_sharded(
         q.shape[2] // n_tp,
         max(1, k.shape[2] // n_tp),
         q.shape[3],
-        q.dtype.itemsize,
     )
     kwargs = {"mesh": mesh, "in_specs": (spec, spec, spec), "out_specs": spec}
     if will_use_pallas:
