@@ -21,7 +21,12 @@ Model: ``LlamaConfig.nexus_1b`` — ~1B params, head_dim 128 (pallas flash
 kernel on the hot path), bf16 params+optimizer, sized for one v5e chip.
 
 Tuning knobs (all env, all optional — defaults are the tuned configuration):
-  NEXUS_BENCH_BATCH     per-chip batch size (default 16)
+  NEXUS_BENCH_MODEL     nexus_1b (default) | nexus_moe (MoeConfig.nexus_moe:
+                        8 experts, top-2, static-capacity scatter dispatch;
+                        MFU counts ACTIVE params per the MoE convention)
+  NEXUS_BENCH_BATCH     per-chip batch size (default 16; moe default 64)
+  NEXUS_BENCH_CAPACITY  MoE capacity factor override (default from config)
+  NEXUS_BENCH_DISPATCH  MoE dispatch override: scatter | sort
   NEXUS_BENCH_SEQ       sequence length (default 2048)
   NEXUS_BENCH_STEPS     timed steps (default 10)
   NEXUS_BENCH_REMAT     remat policy: dots | attn_out | nothing
@@ -61,16 +66,25 @@ def _chip_peak_tflops(device) -> float:
 def model_flops_per_token(cfg, seq: int) -> float:
     """Training FLOPs per token: 6 x matmul params + causal attention.
 
-    Per layer/token forward: 2x(wq + wk + wv + wo + 3 mlp) matmul FLOPs;
+    Per layer/token forward: 2x(wq + wk + wv + wo + ffn) matmul FLOPs;
     attention scores QK^T + PV add 4*s*hq*d, halved by causality.  Training
     = 3x forward (fwd + 2x backward).  Embedding lookup is a gather (no
     FLOPs); the (tied or untied) head projection is a real matmul.
+
+    MoE configs (detected by ``n_experts``) count ACTIVE parameters — the
+    router projection plus top-k experts' SwiGLU per token, the standard
+    MoE MFU convention — so dispatch scatter/gather bookkeeping counts as
+    overhead, not useful work.
     """
     e, f, hq, hkv, d, l, v = (
         cfg.hidden, cfg.intermediate, cfg.n_heads, cfg.n_kv_heads,
         cfg.head_dim, cfg.n_layers, cfg.vocab_size,
     )
-    matmul_params = l * (e * hq * d + 2 * e * hkv * d + hq * d * e + 3 * e * f) + e * v
+    if getattr(cfg, "n_experts", 0):
+        ffn = cfg.experts_per_token * 3 * e * f + e * cfg.n_experts
+    else:
+        ffn = 3 * e * f
+    matmul_params = l * (e * hq * d + 2 * e * hkv * d + hq * d * e + ffn) + e * v
     attn = 2 * seq * hq * d * l  # causal: 4*s*hq*d / 2, per layer
     return 3.0 * (2.0 * matmul_params + attn)
 
@@ -86,17 +100,33 @@ def main() -> None:
 
     n_chips = jax.device_count()
     on_tpu = jax.default_backend() in ("tpu", "axon")
+    model = os.environ.get("NEXUS_BENCH_MODEL", "nexus_1b")
     if on_tpu:
-        cfg = LlamaConfig.nexus_1b()
-        per_chip_batch, seq, steps, warmup = 16, 2048, 10, 2
+        if model == "nexus_moe":
+            from tpu_nexus.models import MoeConfig
+
+            cfg = MoeConfig.nexus_moe()
+            per_chip_batch, seq, steps, warmup = 64, 2048, 10, 2
+        else:
+            cfg = LlamaConfig.nexus_1b()
+            per_chip_batch, seq, steps, warmup = 16, 2048, 10, 2
     else:  # CPU smoke: keep it honest but small
-        cfg = LlamaConfig.tiny()
+        if model == "nexus_moe":
+            from tpu_nexus.models import MoeConfig
+
+            cfg = MoeConfig.tiny()
+        else:
+            cfg = LlamaConfig.tiny()
         per_chip_batch, seq, steps, warmup = 1, 128, 10, 2
     per_chip_batch = int(os.environ.get("NEXUS_BENCH_BATCH", per_chip_batch))
     seq = int(os.environ.get("NEXUS_BENCH_SEQ", seq))
     steps = int(os.environ.get("NEXUS_BENCH_STEPS", steps))
     if os.environ.get("NEXUS_BENCH_REMAT"):
         cfg = dataclasses.replace(cfg, remat_policy=os.environ["NEXUS_BENCH_REMAT"])
+    if os.environ.get("NEXUS_BENCH_CAPACITY") and getattr(cfg, "n_experts", 0):
+        cfg = dataclasses.replace(cfg, capacity_factor=float(os.environ["NEXUS_BENCH_CAPACITY"]))
+    if os.environ.get("NEXUS_BENCH_DISPATCH") and getattr(cfg, "n_experts", 0):
+        cfg = dataclasses.replace(cfg, dispatch=os.environ["NEXUS_BENCH_DISPATCH"])
     # per-chip batch is fixed and the batch shards over dp*fsdp = all chips,
     # so the global batch divides the mesh at any chip count
     batch = per_chip_batch * n_chips
@@ -143,21 +173,22 @@ def main() -> None:
         pass
     vs_baseline = per_chip / baseline if baseline else 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "supervised_jax_tokens_per_sec_per_chip",
-                "value": round(per_chip, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(vs_baseline, 3),
-                "mfu": round(mfu, 4),
-                "batch_per_chip": per_chip_batch,
-                "seq": seq,
-                "remat_policy": cfg.remat_policy,
-                "chips": n_chips,
-            }
-        )
-    )
+    record = {
+        "metric": "supervised_jax_tokens_per_sec_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs_baseline, 3),
+        "mfu": round(mfu, 4),
+        "model": model,
+        "batch_per_chip": per_chip_batch,
+        "seq": seq,
+        "remat_policy": cfg.remat_policy,
+        "chips": n_chips,
+    }
+    if getattr(cfg, "n_experts", 0):
+        record["dispatch"] = cfg.dispatch
+        record["capacity_factor"] = cfg.capacity_factor
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -50,6 +50,58 @@ class TestMoeFfn:
         ref = jnp.sum(picked * gate[..., None].astype(ct), axis=1).reshape(x.shape)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
 
+    @pytest.mark.parametrize("capacity_factor", [1.25, 0.5])
+    def test_sort_dispatch_matches_scatter(self, capacity_factor):
+        """The sort-based dispatch (PERF.md r3) must agree with the scatter
+        path bit-for-tolerance on outputs, grads, AND dropped assignments —
+        the stable sort's k-major tiebreak drops exactly the overflow
+        assignments the cumsum ranking drops (capacity 0.5 forces drops)."""
+        import dataclasses
+
+        cfg = dataclasses.replace(MoeConfig.tiny(), capacity_factor=capacity_factor)
+        cfg_sort = dataclasses.replace(cfg, dispatch="sort")
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        layer = _layer0(params)
+        x = jax.random.normal(jax.random.PRNGKey(2), (2, 64, cfg.hidden), jnp.float32)
+
+        o1, a1 = moe_ffn(x, layer, cfg)
+        o2, a2 = moe_ffn(x, layer, cfg_sort)
+        np.testing.assert_allclose(np.asarray(o1), np.asarray(o2), rtol=1e-4, atol=1e-4)
+        assert float(a1["dropped_frac"]) == float(a2["dropped_frac"])
+        np.testing.assert_allclose(
+            float(a1["load_balance"]), float(a2["load_balance"]), rtol=1e-6
+        )
+
+        def loss(c):
+            def f(x, l):
+                out, aux = moe_ffn(x, l, c)
+                return jnp.sum(out.astype(jnp.float32) ** 2) + aux["load_balance"]
+            return f
+
+        g1 = jax.grad(loss(cfg), argnums=(0, 1))(x, layer)
+        g2 = jax.grad(loss(cfg_sort), argnums=(0, 1))(x, layer)
+        for (p1, p2) in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+            np.testing.assert_allclose(np.asarray(p1), np.asarray(p2), rtol=1e-3, atol=1e-4)
+
+    def test_unknown_dispatch_rejected(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(MoeConfig.tiny(), dispatch="sorted")  # typo
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        x = jnp.zeros((1, 8, cfg.hidden), jnp.float32)
+        with pytest.raises(ValueError, match="unknown MoeConfig.dispatch"):
+            moe_ffn(x, _layer0(params), cfg)
+
+    def test_sort_dispatch_refused_on_ep_mesh(self):
+        """dispatch='sort' cannot shard over ep — the adapter must refuse
+        loudly instead of letting GSPMD silently replicate expert buffers."""
+        import dataclasses
+
+        cfg = dataclasses.replace(MoeConfig.tiny(), dispatch="sort")
+        mesh = build_mesh(MeshSpec(fsdp=2, ep=2, tp=2))
+        with pytest.raises(ValueError, match="ep-sharded"):
+            adapter_for(cfg).make_loss(TrainConfig(), mesh)
+
     def test_capacity_drops_overflow(self):
         cfg = MoeConfig.tiny()
         cfg = type(cfg)(**{**cfg.__dict__, "capacity_factor": 0.25})

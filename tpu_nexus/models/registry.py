@@ -137,6 +137,16 @@ class MoeAdapter(ModelAdapter):
 
         attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
+        if cfg.dispatch == "sort" and mesh is not None and mesh.shape.get("ep", 1) > 1:
+            # the sort path's per-expert dynamic slices cannot partition
+            # over ep — GSPMD would silently replicate the expert buffers
+            # and defeat expert parallelism, so refuse loudly here (the one
+            # place that sees both the config and the mesh)
+            raise ValueError(
+                "MoeConfig.dispatch='sort' is a single-chip/replicated-expert "
+                f"optimization and cannot run on an ep-sharded mesh (ep={mesh.shape['ep']}); "
+                "use dispatch='scatter' for expert parallelism"
+            )
         z_loss = getattr(train_cfg, "z_loss", 0.0)
 
         def loss_fn(params, tokens):
