@@ -46,6 +46,25 @@ from tpu_nexus.workload.train import (
 logger = logging.getLogger(__name__)
 
 
+def _nonbatch_axis_spans_processes(mesh, rules: RuleTable) -> bool:
+    """True when a mesh axis other than the batch axes (whatever the rule
+    table maps the logical "batch" axis to) places its device groups across
+    >1 process — e.g. an sp ring whose steps ride DCN.  Process-local
+    batch-row assembly is invalid there (a process's rows are not a
+    contiguous row block of the global batch)."""
+    batch_axes = rules.get("batch", ("dp", "fsdp"))
+    if isinstance(batch_axes, str):
+        batch_axes = (batch_axes,)
+    batch_axes = set(batch_axes or ())
+    procs = np.vectorize(lambda d: d.process_index)(mesh.devices)
+    for i, name in enumerate(mesh.axis_names):
+        if name in batch_axes or mesh.shape[name] == 1:
+            continue
+        if (procs.min(axis=i) != procs.max(axis=i)).any():
+            return True
+    return False
+
+
 @dataclass(frozen=True)
 class WorkloadConfig:
     #: a model config (LlamaConfig, MnistConfig) or a ModelAdapter — resolved
@@ -71,13 +90,23 @@ class WorkloadConfig:
 
         e = os.environ if env is None else env
         steps = int(e.get("NEXUS_STEPS", "100"))
+        # NEXUS_MESH: "sp=2,fsdp=2" etc. — axis sizes for MeshSpec
+        # (-1 = infer); absent -> shard everything over fsdp
+        mesh_env = e.get("NEXUS_MESH", "")
+        if mesh_env:
+            mesh = MeshSpec(
+                **{k.strip(): int(v) for k, v in
+                   (pair.split("=") for pair in mesh_env.split(",") if pair.strip())}
+            )
+        else:
+            mesh = MeshSpec(fsdp=-1)
         return WorkloadConfig(
             model=get_adapter(e.get("NEXUS_MODEL_PRESET", "tiny")),
             train=TrainConfig(
                 warmup_steps=int(e.get("NEXUS_WARMUP_STEPS", "10")),
                 total_steps=max(steps, 2),
             ),
-            mesh=MeshSpec(fsdp=-1),
+            mesh=mesh,
             batch_size=int(e.get("NEXUS_BATCH", "8")),
             seq_len=int(e.get("NEXUS_SEQ_LEN", "512")),
             steps=steps,
@@ -209,13 +238,26 @@ def run_workload(
             logger.info("restored tensor checkpoint at step %d", latest)
 
     step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules)
-    # cfg.batch_size is GLOBAL; each process generates its own shard of the
-    # batch (disjoint seeds) and multi-process runs assemble the global array
-    # from process-local data
-    if cfg.batch_size % ctx.num_processes:
-        raise ValueError(f"batch {cfg.batch_size} not divisible by {ctx.num_processes} processes")
-    local_batch = cfg.batch_size // ctx.num_processes
-    data = data or adapter.data(local_batch, cfg.seq_len, seed=cfg.seed + ctx.process_id)
+    # cfg.batch_size is GLOBAL.  Two multi-process data modes:
+    #  * batch-rows mode (the scalable default): each process generates its
+    #    own shard of the batch rows (disjoint seeds) and the global array
+    #    assembles from process-local data;
+    #  * replicated mode: when a NON-batch mesh axis (sp/tp/ep) spans
+    #    processes — e.g. the sp=2 cross-process ring rehearsal — batch rows
+    #    are no longer process-aligned, so every process generates the SAME
+    #    full global batch (base seed) and each device slices its shard.
+    replicated_data = ctx.num_processes > 1 and _nonbatch_axis_spans_processes(mesh, cfg.rules)
+    if data is None:
+        if replicated_data:
+            data = adapter.data(cfg.batch_size, cfg.seq_len, seed=cfg.seed)
+        else:
+            # only the row-split mode needs batch % processes == 0
+            if cfg.batch_size % ctx.num_processes:
+                raise ValueError(
+                    f"batch {cfg.batch_size} not divisible by {ctx.num_processes} processes"
+                )
+            local_batch = cfg.batch_size // ctx.num_processes
+            data = adapter.data(local_batch, cfg.seq_len, seed=cfg.seed + ctx.process_id)
     # restart-from-step must also restart-from-*data*: fast-forward the
     # stream so resumed steps see the batches they would have seen, not a
     # replay of batch 0..N (which silently corrupts the training trajectory)
@@ -225,6 +267,15 @@ def run_workload(
 
     def to_global(raw):
         if ctx.num_processes > 1:
+            if replicated_data:
+                return jax.tree.map(
+                    lambda sh, leaf: jax.make_array_from_callback(
+                        np.shape(leaf), sh,
+                        lambda idx, _l=np.asarray(leaf): _l[idx],
+                    ),
+                    shardings,
+                    raw,
+                )
             return jax.tree.map(
                 lambda sh, leaf: jax.make_array_from_process_local_data(sh, np.asarray(leaf)),
                 shardings,
