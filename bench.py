@@ -123,6 +123,8 @@ def main() -> None:
     steps = int(os.environ.get("NEXUS_BENCH_STEPS", steps))
     if os.environ.get("NEXUS_BENCH_REMAT"):
         cfg = dataclasses.replace(cfg, remat_policy=os.environ["NEXUS_BENCH_REMAT"])
+    if os.environ.get("NEXUS_BENCH_UNROLL"):
+        cfg = dataclasses.replace(cfg, scan_unroll=int(os.environ["NEXUS_BENCH_UNROLL"]))
     if os.environ.get("NEXUS_BENCH_CAPACITY") and getattr(cfg, "n_experts", 0):
         cfg = dataclasses.replace(cfg, capacity_factor=float(os.environ["NEXUS_BENCH_CAPACITY"]))
     if os.environ.get("NEXUS_BENCH_DISPATCH") and getattr(cfg, "n_experts", 0):
@@ -131,7 +133,11 @@ def main() -> None:
     # so the global batch divides the mesh at any chip count
     batch = per_chip_batch * n_chips
 
-    tcfg = TrainConfig(warmup_steps=10, total_steps=1000)
+    tcfg = TrainConfig(
+        warmup_steps=10,
+        total_steps=1000,
+        ce_chunk=int(os.environ.get("NEXUS_BENCH_CE_CHUNK", "256")),
+    )
     mesh = build_mesh(MeshSpec(fsdp=-1))
     rules = LOGICAL_RULES_FSDP_TP
     state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, rules)

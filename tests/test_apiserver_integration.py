@@ -76,53 +76,62 @@ def apiserver(tmp_path_factory):
     etcd_port, etcd_peer = _free_port(), _free_port()
     api_port = _free_port()
 
-    etcd_proc = subprocess.Popen(
-        [
-            ETCD,
-            "--data-dir", str(root / "etcd"),
-            "--listen-client-urls", f"http://127.0.0.1:{etcd_port}",
-            "--advertise-client-urls", f"http://127.0.0.1:{etcd_port}",
-            "--listen-peer-urls", f"http://127.0.0.1:{etcd_peer}",
-        ],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
+    procs = []
 
-    sa_key = root / "sa.key"
-    subprocess.run(
-        ["openssl", "genrsa", "-out", str(sa_key), "2048"],
-        check=True, capture_output=True,
-    )
-    tokens = root / "tokens.csv"
-    tokens.write_text(f"{TOKEN},nexus-admin,nexus-admin-uid,system:masters\n")
+    def _teardown():
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait(timeout=10)
 
-    api_proc = subprocess.Popen(
-        [
-            APISERVER,
-            "--etcd-servers", f"http://127.0.0.1:{etcd_port}",
-            "--secure-port", str(api_port),
-            "--cert-dir", str(root / "certs"),  # self-signed serving certs
-            "--token-auth-file", str(tokens),
-            "--authorization-mode", "AlwaysAllow",
-            "--service-account-issuer", "https://kubernetes.default.svc",
-            "--service-account-signing-key-file", str(sa_key),
-            "--service-account-key-file", str(sa_key),
-            "--disable-admission-plugins", "ServiceAccount",
-            "--watch-cache=true",
-        ],
-        stdout=subprocess.DEVNULL,
-        stderr=subprocess.DEVNULL,
-    )
-
-    base = f"https://127.0.0.1:{api_port}"
     try:
+        procs.append(subprocess.Popen(
+            [
+                ETCD,
+                "--data-dir", str(root / "etcd"),
+                "--listen-client-urls", f"http://127.0.0.1:{etcd_port}",
+                "--advertise-client-urls", f"http://127.0.0.1:{etcd_port}",
+                "--listen-peer-urls", f"http://127.0.0.1:{etcd_peer}",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+
+        sa_key = root / "sa.key"
+        subprocess.run(
+            ["openssl", "genrsa", "-out", str(sa_key), "2048"],
+            check=True, capture_output=True,
+        )
+        tokens = root / "tokens.csv"
+        tokens.write_text(f"{TOKEN},nexus-admin,nexus-admin-uid,system:masters\n")
+
+        procs.append(subprocess.Popen(
+            [
+                APISERVER,
+                "--etcd-servers", f"http://127.0.0.1:{etcd_port}",
+                "--secure-port", str(api_port),
+                "--cert-dir", str(root / "certs"),  # self-signed serving certs
+                "--token-auth-file", str(tokens),
+                "--authorization-mode", "AlwaysAllow",
+                "--service-account-issuer", "https://kubernetes.default.svc",
+                "--service-account-signing-key-file", str(sa_key),
+                "--service-account-key-file", str(sa_key),
+                "--disable-admission-plugins", "ServiceAccount",
+                "--watch-cache=true",
+            ],
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        ))
+
+        base = f"https://127.0.0.1:{api_port}"
         _wait_ready(base, timeout=60)
         yield base
     finally:
-        api_proc.terminate()
-        etcd_proc.terminate()
-        api_proc.wait(timeout=10)
-        etcd_proc.wait(timeout=10)
+        _teardown()
 
 
 def _wait_ready(base: str, timeout: float) -> None:

@@ -34,7 +34,6 @@ def make_app(state):
             if "watch_raw_writes" in state:  # byte-exact frame segmentation
                 for blob in state["watch_raw_writes"]:
                     await resp.write(blob)
-                    await resp.drain()
                     await asyncio.sleep(0.01)  # force separate reads
                 await asyncio.sleep(0.05)
                 return resp

@@ -96,11 +96,12 @@ class LlamaAdapter(ModelAdapter):
         attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
         z_loss = getattr(train_cfg, "z_loss", 0.0)
+        ce_chunk = getattr(train_cfg, "ce_chunk", 256)
 
         def loss_fn(params, tokens):
             hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
             head = llama_head(params, cfg)
-            return chunked_next_token_loss(hidden, head, tokens, z_loss)
+            return chunked_next_token_loss(hidden, head, tokens, z_loss, chunk=ce_chunk)
 
         return loss_fn
 
@@ -148,11 +149,12 @@ class MoeAdapter(ModelAdapter):
                 "use dispatch='scatter' for expert parallelism"
             )
         z_loss = getattr(train_cfg, "z_loss", 0.0)
+        ce_chunk = getattr(train_cfg, "ce_chunk", 256)
 
         def loss_fn(params, tokens):
             hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)
             head = moe_head(params, cfg)
-            loss, metrics = chunked_next_token_loss(hidden, head, tokens, z_loss)
+            loss, metrics = chunked_next_token_loss(hidden, head, tokens, z_loss, chunk=ce_chunk)
             loss = (
                 loss
                 + cfg.load_balance_coef * aux["load_balance"]

@@ -52,6 +52,12 @@ import os as _os
 BLOCK_Q = int(_os.environ.get("NEXUS_FLASH_BLOCK_Q", 1024))
 BLOCK_K = int(_os.environ.get("NEXUS_FLASH_BLOCK_K", 1024))
 _NEG_INF = -1e30
+# The online softmax runs in the exp2 domain: log2(e) folds into the q
+# prescale (scores arrive as log2-scaled), so the hot [bq, bk] exp pass is
+# a single native VPU exp2 with no exp->exp2*ln2 multiply; block sums `l`
+# are invariant (exp2(s2 - m2) == exp(s - m)), and only the tiny [bq, 1]
+# logsumexp residual converts back to natural log at flush.
+_LOG2E = 1.4426950408889634
 
 
 def _block_for(s: int, target: int) -> int:
@@ -147,8 +153,8 @@ def _fwd_kernel(
         m = m_ref[...]
         m_blk = jnp.max(scores, axis=1, keepdims=True)  # [block_q, 1]
         m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp(m - m_new))
-        p = jnp.exp(scores - m_new)
+        alpha = jnp.where(m == _NEG_INF, 0.0, jnp.exp2(m - m_new))
+        p = jnp.exp2(scores - m_new)  # scores are log2-scaled (q prescale)
         lsum_ref[...] = lsum_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
         pv = jax.lax.dot_general(
             p.astype(v_blk.dtype), v_blk,
@@ -169,10 +175,11 @@ def _fwd_kernel(
     def _finalize():
         l_safe = jnp.maximum(lsum_ref[...], 1e-30)
         o_ref[0, 0, :, :] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
-        # logsumexp residual for the backward recomputation: L = m + log(l).
-        # Kept [..., 1]-shaped: TPU block tiling wants the last two dims to
-        # be (8k, array-dim) — (BLOCK_Q, 1) qualifies, a bare [S] would not.
-        l_ref[0, 0, :, :] = m_ref[...] + jnp.log(l_safe)
+        # logsumexp residual for the backward recomputation, converted back
+        # to natural log: L = m2/log2(e) + log(l).  Kept [..., 1]-shaped:
+        # TPU block tiling wants the last two dims to be (8k, array-dim) —
+        # (BLOCK_Q, 1) qualifies, a bare [S] would not.
+        l_ref[0, 0, :, :] = m_ref[...] * (1.0 / _LOG2E) + jnp.log(l_safe)
 
 
 def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
@@ -182,10 +189,10 @@ def _flash_forward(q, k, v, scale: float, causal: bool, interpret: bool):
     block_q = _block_for(s, BLOCK_Q)
     block_k = _block_for(s_k, BLOCK_K)
     n_kv = s_k // block_k
-    # kernel layout [B, H, S, D]; softmax scale folded into q ONCE here (XLA
-    # fuses it into the transpose copy) instead of per KV grid step in the
-    # kernel — same f32-multiply-then-round as the in-kernel fold had
-    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale).astype(q.dtype)
+    # kernel layout [B, H, S, D]; softmax scale AND log2(e) folded into q
+    # ONCE here (XLA fuses it into the transpose copy), putting the scores
+    # in the exp2 domain for the kernels
+    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     grid = (b, hq, s // block_q, n_kv)
@@ -241,12 +248,12 @@ def _bwd_dq_kernel(
         dq_acc[...] = jnp.zeros_like(dq_acc)
 
     def compute(masked):
-        # q arrives pre-scaled (for the scores dot); the dS·K chain factor
-        # is applied once to the [block_q, D] accumulator at flush instead
-        # of to every [block_q, block_k] dS block
+        # q arrives pre-scaled by scale*log2(e) (for the log2-domain scores
+        # dot); the dS·K chain factor is applied once to the [block_q, D]
+        # accumulator at flush instead of to every [block_q, block_k] block
         q = q_ref[0, 0, :, :]
         do = do_ref[0, 0, :, :]
-        lse = l_ref[0, 0, :, :]  # [block_q, 1]
+        lse2 = l_ref[0, 0, :, :] * _LOG2E  # [block_q, 1], log2 domain
         dsum = dsum_ref[0, 0, :, :]  # [block_q, 1]
         k_blk = k_ref[0, 0, :, :]
         v_blk = v_ref[0, 0, :, :]
@@ -258,7 +265,7 @@ def _bwd_dq_kernel(
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             k_pos = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse)  # [block_q, block_k]
+        p = jnp.exp2(scores - lse2)  # [block_q, block_k]
         dp = jax.lax.dot_general(
             do, v_blk, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -306,13 +313,12 @@ def _bwd_dkv_kernel(
     def compute(masked):
         k_blk = k_ref[0, 0, :, :]  # [block_k, D]
         v_blk = v_ref[0, 0, :, :]
-        # q arrives pre-scaled: it feeds the scores dot (where S = scale·QKᵀ
-        # needs it) AND the dK accumulation (dK = scale·dSᵀ·Q — the same
-        # factor), so no per-block [block_q, block_k] scale pass and no
-        # flush-time multiply are needed anywhere
+        # q arrives pre-scaled by scale*log2(e): it feeds the log2-domain
+        # scores dot AND the dK accumulation (dK = scale·dSᵀ·Q), whose
+        # surplus log2(e) factor is divided out once at flush
         q_blk = q_ref[0, 0, :, :]
         do_blk = do_ref[0, 0, :, :]
-        lse = l_ref[0, 0, :, :]  # [block_q, 1]
+        lse2 = l_ref[0, 0, :, :] * _LOG2E  # [block_q, 1], log2 domain
         dsum = dsum_ref[0, 0, :, :]
         scores = jax.lax.dot_general(
             q_blk, k_blk, dimension_numbers=(((1,), (1,)), ((), ())),
@@ -322,7 +328,7 @@ def _bwd_dkv_kernel(
             q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
             scores = jnp.where(q_pos >= k_pos, scores, _NEG_INF)
-        p = jnp.exp(scores - lse)
+        p = jnp.exp2(scores - lse2)
         # dV += Pᵀ · dO
         dv_acc[...] += jax.lax.dot_general(
             p.astype(do_blk.dtype), do_blk,
@@ -353,7 +359,8 @@ def _bwd_dkv_kernel(
 
     @pl.when(jnp.logical_and(gi == group - 1, qi == n_q_blocks - 1))
     def _flush():
-        dk_ref[0, 0, :, :] = dk_acc[...].astype(dk_ref.dtype)
+        # q's prescale carried an extra log2(e) into dK; divide it out here
+        dk_ref[0, 0, :, :] = (dk_acc[...] * (1.0 / _LOG2E)).astype(dk_ref.dtype)
         dv_ref[0, 0, :, :] = dv_acc[...].astype(dv_ref.dtype)
 
 
@@ -365,9 +372,10 @@ def _flash_backward(q, k, v, out, lse, g_out, scale, causal, interpret):
     group = hq // hkv
     block_q = _block_for(s, BLOCK_Q)
     block_k = _block_for(s_k, BLOCK_K)
-    # scale folded into q once (as in the forward): serves the scores dots
-    # in both kernels and the dK = scale·dSᵀ·Q accumulation
-    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * scale).astype(q.dtype)
+    # scale*log2(e) folded into q once (as in the forward): serves the
+    # log2-domain scores dots in both kernels and the dK accumulation
+    # (whose surplus log2(e) the dkv flush divides out)
+    qt = (jnp.swapaxes(q, 1, 2).astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
     dot = jnp.swapaxes(g_out, 1, 2)
