@@ -69,6 +69,22 @@ class TestManifests:
         # plain-Job path gets stable pod DNS via subdomain + headless service
         assert job["spec"]["template"]["spec"]["subdomain"] == s.run_id
 
+    def test_user_env_passthrough_carries_mesh_request(self):
+        """A launch can request a specific parallelism layout: spec.env
+        entries (e.g. the NEXUS_MESH contract run_workload parses) land in
+        the container env of both manifest flavors."""
+        s = spec(num_hosts=2, env={"NEXUS_MESH": "fsdp=2,sp=2", "NEXUS_MODEL_PRESET": "nexus_1b"})
+        for manifest, path in (
+            (compose_job(s), lambda m: m["spec"]["template"]),
+            (compose_jobset(s), lambda m: m["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]),
+        ):
+            env = {
+                e["name"]: e.get("value")
+                for e in path(manifest)["spec"]["containers"][0]["env"]
+            }
+            assert env["NEXUS_MESH"] == "fsdp=2,sp=2"
+            assert env["NEXUS_MODEL_PRESET"] == "nexus_1b"
+
     def test_jobset_coordinator_dns(self):
         s = spec(num_hosts=4)
         js = compose_jobset(s)

@@ -73,6 +73,7 @@ class RestKubeClient(KubeClient):
 
     @classmethod
     def from_kubeconfig(cls, path: str, context: Optional[str] = None) -> "RestKubeClient":
+        path = os.path.expanduser(path)  # config files say "~/.kube/config"
         with open(path, "r", encoding="utf-8") as fh:
             cfg = yaml.safe_load(fh)
         ctx_name = context or cfg.get("current-context")
