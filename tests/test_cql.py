@@ -256,3 +256,80 @@ def test_merge_chip_steps_builds_map_append():
     assert "{'host1/chip0': 7, 'host1/chip1': 7}" in q
     assert "WHERE algorithm = 'test-algorithm' AND id = 'run-1'" in q
     store.close()
+
+
+def test_wire_bytes_conform_to_protocol_v4_spec_by_hand():
+    """Independent-decoder witness (r2 verdict: 'the L0 claim rests on the
+    loopback fake', whose frames are built with the MODULE'S own primitives
+    — a symmetric encode bug would cancel out).  Here the client's raw
+    bytes are checked against frames hand-packed in this test straight from
+    the CQL native protocol v4 spec (§2 frame header, §4.1.1 STARTUP,
+    §4.1.4 QUERY), and the server replies are likewise hand-packed.  No
+    cql.py helper touches the expected bytes."""
+    import struct as _s
+
+    server_sock = socket.socket()
+    server_sock.bind(("127.0.0.1", 0))
+    server_sock.listen(1)
+    port = server_sock.getsockname()[1]
+
+    captured = {}
+
+    def recv_exact(conn, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:  # peer closed: bail instead of spinning
+                raise ConnectionError("client closed early")
+            buf += chunk
+        return buf
+
+    def serve():
+        conn, _ = server_sock.accept()
+        # ---- STARTUP (client stream counter starts at 1) ----
+        ver, flags, stream, opcode, length = _s.unpack(">BBhBi", recv_exact(conn, 9))
+        body = recv_exact(conn, length) if length else b""
+        captured["startup"] = (ver, flags, stream, opcode, body)
+        # READY, hand-packed: response version 0x84, empty body
+        conn.sendall(_s.pack(">BBhBi", 0x84, 0, stream, 0x02, 0))
+        # ---- QUERY ----
+        ver, flags, stream, opcode, length = _s.unpack(">BBhBi", recv_exact(conn, 9))
+        body = recv_exact(conn, length) if length else b""
+        captured["query"] = (ver, flags, stream, opcode, body)
+        # RESULT(Void), hand-packed: body = [int kind=0x0001]
+        conn.sendall(_s.pack(">BBhBi", 0x84, 0, stream, 0x08, 4) + _s.pack(">i", 1))
+        conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+
+    sock = socket.create_connection(("127.0.0.1", port), timeout=5)
+    c = CqlConnection(sock)
+    c.startup()
+    cql = "SELECT algorithm FROM nexus.checkpoints"
+    c.query(cql)
+    c.close()
+    t.join(timeout=5)
+
+    # STARTUP: version 0x04 request, flags 0, opcode 0x01, body is a
+    # [string map] {CQL_VERSION: 3.0.0}: short n, then short-len strings
+    ver, flags, stream, opcode, body = captured["startup"]
+    assert (ver, flags, opcode) == (0x04, 0x00, 0x01)
+    expected_startup = (
+        _s.pack(">H", 1)
+        + _s.pack(">H", 11) + b"CQL_VERSION"
+        + _s.pack(">H", 5) + b"3.0.0"
+    )
+    assert body == expected_startup
+
+    # QUERY: opcode 0x07, body = [long string] + [consistency short=ONE]
+    # + [flags byte 0x00]; stream increments per request
+    ver, flags, stream, opcode, body = captured["query"]
+    assert (ver, flags, opcode) == (0x04, 0x00, 0x07)
+    assert stream == captured["startup"][2] + 1
+    expected_query = (
+        _s.pack(">i", len(cql)) + cql.encode()
+        + _s.pack(">H", 0x0001)
+        + b"\x00"
+    )
+    assert body == expected_query

@@ -138,3 +138,21 @@ class TestMnist:
         assert jax.tree.structure(params) == jax.tree.structure(
             axes, is_leaf=lambda x: isinstance(x, tuple)
         )
+
+
+def test_max_seq_len_guard_refuses_overlong_sequences():
+    """max_seq_len is a real contract, not metadata: a sequence past the
+    config's designed context window fails loudly (nexus_1b_long exists to
+    widen it — see PERF.md r3 long-context table)."""
+    import jax
+    import jax.numpy as jnp
+    import pytest
+
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.llama import llama_hidden, llama_init
+
+    cfg = LlamaConfig.tiny()  # max_seq_len 256
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jnp.zeros((1, 512), jnp.int32)
+    with pytest.raises(ValueError, match="max_seq_len"):
+        llama_hidden(params, tokens, cfg)

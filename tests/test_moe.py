@@ -176,6 +176,26 @@ class TestMoeTraining:
         assert losses[-1] < losses[0]
         assert float(metrics["load_balance"]) > 0.0
 
+    def test_train_step_on_ep_sp_mesh_rings_attention(self):
+        """Long-context MoE: ep (scatter expert dispatch) and sp (ring
+        attention over ppermute) carry traffic in the SAME train step — the
+        mesh layout a long-sequence MoE run actually uses.  seq 64 over
+        sp=2 -> 32-token local shards rotating through the ring."""
+        cfg = MoeConfig.tiny()
+        mesh = build_mesh(MeshSpec(ep=2, sp=2, tp=2))
+        tcfg = TrainConfig(warmup_steps=2, total_steps=50, learning_rate=1e-2)
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 64), 0, cfg.vocab_size)
+        with mesh:
+            losses = []
+            for _ in range(6):
+                state, metrics = step_fn(state, tokens)
+                losses.append(float(metrics["loss"]))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        assert float(metrics["load_balance"]) > 0.0
+
     def test_moe_through_harness(self):
         """The MoE family runs the SAME harness/ledger contract as the other
         zoo models (registry parity)."""

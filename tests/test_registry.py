@@ -39,6 +39,11 @@ class TestRegistry:
         assert isinstance(get_adapter("mnist"), MnistAdapter)
         assert get_adapter("tiny").config == LlamaConfig.tiny()
         assert get_adapter("nexus_1b").config == LlamaConfig.nexus_1b()
+        # 32k single-chip long-context preset (PERF.md r3): same weights
+        # shape as nexus_1b, stretched window
+        long_cfg = get_adapter("nexus_1b_long").config
+        assert long_cfg.max_seq_len == 32768
+        assert long_cfg.hidden == LlamaConfig.nexus_1b().hidden
         with pytest.raises(KeyError, match="known"):
             get_adapter("nope")
 

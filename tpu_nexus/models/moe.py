@@ -323,6 +323,11 @@ def moe_hidden(
     """Final-norm hidden states [B, S, e] + accumulated router aux losses."""
     from tpu_nexus.ops import attention as _ops_attention
 
+    if tokens.shape[1] > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} exceeds the config's "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
     if positions is None:
         positions = jnp.broadcast_to(
             jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
