@@ -25,12 +25,14 @@ Design choices, all for the XLA compilation model:
 
 from __future__ import annotations
 
+import functools
 import math
 from dataclasses import dataclass
 from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpu_nexus.models.llama import attention_block, rope_tables
 from tpu_nexus.ops.rmsnorm import rms_norm
@@ -202,16 +204,88 @@ def _aux_losses(logits, probs, eidx, keep, cfg: MoeConfig):
     return {"load_balance": load_balance, "router_z": z, "dropped_frac": dropped}
 
 
+def _blocks_from_sorted(padded, starts, counts, cap: int, ne: int):
+    """[E, cap, e] blocks from a sorted-by-expert row array (padded by at
+    least `cap` rows so the last window never clamps): one contiguous
+    dynamic slice per expert, rows past the expert's count masked to zero.
+    Shared by the forward dispatch and the combine-gather VJP so their
+    windowing can never drift apart."""
+    ar = jnp.arange(cap, dtype=jnp.int32)[:, None]
+    blocks = []
+    for s_ in range(ne):  # ne is small and static — unrolled contiguous copies
+        sl = jax.lax.dynamic_slice(padded, (starts[s_], 0), (cap, padded.shape[-1]))
+        blocks.append(sl * (ar < counts[s_]).astype(padded.dtype))
+    return jnp.stack(blocks)  # [E, cap, e]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _take_by_token(src, idx, by_token, t, k):
+    """``src[idx]`` whose VJP needs NO scatter: every token has exactly K
+    assignments, so the cotangent re-orders by token (a gather) and does a
+    static ``[T, K, e] -> [T, e]`` sum instead of a [T*K, e] scatter-add."""
+    del by_token, t, k
+    return jnp.take(src, idx, axis=0)
+
+
+def _take_by_token_fwd(src, idx, by_token, t, k):
+    # `idx` is not a residual: its float0 cotangent shape (t*k,) is static
+    return jnp.take(src, idx, axis=0), (by_token,)
+
+
+def _take_by_token_bwd(t, k, res, d):
+    (by_token,) = res
+    d_src = jnp.take(d, by_token, axis=0).reshape(t, k, d.shape[-1]).sum(axis=1)
+    f0 = np.zeros((t * k,), jax.dtypes.float0)
+    return d_src, f0, f0
+
+
+_take_by_token.defvjp(_take_by_token_fwd, _take_by_token_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _take_slots(out_all, slot, perm, starts, counts, cap, ne):
+    """``out_all[slot]`` whose VJP needs NO scatter: re-sorted by expert
+    (a gather by ``perm``), the cotangent rows for each expert are one
+    contiguous run aligned with its buffer block, so d_out_all builds from
+    E fixed-size slices with the same underfill mask the forward dispatch
+    uses.  Overflow assignments' cotangents are already zero (keep-masked
+    downstream) and fall outside the per-expert window."""
+    del perm, starts, counts, cap, ne
+    return jnp.take(out_all, slot, axis=0)
+
+
+def _take_slots_fwd(out_all, slot, perm, starts, counts, cap, ne):
+    # `slot` is not a residual: its float0 cotangent shape equals perm's
+    return jnp.take(out_all, slot, axis=0), (perm, starts, counts)
+
+
+def _take_slots_bwd(cap, ne, res, d):
+    perm, starts, counts = res
+    e = d.shape[-1]
+    d_sorted = jnp.take(d, perm, axis=0)
+    d_pad = jnp.concatenate([d_sorted, jnp.zeros((cap, e), d.dtype)], axis=0)
+    d_out_all = _blocks_from_sorted(d_pad, starts, counts, cap, ne).reshape(ne * cap, e)
+    f0n = np.zeros(perm.shape, jax.dtypes.float0)
+    f0e = np.zeros((ne,), jax.dtypes.float0)
+    return d_out_all, f0n, f0n, f0e, f0e
+
+
+_take_slots.defvjp(_take_slots_fwd, _take_slots_bwd)
+
+
 def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
-    """Sort-based dispatch: no [T*K, E] position cumsum and no [T*K, emb]
-    scatter in the forward.  Assignments are sorted by expert id (stable, so
-    in-expert order is deterministic); each expert's tokens are then one
+    """Sort-based dispatch: NO large scatter in the forward OR the backward.
+
+    Assignments stable-sort by expert id; each expert's tokens are then one
     CONTIGUOUS slice of the sorted array, so the [E, C, emb] buffers build
     from E dynamic slices (pure copies) with an underfill mask.  The combine
-    gathers each assignment's output row via its buffer slot (unsorted back
-    with a tiny int32 scatter) exactly like the scatter path.  Measured ~25%
-    faster per moe_ffn fwd+bwd on v5e than "scatter" (PERF.md r3); single-
-    chip / replicated experts only — the slices do not shard over ep."""
+    gathers each assignment's output row via its buffer slot.  Both big
+    gathers carry custom VJPs (:func:`_take_by_token`, :func:`_take_slots`)
+    that turn the usual scatter-add cotangents into gathers + static
+    reshape-sums / contiguous slices — the only scatter anywhere is the
+    [T*K] int32 inverse-permutation build.  Measured 79.3 -> 68.2 ms per
+    moe_ffn fwd+bwd on v5e vs the scatter path (PERF.md r3); single-chip /
+    replicated experts only — the slices do not shard over ep."""
     ct = cfg.dtype
     b, s, e = x.shape
     t = b * s
@@ -232,16 +306,21 @@ def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     local = a_idx - jnp.take(starts, eidx_sorted)  # position within expert
     keep_sorted = local < cap
 
+    # one tiny int32 scatter builds the inverse permutation; everything
+    # else that needs original-order views gathers through it
+    inv_perm = jnp.zeros((t * k,), jnp.int32).at[perm].set(a_idx)
+    # ``by_token``: sorted-assignment indices ordered token-major — every
+    # token has exactly K assignments (at k-major slots kk*T + t), so
+    # inv_perm laid out [K, T] and transposed gives each token's K rows
+    # consecutively.  This is what makes the dispatch-gather VJP a static
+    # reshape-sum instead of a scatter-add.
+    by_token = inv_perm.reshape(k, t).T.reshape(t * k)
+
     tok_sorted = perm % t
-    x_sorted = jnp.take(flat.astype(ct), tok_sorted, axis=0)  # [T*K, e]
+    x_sorted = _take_by_token(flat.astype(ct), tok_sorted, by_token, t, k)  # [T*K, e]
     # pad so the last expert's slice never clamps out of range
     x_pad = jnp.concatenate([x_sorted, jnp.zeros((cap, e), ct)], axis=0)
-    ar = jnp.arange(cap, dtype=jnp.int32)[:, None]
-    bufs = []
-    for s_ in range(ne):  # ne is small and static — unrolled contiguous copies
-        sl = jax.lax.dynamic_slice(x_pad, (starts[s_], 0), (cap, e))
-        bufs.append(sl * (ar < counts[s_]).astype(ct))  # mask next expert's rows
-    buf = jnp.stack(bufs)  # [E, C, e]
+    buf = _blocks_from_sorted(x_pad, starts, counts, cap, ne)  # [E, C, e]
 
     out_buf = _expert_swiglu(buf, layer, ct)
     out_all = out_buf.reshape(ne * cap, e)
@@ -249,9 +328,10 @@ def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     # slot of each assignment in out_all, back in original (k-major) order;
     # overflow clamps in-range and is zeroed by `keep` at the combine
     slot_sorted = eidx_sorted * cap + jnp.minimum(local, cap - 1)
-    slot = jnp.zeros((t * k,), jnp.int32).at[perm].set(slot_sorted)
-    keep = jnp.zeros((t * k,), jnp.bool_).at[perm].set(keep_sorted)
-    picked = jnp.take(out_all, slot, axis=0).reshape(k, t, e).transpose(1, 0, 2)
+    slot = jnp.take(slot_sorted, inv_perm)
+    keep = jnp.take(keep_sorted, inv_perm)
+    picked = _take_slots(out_all, slot, perm, starts, counts, cap, ne)
+    picked = picked.reshape(k, t, e).transpose(1, 0, 2)
     keep_tk = keep.reshape(k, t).T.astype(jnp.float32)  # [T, K]
     combined = jnp.sum(picked * (gate * keep_tk)[..., None].astype(ct), axis=1)
 
