@@ -49,6 +49,9 @@ def _run_rehearsal(tmp_path, tag, n_procs, devices_per_proc, extra_env):
         "NEXUS_ALGORITHM": algorithm,
         "NEXUS_REHEARSAL_DB": db,
         "NEXUS_BATCH": "4",
+        # speed knob: shorter than the 256 default (and well inside tiny's
+        # max_seq_len window) keeps the 2-process CPU run snappy
+        "NEXUS_SEQ_LEN": "128",
         "NEXUS_STEPS": "6",
         "NEXUS_HEARTBEAT_EVERY": "2",
         **extra_env,

@@ -108,7 +108,8 @@ class WorkloadConfig:
             ),
             mesh=mesh,
             batch_size=int(e.get("NEXUS_BATCH", "8")),
-            seq_len=int(e.get("NEXUS_SEQ_LEN", "512")),
+            # default inside the default (tiny) preset's max_seq_len window
+            seq_len=int(e.get("NEXUS_SEQ_LEN", "256")),
             steps=steps,
             heartbeat_every=int(e.get("NEXUS_HEARTBEAT_EVERY", "10")),
             checkpoint_every=int(e.get("NEXUS_CHECKPOINT_EVERY", "0")),
