@@ -33,7 +33,7 @@ class TestMesh:
 
     def test_explicit_spec(self):
         mesh = build_mesh(MeshSpec(dp=1, fsdp=2, sp=2, tp=2))
-        assert mesh.shape == {"dp": 1, "fsdp": 2, "ep": 1, "sp": 2, "tp": 2}
+        assert mesh.shape == {"pp": 1, "dp": 1, "fsdp": 2, "ep": 1, "sp": 2, "tp": 2}
 
     def test_inferred_axis(self):
         mesh = build_mesh(MeshSpec(fsdp=-1, tp=2))

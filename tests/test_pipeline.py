@@ -1,0 +1,193 @@
+"""Pipeline parallelism (pp mesh axis): the GSPMD-native GPipe transform.
+
+No reference counterpart (SURVEY.md §2.7 — parallelism ABSENT in the
+reference); testing strategy follows SURVEY.md §7.4: multi-chip semantics
+rehearsed on the virtual 8-device CPU mesh, numerics pinned against the
+non-pipelined scan-over-layers forward, which is itself grad-tested.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models import LlamaConfig, MoeConfig
+from tpu_nexus.models.llama import llama_hidden, llama_hidden_pp, llama_init
+from tpu_nexus.parallel import (
+    LOGICAL_RULES_FSDP_TP,
+    LOGICAL_RULES_FSDP_TP_PP,
+    MeshSpec,
+    build_mesh,
+)
+from tpu_nexus.parallel.pipeline import auto_microbatches, pipeline_apply
+from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+
+class TestPipelineApply:
+    def test_matches_sequential_scan(self):
+        """P-stage pipeline == plain scan over the same stacked layers."""
+        key = jax.random.PRNGKey(0)
+        n_layers, batch, dim = 8, 8, 16
+        ws = jax.random.normal(key, (n_layers, dim, dim)) * 0.1
+        bs = jax.random.normal(jax.random.PRNGKey(1), (n_layers, dim)) * 0.1
+        x = jax.random.normal(jax.random.PRNGKey(2), (batch, 4, dim))
+        layers = {"w": ws, "b": bs}
+
+        def layer_fn(x, layer):
+            return jnp.tanh(x @ layer["w"] + layer["b"])
+
+        ref, _ = jax.lax.scan(lambda c, l: (layer_fn(c, l), None), x, layers)
+        for n_stages, microbatches in [(2, 4), (4, 8), (2, 2), (8, 8), (1, 2)]:
+            got = pipeline_apply(
+                layer_fn, layers, x, n_stages=n_stages, microbatches=microbatches
+            )
+            np.testing.assert_allclose(got, ref, rtol=1e-6, atol=1e-6)
+
+    def test_grads_match_sequential(self):
+        key = jax.random.PRNGKey(0)
+        layers = {"w": jax.random.normal(key, (4, 8, 8)) * 0.1}
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8))
+
+        def layer_fn(x, layer):
+            return jnp.tanh(x @ layer["w"])
+
+        def loss_seq(layers, x):
+            out, _ = jax.lax.scan(lambda c, l: (layer_fn(c, l), None), x, layers)
+            return jnp.sum(out**2)
+
+        def loss_pp(layers, x):
+            out = pipeline_apply(layer_fn, layers, x, n_stages=2, microbatches=2)
+            return jnp.sum(out**2)
+
+        g_ref = jax.grad(loss_seq)(layers, x)
+        g_pp = jax.grad(loss_pp)(layers, x)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6),
+            g_ref,
+            g_pp,
+        )
+
+    def test_pytree_carry(self):
+        """Auxiliary values (e.g. RoPE tables) ride the pipeline per-microbatch."""
+        layers = {"w": jnp.stack([jnp.eye(4) * (i + 1) for i in range(4)])}
+        x = jnp.ones((4, 4))
+        aux = jnp.arange(4, dtype=jnp.float32)[:, None] * jnp.ones((4, 4))
+
+        def layer_fn(carry, layer):
+            x, aux = carry
+            return x @ layer["w"] + aux, aux
+
+        out, aux_out = pipeline_apply(
+            layer_fn, layers, (x, aux), n_stages=2, microbatches=4
+        )
+        ref = (x, aux)
+        for i in range(4):
+            ref = layer_fn(ref, {"w": layers["w"][i]})
+        np.testing.assert_allclose(out, ref[0], rtol=1e-6)
+        np.testing.assert_allclose(aux_out, aux, rtol=1e-6)  # aux passes through
+
+    def test_divisibility_errors(self):
+        layers = {"w": jnp.zeros((3, 4, 4))}
+        x = jnp.zeros((4, 4))
+        with pytest.raises(ValueError, match="not divisible by pp"):
+            pipeline_apply(lambda c, l: c, layers, x, n_stages=2, microbatches=2)
+        layers = {"w": jnp.zeros((4, 4, 4))}
+        with pytest.raises(ValueError, match="not divisible by microbatches"):
+            pipeline_apply(lambda c, l: c, layers, x, n_stages=2, microbatches=3)
+
+    def test_auto_microbatches(self):
+        assert auto_microbatches(16, 2) == 8
+        assert auto_microbatches(8, 2) == 8
+        assert auto_microbatches(4, 2) == 4
+        assert auto_microbatches(2, 2) == 2
+        with pytest.raises(ValueError, match="pp_microbatches"):
+            auto_microbatches(3, 2)
+
+
+class TestLlamaPipelined:
+    def test_hidden_matches_non_pipelined(self):
+        cfg = LlamaConfig.tiny()  # 2 layers, remat off
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        ref = llama_hidden(params, tokens, cfg)
+        got = llama_hidden_pp(params, tokens, cfg, n_stages=2, microbatches=2)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+        )
+
+    def test_hidden_matches_with_remat(self):
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(), n_layers=4, remat=True, remat_policy="nothing"
+        )
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0, cfg.vocab_size)
+        ref = llama_hidden(params, tokens, cfg)
+        got = llama_hidden_pp(params, tokens, cfg, n_stages=2, microbatches=4)
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+        )
+
+
+class TestPipelinedTrainStep:
+    """The full sharded train step over a pp-bearing mesh (8 virtual devices)."""
+
+    def _step_loss(self, mesh, rules, cfg, tcfg, tokens):
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, rules)
+        step_fn = make_train_step(cfg, tcfg, mesh, rules)
+        with mesh:
+            state, metrics = step_fn(state, tokens)
+        return float(metrics["loss"]), float(metrics["grad_norm"])
+
+    def test_pp_step_matches_flat_step(self):
+        cfg = LlamaConfig.tiny()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+
+        flat_mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        loss_ref, gnorm_ref = self._step_loss(
+            flat_mesh, LOGICAL_RULES_FSDP_TP, cfg, tcfg, tokens
+        )
+
+        pp_mesh = build_mesh(MeshSpec(pp=2, fsdp=2, tp=2))
+        loss_pp, gnorm_pp = self._step_loss(
+            pp_mesh, LOGICAL_RULES_FSDP_TP_PP, cfg, tcfg, tokens
+        )
+        assert abs(loss_pp - loss_ref) < 1e-3, (loss_pp, loss_ref)
+        assert abs(gnorm_pp - gnorm_ref) / max(gnorm_ref, 1e-6) < 1e-2
+
+    def test_pp_state_is_stage_sharded(self):
+        cfg = LlamaConfig.tiny()
+        tcfg = TrainConfig(warmup_steps=1, total_steps=10)
+        mesh = build_mesh(MeshSpec(pp=2, fsdp=2, tp=2))
+        state = init_train_state(
+            jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP_PP
+        )
+        spec = state["params"]["layers"]["wq"].sharding.spec
+        assert spec[0] == "pp", spec
+
+    def test_explicit_microbatches_must_cover_dp_extent(self):
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        mesh = build_mesh(MeshSpec(pp=2, fsdp=4))
+        # mb size 1 < fsdp extent 4 -> every tick pads 3/4 of the data axis
+        with pytest.raises(ValueError, match="data-parallel extent"):
+            llama_hidden_pp(
+                params, tokens, cfg, n_stages=2, microbatches=8, mesh=mesh
+            )
+
+    def test_pp_with_sp_refused(self):
+        from tpu_nexus.models.registry import LlamaAdapter
+
+        mesh = build_mesh(MeshSpec(pp=2, sp=2, fsdp=2))
+        with pytest.raises(ValueError, match="ring attention"):
+            LlamaAdapter(config=LlamaConfig.tiny()).make_loss(TrainConfig(), mesh)
+
+    def test_moe_pp_refused(self):
+        from tpu_nexus.models.registry import MoeAdapter
+
+        mesh = build_mesh(MeshSpec(pp=2, fsdp=4))
+        with pytest.raises(ValueError, match="not yet supported for the "):
+            MoeAdapter(config=MoeConfig.tiny()).make_loss(TrainConfig(), mesh)

@@ -21,6 +21,7 @@ Design choices (all for the XLA compilation model, not ported from anywhere):
 
 from __future__ import annotations
 
+import math
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -120,17 +121,19 @@ class LlamaConfig:
 
 def llama_axes(cfg: LlamaConfig) -> Dict[str, Any]:
     """Logical-axis pytree mirroring :func:`llama_init`'s params structure.
-    Leading per-layer stack axis is unsharded (None)."""
+    The leading per-layer stack axis is the logical "layers" dim — unsharded
+    in the default rule tables, sharded over ``pp`` under
+    ``LOGICAL_RULES_FSDP_TP_PP`` (pipeline parallelism)."""
     layers = {
-        "attn_norm": (None, "embed"),
-        "wq": (None, "embed", "heads", "head_dim"),
-        "wk": (None, "embed", "kv_heads", "head_dim"),
-        "wv": (None, "embed", "kv_heads", "head_dim"),
-        "wo": (None, "heads", "head_dim", "embed"),
-        "mlp_norm": (None, "embed"),
-        "w_gate": (None, "embed", "mlp"),
-        "w_up": (None, "embed", "mlp"),
-        "w_down": (None, "mlp", "embed"),
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "w_gate": ("layers", "embed", "mlp"),
+        "w_up": ("layers", "embed", "mlp"),
+        "w_down": ("layers", "mlp", "embed"),
     }
     axes: Dict[str, Any] = {
         "embed": {"tokens": ("vocab", "embed")},
@@ -214,6 +217,55 @@ def attention_block(x, layer, cfg, cos, sin, attn_fn, *, collect_kv: bool = Fals
     return x
 
 
+def mlp_block(x: jax.Array, layer: Dict[str, Any], cfg: LlamaConfig) -> jax.Array:
+    """Pre-norm SwiGLU MLP sub-block with residual, shared by the plain and
+    pipelined forwards."""
+    ct = cfg.dtype
+    h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+    gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
+    up = jnp.einsum("bse,ef->bsf", h, layer["w_up"].astype(ct))
+    return x + jnp.einsum("bsf,fe->bse", jax.nn.silu(gate) * up, layer["w_down"].astype(ct))
+
+
+def remat_policy(name: str):
+    """Checkpoint policy for the layer scan/pipeline (see
+    :attr:`LlamaConfig.remat_policy` for the tradeoffs).  "attn_lse" rides
+    along with "attn_out": the flash kernel's logsumexp residual ([B,H,S,1]
+    f32, ~2 MB/layer) — saving it lets the backward replay skip re-running
+    the flash forward kernel entirely."""
+    policies = {
+        "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
+        "nothing": jax.checkpoint_policies.nothing_saveable,
+    }
+    return policies[name]
+
+
+def _forward_preamble(params, tokens, cfg, positions, attn_fn, attn_impl):
+    """Shared entry of the plain and pipelined forwards: context-window
+    guard, default positions, default attention dispatch, embedding lookup,
+    RoPE tables."""
+    if tokens.shape[1] > cfg.max_seq_len:
+        # max_seq_len is the config's designed context window (rope design
+        # point); exceeding it must fail loudly, not silently extrapolate —
+        # pick a longer preset (e.g. nexus_1b_long) or extend the config
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} exceeds the config's "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+        )
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal=True):
+            return _ops_attention(q, k, v, causal=causal, impl=attn_impl)
+
+    x = params["embed"]["tokens"].astype(cfg.dtype)[tokens]  # [B, S, E]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    return x, cos, sin, attn_fn
+
+
 def llama_head(params: Dict[str, Any], cfg: LlamaConfig) -> jax.Array:
     """The output projection ``[E, vocab]`` (tied or untied)."""
     if cfg.tied_embeddings:
@@ -241,51 +293,95 @@ def llama_hidden(
     ``return_kv=True`` → ``(hidden, (k, v))`` with K/V stacked per layer
     ``[L, B, S, Hkv, D]`` (decode prefill).
     """
-    if tokens.shape[1] > cfg.max_seq_len:
-        # max_seq_len is the config's designed context window (rope design
-        # point); exceeding it must fail loudly, not silently extrapolate —
-        # pick a longer preset (e.g. nexus_1b_long) or extend the config
-        raise ValueError(
-            f"sequence length {tokens.shape[1]} exceeds the config's "
-            f"max_seq_len {cfg.max_seq_len}"
-        )
-    if positions is None:
-        positions = jnp.broadcast_to(
-            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
-        )
-    if attn_fn is None:
-        def attn_fn(q, k, v, causal=True):
-            return _ops_attention(q, k, v, causal=causal, impl=attn_impl)
-
-    ct = cfg.dtype
-    x = params["embed"]["tokens"].astype(ct)[tokens]  # [B, S, E]
-    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    x, cos, sin, attn_fn = _forward_preamble(params, tokens, cfg, positions, attn_fn, attn_impl)
 
     def block(x, layer):
         x, kv = attention_block(x, layer, cfg, cos, sin, attn_fn, collect_kv=True)
-        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
-        up = jnp.einsum("bse,ef->bsf", h, layer["w_up"].astype(ct))
-        x = x + jnp.einsum("bsf,fe->bse", jax.nn.silu(gate) * up, layer["w_down"].astype(ct))
+        x = mlp_block(x, layer, cfg)
         return x, (kv if return_kv else None)
 
     body = block
     if cfg.remat:
-        policies = {
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            # "attn_lse" rides along: the flash kernel's logsumexp residual
-            # ([B,H,S,1] f32, ~2 MB/layer) — saving it lets the backward
-            # replay skip re-running the flash forward kernel entirely
-            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
-            "nothing": jax.checkpoint_policies.nothing_saveable,
-        }
-        body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
+        body = jax.checkpoint(block, policy=remat_policy(cfg.remat_policy))
     x, kv = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
 
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     if return_kv:
         return hidden, kv
     return hidden
+
+
+def llama_hidden_pp(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    n_stages: int,
+    microbatches: int = 0,
+    mesh: Any = None,
+    batch_axes: Any = ("dp", "fsdp"),
+    positions: Optional[jax.Array] = None,
+    attn_fn: Optional[AttnFn] = None,
+    attn_impl: str = "auto",
+) -> jax.Array:
+    """:func:`llama_hidden` over a pipeline-parallel layer stack.
+
+    The layer stack runs through :func:`tpu_nexus.parallel.pipeline
+    .pipeline_apply`: params' ``[L, ...]`` axes are stage-sharded over ``pp``
+    (rule table ``LOGICAL_RULES_FSDP_TP_PP``) and activations hand off
+    between stages as CollectivePermutes XLA derives from a roll on the
+    stage axis.  Embedding, final norm, and head stay outside the pipeline,
+    replicated over ``pp`` (their FLOPs are per-token-embedding, a small
+    fraction of the stack; pp devices duplicate them batch-sharded).
+
+    Each microbatch's RoPE cos/sin tables ride the pipeline alongside its
+    activations, so non-default ``positions`` stay correct per microbatch.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_nexus.parallel.pipeline import auto_microbatches, pipeline_apply
+
+    x, cos, sin, attn_fn = _forward_preamble(params, tokens, cfg, positions, attn_fn, attn_impl)
+    axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes or ())
+    dp_extent = 1
+    if mesh is not None:
+        dp_extent = math.prod(mesh.shape.get(a, 1) for a in axes)
+    if not microbatches:
+        microbatches = auto_microbatches(x.shape[0], n_stages, min_microbatch=dp_extent)
+    elif x.shape[0] % microbatches or (x.shape[0] // microbatches) % dp_extent:
+        # an explicit pp_microbatches that leaves microbatches smaller than
+        # (or ragged over) the data-parallel extent would silently pad every
+        # tick's batch sharding — refuse rather than waste dp/fsdp devices
+        raise ValueError(
+            f"pp_microbatches={microbatches} gives microbatch size "
+            f"{x.shape[0] / microbatches} from batch {x.shape[0]}, which is not a "
+            f"multiple of the data-parallel extent {dp_extent} ({'×'.join(axes) or '-'})"
+        )
+
+    def layer_fn(carry, layer):
+        x, cos, sin = carry
+        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
+        return mlp_block(x, layer, cfg), cos, sin
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=remat_policy(cfg.remat_policy))
+
+    spec = (
+        P(axes, None, None),          # x  [mb, S, E]
+        P(axes, None, None, None),    # cos [mb, S, 1, D/2]
+        P(axes, None, None, None),    # sin
+    )
+    x, _, _ = pipeline_apply(
+        layer_fn,
+        params["layers"],
+        (x, cos, sin),
+        n_stages=n_stages,
+        microbatches=microbatches,
+        mesh=mesh,
+        microbatch_spec=spec,
+        unroll=cfg.scan_unroll,
+    )
+    return rms_norm(x, params["out_norm"], cfg.norm_eps)
 
 
 def llama_forward(

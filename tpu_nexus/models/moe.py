@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpu_nexus.models.llama import attention_block, rope_tables
+from tpu_nexus.models.llama import attention_block, remat_policy, rope_tables
 from tpu_nexus.ops.rmsnorm import rms_norm
 
 AttnFn = Any
@@ -106,16 +106,16 @@ def moe_axes(cfg: MoeConfig) -> Dict[str, Any]:
     """Logical-axis pytree mirroring :func:`moe_init`.  Expert weights carry
     the "expert" logical axis -> the ``ep`` mesh axis (parallel/sharding.py)."""
     layers = {
-        "attn_norm": (None, "embed"),
-        "wq": (None, "embed", "heads", "head_dim"),
-        "wk": (None, "embed", "kv_heads", "head_dim"),
-        "wv": (None, "embed", "kv_heads", "head_dim"),
-        "wo": (None, "heads", "head_dim", "embed"),
-        "mlp_norm": (None, "embed"),
-        "router": (None, "embed", None),  # [L, e, E] — E is tiny, replicate
-        "w_gate": (None, "expert", "embed", "mlp"),
-        "w_up": (None, "expert", "embed", "mlp"),
-        "w_down": (None, "expert", "mlp", "embed"),
+        "attn_norm": ("layers", "embed"),
+        "wq": ("layers", "embed", "heads", "head_dim"),
+        "wk": ("layers", "embed", "kv_heads", "head_dim"),
+        "wv": ("layers", "embed", "kv_heads", "head_dim"),
+        "wo": ("layers", "heads", "head_dim", "embed"),
+        "mlp_norm": ("layers", "embed"),
+        "router": ("layers", "embed", None),  # [L, e, E] — E is tiny, replicate
+        "w_gate": ("layers", "expert", "embed", "mlp"),
+        "w_up": ("layers", "expert", "embed", "mlp"),
+        "w_down": ("layers", "expert", "mlp", "embed"),
     }
     axes: Dict[str, Any] = {
         "embed": {"tokens": ("vocab", "embed")},
@@ -430,12 +430,7 @@ def moe_hidden(
 
     body = block
     if cfg.remat:
-        policies = {
-            "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-            "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
-            "nothing": jax.checkpoint_policies.nothing_saveable,
-        }
-        body = jax.checkpoint(block, policy=policies[cfg.remat_policy])
+        body = jax.checkpoint(block, policy=remat_policy(cfg.remat_policy))
     zero = jnp.zeros((), jnp.float32)
     (x, lb, rz), dropped = jax.lax.scan(
         body, (x, zero, zero), params["layers"], unroll=cfg.scan_unroll

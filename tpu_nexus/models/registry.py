@@ -61,8 +61,12 @@ class ModelAdapter:
         """Logical-axis pytree mirroring one batch."""
         raise NotImplementedError
 
-    def make_loss(self, train_cfg: Any, mesh: Any) -> Callable[[Any, Any], Tuple[jax.Array, Dict]]:
-        """(params, batch) -> (scalar loss, metrics dict), jit-traceable."""
+    def make_loss(
+        self, train_cfg: Any, mesh: Any, rules: Any = None
+    ) -> Callable[[Any, Any], Tuple[jax.Array, Dict]]:
+        """(params, batch) -> (scalar loss, metrics dict), jit-traceable.
+        ``rules`` is the logical-axis rule table in effect (may be None for
+        rule-agnostic adapters)."""
         raise NotImplementedError
 
     def data(self, batch: int, seq_len: int, seed: int) -> Iterator[Any]:
@@ -90,16 +94,36 @@ class LlamaAdapter(ModelAdapter):
     def batch_axes(self):
         return ("batch", "seq")
 
-    def make_loss(self, train_cfg, mesh):
+    def make_loss(self, train_cfg, mesh, rules=None):
+        from tpu_nexus.models.llama import llama_hidden_pp
         from tpu_nexus.workload.train import chunked_next_token_loss
 
         attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
         z_loss = getattr(train_cfg, "z_loss", 0.0)
         ce_chunk = getattr(train_cfg, "ce_chunk", 256)
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if pp > 1 and attn_fn is not None:
+            # ring attention is a shard_map region; vmapping it over the
+            # pipeline's stage axis is untraced territory — refuse loudly
+            # rather than let GSPMD guess (pp already covers long-stack
+            # memory; shard long *sequences* over sp on a pp=1 mesh)
+            raise ValueError(
+                "pp > 1 with sp > 1 is not supported: ring attention cannot "
+                "run inside the pipeline's stage vmap"
+            )
+        pp_microbatches = getattr(train_cfg, "pp_microbatches", 0)
+        batch_axes = (rules or {}).get("batch", ("dp", "fsdp"))
 
         def loss_fn(params, tokens):
-            hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
+            if pp > 1:
+                hidden = llama_hidden_pp(
+                    params, tokens, cfg, n_stages=pp,
+                    microbatches=pp_microbatches, mesh=mesh,
+                    batch_axes=batch_axes,
+                )
+            else:
+                hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
             head = llama_head(params, cfg)
             return chunked_next_token_loss(hidden, head, tokens, z_loss, chunk=ce_chunk)
 
@@ -133,11 +157,17 @@ class MoeAdapter(ModelAdapter):
     def batch_axes(self):
         return ("batch", "seq")
 
-    def make_loss(self, train_cfg, mesh):
+    def make_loss(self, train_cfg, mesh, rules=None):
         from tpu_nexus.workload.train import chunked_next_token_loss
 
         attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
+        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+            raise ValueError(
+                "pipeline parallelism (pp > 1) is not yet supported for the "
+                "MoE family: the per-layer router aux losses would need to "
+                "ride the pipeline; shard experts over ep instead"
+            )
         if cfg.dispatch == "sort" and mesh is not None and mesh.shape.get("ep", 1) > 1:
             # the sort path's per-expert dynamic slices cannot partition
             # over ep — GSPMD would silently replicate the expert buffers
@@ -196,7 +226,7 @@ class MnistAdapter(ModelAdapter):
     def batch_axes(self):
         return {"x": ("batch", None), "y": ("batch",)}
 
-    def make_loss(self, train_cfg, mesh):
+    def make_loss(self, train_cfg, mesh, rules=None):
         cfg = self.config
 
         def loss_fn(params, batch):

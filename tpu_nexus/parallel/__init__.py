@@ -11,13 +11,17 @@ JAX programs, so this package is where scale lives:
                     launched by :mod:`tpu_nexus.launcher` (coordinator address
                     via JobSet headless-service DNS);
 * ``ring``        — ring attention (context/sequence parallelism) built on
-                    ``shard_map`` + ``ppermute`` so collectives ride ICI.
+                    ``shard_map`` + ``ppermute`` so collectives ride ICI;
+* ``pipeline``    — pipeline parallelism (``pp`` axis) as a GSPMD program
+                    transformation: stage-sharded layer stacks, microbatch
+                    scan, CollectivePermute handoffs derived by XLA.
 """
 
 from tpu_nexus.parallel.mesh import MeshSpec, build_mesh, local_mesh
 from tpu_nexus.parallel.sharding import (
     LOGICAL_RULES_1D,
     LOGICAL_RULES_FSDP_TP,
+    LOGICAL_RULES_FSDP_TP_PP,
     logical_to_sharding,
     shard_pytree,
 )
@@ -28,6 +32,7 @@ __all__ = [
     "local_mesh",
     "LOGICAL_RULES_1D",
     "LOGICAL_RULES_FSDP_TP",
+    "LOGICAL_RULES_FSDP_TP_PP",
     "logical_to_sharding",
     "shard_pytree",
 ]

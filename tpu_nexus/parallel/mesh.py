@@ -4,6 +4,9 @@ No reference counterpart (SURVEY.md §2.7: parallelism strategies ABSENT in the
 reference) — this is the TPU-first foundation for the workload harness.  The
 mesh axes follow the standard megascale naming:
 
+* ``pp``   — pipeline parallelism over the layer stack (stage-sharded layer
+             params; inter-stage activation handoff is a roll on the
+             pp-sharded stage axis that XLA lowers to CollectivePermute);
 * ``dp``   — pure data parallelism (gradients all-reduced, params replicated);
 * ``fsdp`` — data parallelism with fully-sharded parameters (params/opt-state
              sharded over this axis, all-gathered per layer on use);
@@ -30,8 +33,10 @@ from jax.sharding import Mesh
 #: real slice they land on physically adjacent chips (torus neighbours) and
 #: their collectives ride ICI, while dp/fsdp ride the outer (possibly DCN)
 #: dimension.  jax.devices() orders devices host-major, so the *last* mesh
-#: axes get intra-host/intra-slice neighbours.
-AXIS_ORDER: Tuple[str, ...] = ("dp", "fsdp", "ep", "sp", "tp")
+#: axes get intra-host/intra-slice neighbours.  pp is outermost of all: its
+#: traffic is one point-to-point activation handoff per microbatch tick —
+#: the lowest-bandwidth axis, the canonical one to stretch across slices.
+AXIS_ORDER: Tuple[str, ...] = ("pp", "dp", "fsdp", "ep", "sp", "tp")
 
 
 @dataclass(frozen=True)
@@ -39,6 +44,7 @@ class MeshSpec:
     """Declarative mesh shape.  Sizes must multiply to the device count; a
     single ``-1`` axis is inferred (numpy-reshape style)."""
 
+    pp: int = 1
     dp: int = 1
     fsdp: int = -1
     ep: int = 1
@@ -46,7 +52,7 @@ class MeshSpec:
     tp: int = 1
 
     def sizes(self) -> Tuple[int, ...]:
-        return (self.dp, self.fsdp, self.ep, self.sp, self.tp)
+        return (self.pp, self.dp, self.fsdp, self.ep, self.sp, self.tp)
 
     def resolve(self, n_devices: int) -> Tuple[int, ...]:
         """Concretize the one allowed ``-1`` against ``n_devices``."""

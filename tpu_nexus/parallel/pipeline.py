@@ -1,0 +1,177 @@
+"""Pipeline parallelism (the ``pp`` mesh axis) — GSPMD-native GPipe.
+
+No reference counterpart (SURVEY.md §2.7: parallelism strategies ABSENT in
+the reference); this is part of the TPU-native workload layer.
+
+Design — *pipelining as a sharded program transformation*, not hand-written
+point-to-point sends (the GSPMD paper's §3.3 construction, rebuilt here
+TPU-first):
+
+* The per-layer weight stacks ``[L, ...]`` shard their leading axis over
+  ``pp`` in contiguous slabs (rule table ``LOGICAL_RULES_FSDP_TP_PP``), so
+  each pipeline stage's devices hold only their ``L/pp`` layers — pipeline
+  parallelism IS model-memory parallelism here, like fsdp but along depth.
+* The batch splits into M microbatches.  One :func:`jax.lax.scan` runs
+  ``M + P - 1`` ticks over a stage-stacked activation buffer ``[P, mb, ...]``
+  whose leading axis is sharded over ``pp``.  Every tick applies all P stage
+  slabs via :func:`jax.vmap` over the stage axis — because both the buffer
+  and the slabs are pp-sharded, each device computes exactly its own stage.
+* The inter-stage handoff is ``jnp.roll(y, 1, axis=0)`` on the pp-sharded
+  stage axis: XLA lowers a shift of a sharded dimension to a single
+  ``CollectivePermute`` between pp-neighbours — the idiomatic TPU form of a
+  pipeline send, and its transpose (the backward's reverse handoff) falls out
+  of autodiff as the opposite roll.  No collective is issued by hand.
+* The first ``P - 1`` outputs and the zero-padded drain inputs are pipeline
+  bubble; utilization is ``M / (M + P - 1)``, so run with microbatch counts
+  of 2-4x the stage count.
+
+The activation carried between stages may be an arbitrary pytree — e.g. the
+Llama wiring threads (x, rope-cos, rope-sin) so each microbatch's RoPE tables
+ride the pipeline with it and arbitrary position ids stay correct.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def auto_microbatches(batch: int, n_stages: int, min_microbatch: int = 1) -> int:
+    """Pick a microbatch count: the largest of 4·P / 2·P / P that divides the
+    batch (bubble fraction (P-1)/(M+P-1): 4·P ⇒ ≤20%) while keeping each
+    microbatch divisible by ``min_microbatch`` — the data-parallel extent, so
+    no dp/fsdp device is left computing GSPMD padding every tick."""
+    for m in (4 * n_stages, 2 * n_stages, n_stages):
+        if batch % m == 0 and (batch // m) % min_microbatch == 0:
+            return m
+    raise ValueError(
+        f"batch size {batch} admits none of "
+        f"{[4 * n_stages, 2 * n_stages, n_stages]} microbatch counts for "
+        f"{n_stages} pipeline stages with microbatches divisible by "
+        f"{min_microbatch} (the data-parallel extent); pick pp_microbatches "
+        "explicitly or grow the batch"
+    )
+
+
+def _constrain(tree: Any, mesh: Optional[Mesh], spec_tree: Any) -> Any:
+    """with_sharding_constraint over a pytree of PartitionSpecs (no-op when
+    mesh/specs are absent)."""
+    if mesh is None or spec_tree is None:
+        return tree
+    return jax.tree.map(
+        lambda x, spec: jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, spec)
+        ),
+        tree,
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def _prepend(spec_tree: Any, axis) -> Any:
+    """Prepend a mesh axis (or None) to every PartitionSpec in a tree."""
+    if spec_tree is None:
+        return None
+    return jax.tree.map(
+        lambda spec: P(axis, *spec),
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, P),
+    )
+
+
+def pipeline_apply(
+    layer_fn: Callable[[Any, Any], Any],
+    stacked_layers: Any,
+    x: Any,
+    *,
+    n_stages: int,
+    microbatches: int,
+    mesh: Optional[Mesh] = None,
+    microbatch_spec: Any = None,
+    stage_axis: str = "pp",
+    unroll: int = 1,
+) -> Any:
+    """Apply ``L`` stacked layers to ``x`` as an ``n_stages``-deep pipeline.
+
+    ``layer_fn(carry, layer) -> carry`` is the single-layer body (already
+    remat-wrapped by the caller if desired); ``stacked_layers`` is a pytree
+    with leading ``[L, ...]`` axes, expected sharded over ``stage_axis`` in
+    contiguous slabs; ``x`` is a pytree of ``[B, ...]`` activations.
+    ``microbatch_spec`` (a pytree of PartitionSpecs for one microbatch
+    ``[mb, ...]``, matching ``x``'s structure) keeps GSPMD from re-sharding
+    the buffers mid-pipeline.  Returns the same pytree as ``x``.
+    """
+    leaves = jax.tree.leaves(stacked_layers)
+    if not leaves:
+        return x
+    n_layers = leaves[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"n_layers {n_layers} not divisible by pp={n_stages}")
+    per_stage = n_layers // n_stages
+    batch = jax.tree.leaves(x)[0].shape[0]
+    if batch % microbatches:
+        raise ValueError(f"batch {batch} not divisible by microbatches {microbatches}")
+
+    # [L, ...] -> [P, L/P, ...]; the reshape of the pp-sharded leading axis
+    # into (pp-sharded stage, local layer) is layout-preserving
+    slabs = jax.tree.map(
+        lambda a: a.reshape((n_stages, per_stage) + a.shape[1:]), stacked_layers
+    )
+
+    def stage_fn(slab, carry):
+        def body(c, layer):
+            return layer_fn(c, layer), None
+
+        carry, _ = jax.lax.scan(body, carry, slab, unroll=unroll)
+        return carry
+
+    stage_vec = jax.vmap(stage_fn)  # over the (pp-sharded) stage axis
+
+    # batch -> [M, mb, ...]; the microbatch-index axis is time, unsharded
+    x_mb = jax.tree.map(
+        lambda a: a.reshape((microbatches, a.shape[0] // microbatches) + a.shape[1:]), x
+    )
+    x_mb = _constrain(x_mb, mesh, _prepend(microbatch_spec, None))
+    # drain padding: the last P-1 ticks flush the pipeline; their stage-0
+    # inputs are zeros and their stage-(P-1) outputs are never collected
+    xs = jax.tree.map(
+        lambda a: jnp.concatenate(
+            [a, jnp.zeros((n_stages - 1,) + a.shape[1:], a.dtype)], axis=0
+        ),
+        x_mb,
+    )
+
+    state_spec = _prepend(microbatch_spec, stage_axis)
+    state0 = jax.tree.map(
+        lambda a: jnp.zeros((n_stages,) + a.shape[1:], a.dtype), x_mb
+    )
+    state0 = _constrain(state0, mesh, state_spec)
+
+    def inject(x_t, state):
+        # microbatch t enters stage 0; stages 1..P-1 keep their rolled input
+        def leaf(xt, st):
+            mask = (jnp.arange(n_stages) == 0).reshape((n_stages,) + (1,) * xt.ndim)
+            return jnp.where(mask, xt[None], st)
+
+        return jax.tree.map(leaf, x_t, state)
+
+    def tick(state, x_t):
+        state = _constrain(inject(x_t, state), mesh, state_spec)
+        y = _constrain(stage_vec(slabs, state), mesh, state_spec)
+        # stage s's output becomes stage s+1's next input: a +1 roll of the
+        # pp-sharded axis == CollectivePermute to the pp-neighbour.  The
+        # wrapped-around y[P-1] at slot 0 is overwritten by injection.
+        nxt = jax.tree.map(lambda a: jnp.roll(a, 1, axis=0), y)
+        out_t = jax.tree.map(lambda a: a[n_stages - 1], y)
+        return nxt, out_t
+
+    _, ys = jax.lax.scan(tick, state0, xs)
+    # tick t emits microbatch t-(P-1): the first P-1 outputs are bubble
+    out = jax.tree.map(lambda a: a[n_stages - 1:], ys)
+    out = _constrain(out, mesh, _prepend(microbatch_spec, None))
+    return jax.tree.map(
+        lambda a: a.reshape((batch,) + a.shape[2:]), out
+    )

@@ -30,6 +30,7 @@ LOGICAL_RULES_1D: RuleTable = {
     "head_dim": None,
     "vocab": None,
     "expert": None,
+    "layers": None,
 }
 
 #: the production layout: params sharded over fsdp (ZeRO-3 style) and tp,
@@ -44,6 +45,16 @@ LOGICAL_RULES_FSDP_TP: RuleTable = {
     "head_dim": None,
     "vocab": "tp",
     "expert": "ep",
+    "layers": None,
+}
+
+#: FSDP_TP plus pipeline parallelism: the per-layer weight stacks shard their
+#: leading ``[n_layers, ...]`` axis over ``pp`` in contiguous slabs of
+#: ``n_layers / pp`` layers — each pipeline stage holds only its slab.  The
+#: stage-rotated forward lives in :mod:`tpu_nexus.parallel.pipeline`.
+LOGICAL_RULES_FSDP_TP_PP: RuleTable = {
+    **LOGICAL_RULES_FSDP_TP,
+    "layers": "pp",
 }
 
 

@@ -18,6 +18,7 @@ configs #2-#5).  Cooperation contract with the supervisor:
 
 from __future__ import annotations
 
+import dataclasses
 import logging
 import time
 from dataclasses import dataclass, field
@@ -219,6 +220,10 @@ def run_workload(
     plan = FaultPlan.from_env()
     adapter = adapter_for(cfg.model)
     mesh = build_mesh(cfg.mesh)
+    if mesh.shape.get("pp", 1) > 1 and not cfg.rules.get("layers"):
+        # a pp-bearing mesh with layer stacks replicated would silently waste
+        # the pp axis — upgrade the default table to stage-shard the stacks
+        cfg = dataclasses.replace(cfg, rules={**cfg.rules, "layers": "pp"})
     logger.info(
         "workload %s/%s: model %s, mesh %s",
         ctx.algorithm, ctx.run_id, adapter.name, dict(mesh.shape),

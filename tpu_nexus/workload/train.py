@@ -40,6 +40,9 @@ class TrainConfig:
     #: the [B, chunk, V] f32 logits transient (536 MB at batch 16 / 32k
     #: vocab / 256) at a small scan-overhead cost
     ce_chunk: int = 256
+    #: microbatch count for pipeline parallelism (mesh pp > 1); 0 = auto
+    #: (largest of 4·pp / 2·pp / pp dividing the batch — bubble ≤ 20%)
+    pp_microbatches: int = 0
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -214,7 +217,7 @@ def make_train_step(
     """
     adapter = _as_adapter(model)
     optimizer = make_optimizer(train_cfg)
-    loss_fn = adapter.make_loss(train_cfg, mesh)
+    loss_fn = adapter.make_loss(train_cfg, mesh, rules=rules)
     shardings = batch_shardings(adapter, mesh, rules)
 
     def step_fn(state, batch):
