@@ -8,6 +8,7 @@ jax.distributed pretrain); MNIST covers the small single-slice demo
 over the ``ep`` mesh axis.
 """
 
+from tpu_nexus.models.generate import decode_step, generate, prefill
 from tpu_nexus.models.llama import LlamaConfig, llama_axes, llama_forward, llama_init
 from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist_init
 from tpu_nexus.models.moe import MoeConfig, moe_axes, moe_hidden, moe_init
@@ -22,6 +23,9 @@ from tpu_nexus.models.registry import (
 
 __all__ = [
     "LlamaConfig",
+    "generate",
+    "prefill",
+    "decode_step",
     "llama_axes",
     "llama_forward",
     "llama_init",

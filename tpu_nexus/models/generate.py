@@ -1,0 +1,166 @@
+"""KV-cache autoregressive decoding for the Llama family.
+
+No reference counterpart (the reference supervises opaque algorithm
+containers, SURVEY.md §2.7); this completes the model zoo's inference
+surface so supervised algorithm jobs can be *serving* workloads too, not
+only pretraining.
+
+TPU-first design:
+
+* **Static shapes end to end** — the cache is a fixed ``[L, B, max_len,
+  Hkv, D]`` buffer written with ``lax.dynamic_update_slice`` at the scalar
+  decode position, and the decode loop is one ``lax.scan`` of
+  ``max_new_tokens`` steps: one compile, no shape-polymorphic retraces,
+  no host round-trips inside the loop.
+* **Prefill reuses the training forward** (:func:`llama_hidden` with
+  ``return_kv=True``): the flash kernel processes the whole prompt in one
+  pass and hands back the per-layer post-RoPE K/V stack.
+* **Decode attention is an O(max_len) masked einsum** — at query length 1
+  the MXU has nothing to tile, so a flash kernel would only add launch
+  overhead; the mask is a positional clamp (``k_pos <= pos``), not a
+  causal triangle.
+* Rows decode in lockstep from a shared scalar position (prompts must be
+  equal length — left-pad upstream if not), which keeps the cache update a
+  single dynamic slice rather than a per-row scatter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from tpu_nexus.models.llama import (
+    LlamaConfig,
+    llama_head,
+    llama_hidden,
+    mlp_block,
+    rope_tables,
+    _rope,
+)
+from tpu_nexus.ops.rmsnorm import rms_norm
+
+_NEG_INF = -1e30
+
+Cache = Dict[str, jax.Array]  # {"k": [L,B,max_len,Hkv,D], "v": same}
+
+
+def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array) -> jax.Array:
+    """GQA attention of a length-1 query against a fixed-size cache.
+
+    ``q`` [B, 1, Hq, D]; ``k``/``v`` [B, max_len, Hkv, D]; ``kv_len`` scalar —
+    cache slots >= kv_len are masked out (they hold zeros/stale writes)."""
+    b, sq, hq, d = q.shape
+    hkv = k.shape[2]
+    g = hq // hkv
+    qg = q.reshape(b, sq, hkv, g, d)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * (d**-0.5)
+    k_pos = jnp.arange(k.shape[1])
+    scores = jnp.where(k_pos < kv_len, scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", w, v, preferred_element_type=jnp.float32)
+    return out.reshape(b, sq, hq, d).astype(q.dtype)
+
+
+def prefill(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    max_len: int,
+) -> Tuple[Cache, jax.Array]:
+    """Run the prompt through the training forward once; return the padded
+    KV cache and the last position's logits ``[B, vocab]``."""
+    b, s = tokens.shape
+    if s > max_len:
+        raise ValueError(f"prompt length {s} exceeds cache max_len {max_len}")
+    hidden, (k, v) = llama_hidden(params, tokens, cfg, return_kv=True)
+    pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
+    cache = {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
+    logits = jnp.einsum("be,ev->bv", hidden[:, -1], llama_head(params, cfg))
+    return cache, logits
+
+
+def decode_step(
+    params: Dict[str, Any],
+    cache: Cache,
+    token: jax.Array,
+    pos: jax.Array,
+    cfg: LlamaConfig,
+) -> Tuple[jax.Array, Cache]:
+    """One autoregressive step: ``token`` [B] at scalar position ``pos`` →
+    (logits [B, vocab], updated cache).  Mirrors the training block exactly
+    (pre-norm GQA + RoPE + SwiGLU via :func:`mlp_block`)."""
+    ct = cfg.dtype
+    b = token.shape[0]
+    x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]
+    positions = jnp.broadcast_to(pos[None, None], (b, 1))
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    def body(x, xs):
+        layer, ck, cv = xs
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = _rope(q, cos, sin)
+        k = _rope(k, cos, sin)
+        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
+        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        o = cached_attention(q, ck, cv, pos + 1)
+        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = mlp_block(x, layer, cfg)
+        return x, (ck, cv)
+
+    x, (ck_all, cv_all) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = jnp.einsum("be,ev->bv", hidden[:, 0], llama_head(params, cfg))
+    return logits, {"k": ck_all, "v": cv_all}
+
+
+def generate(
+    params: Dict[str, Any],
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    *,
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Decode ``max_new_tokens`` continuations of ``prompt`` [B, S] →
+    [B, max_new_tokens].  ``temperature=0`` is greedy; otherwise categorical
+    sampling with ``key``.  Jit-compatible (one prefill + one scan)."""
+    b, s = prompt.shape
+    total = s + max_new_tokens
+    max_len = max_len or total
+    if total > max_len:
+        raise ValueError(f"prompt {s} + {max_new_tokens} new tokens exceeds max_len {max_len}")
+    if max_len > cfg.max_seq_len:
+        raise ValueError(f"max_len {max_len} exceeds the config's context window {cfg.max_seq_len}")
+    if temperature > 0.0 and key is None:
+        raise ValueError("sampling (temperature > 0) needs a PRNG key")
+    if key is None:
+        key = jax.random.PRNGKey(0)  # unused by greedy; scan carry needs an array
+
+    cache, logits = prefill(params, prompt, cfg, max_len)
+
+    def sample(logits, k):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
+        return jax.random.categorical(k, logits / temperature, axis=-1).astype(prompt.dtype)
+
+    def body(carry, _):
+        cache, logits, pos, key = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        return (cache, logits, pos + 1, key), tok
+
+    (_, _, _, _), toks = jax.lax.scan(
+        body, (cache, logits, jnp.asarray(s, jnp.int32), key), length=max_new_tokens
+    )
+    return jnp.moveaxis(toks, 0, 1)  # [B, max_new_tokens]
