@@ -162,3 +162,63 @@ class TestRmsNorm:
         # rms of output ~1
         rms = float(jnp.sqrt(jnp.mean(jnp.square(out.astype(jnp.float32)))))
         assert 0.9 < rms < 1.1
+
+
+class TestGroupedMatmul:
+    """Grouped matmul kernels (interpret mode on CPU) vs the gather-einsum
+    reference.  Tolerances account for this backend's reduced-precision f32
+    matmuls (accumulation-order differences)."""
+
+    def _case(self, key, M=1024, K=256, N=384, E=4, bm=128):
+        from tpu_nexus.ops.grouped_matmul import _gmm_ref
+
+        lhs = jax.random.normal(key, (M, K), jnp.float32)
+        rhs = jax.random.normal(jax.random.fold_in(key, 1), (E, K, N), jnp.float32)
+        te = jnp.asarray([0, 0, 0, 1, 2, 2, 3, 3], jnp.int32)
+        return lhs, rhs, te, bm
+
+    def test_gmm_matches_reference(self):
+        from tpu_nexus.ops.grouped_matmul import _gmm_ref, gmm
+
+        lhs, rhs, te, bm = self._case(jax.random.PRNGKey(0))
+        out = gmm(lhs, rhs, te, bm, 128, True)
+        ref = _gmm_ref(lhs, rhs, te, bm)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-2, atol=2e-2)
+
+    def test_tgmm_matches_reference(self):
+        from tpu_nexus.ops.grouped_matmul import _tgmm_raw, _tgmm_ref
+
+        lhs, rhs, te, bm = self._case(jax.random.PRNGKey(1))
+        d = jax.random.normal(jax.random.PRNGKey(2), (lhs.shape[0], rhs.shape[2]), jnp.float32)
+        got = _tgmm_raw(lhs, d, te, rhs.shape[0], bm, 128, True)
+        ref = _tgmm_ref(lhs, d, te, rhs.shape[0], bm)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=2e-2, atol=2e-1)
+
+    def test_gmm_vjp_matches_reference_grads(self):
+        from tpu_nexus.ops.grouped_matmul import _gmm_ref, gmm
+
+        lhs, rhs, te, bm = self._case(jax.random.PRNGKey(3), M=512, K=128, N=128)
+        te = jnp.asarray([0, 1, 2, 3], jnp.int32)
+
+        g1 = jax.grad(lambda l, r: jnp.sum(gmm(l, r, te, bm, 128, True) ** 2), argnums=(0, 1))(lhs, rhs)
+        g2 = jax.grad(lambda l, r: jnp.sum(_gmm_ref(l, r, te, bm) ** 2), argnums=(0, 1))(lhs, rhs)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-1)
+
+    def test_empty_expert_gets_zero_tgmm_block(self):
+        """Experts with zero row tiles must still produce defined (zero)
+        weight-grad blocks — guaranteed upstream by min-one-tile padding;
+        here every expert owns at least one (zero-filled) tile."""
+        from tpu_nexus.ops.grouped_matmul import _tgmm_raw
+
+        M, K, N, E, bm = 512, 128, 128, 4, 128
+        te = jnp.asarray([0, 0, 2, 3], jnp.int32)  # expert 1: one zero tile? no — absent
+        # give expert 1 no tiles: its block is never visited, so the
+        # dispatch contract REQUIRES one padded tile per expert; emulate it
+        te = jnp.asarray([0, 1, 2, 3], jnp.int32)
+        lhs = jnp.zeros((M, K), jnp.float32).at[:bm].set(1.0)  # only expert 0's tile has rows
+        d = jnp.ones((M, N), jnp.float32).at[bm:].set(0.0)
+        out = _tgmm_raw(lhs, d, te, E, bm, 128, True)
+        assert np.abs(np.asarray(out[0])).sum() > 0
+        np.testing.assert_array_equal(np.asarray(out[1]), 0)
+        np.testing.assert_array_equal(np.asarray(out[3]), 0)

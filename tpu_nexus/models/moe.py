@@ -72,6 +72,13 @@ class MoeConfig:
     #:              scatter in the forward).  Faster on a single chip /
     #:              replicated experts (measured on v5e, PERF.md r3); not
     #:              intended for ep-sharded buffers.
+    #:  "gmm"     — DROPLESS tile-aligned grouped matmul (megablox-style
+    #:              pallas kernel, ops/grouped_matmul.py): sorted rows pad
+    #:              per-expert to the m-tile, expert weights stream per
+    #:              tile via scalar prefetch.  No capacity buffers, no
+    #:              capacity-factor compute inflation, no dropped tokens;
+    #:              dispatch AND combine are bijective gathers in both
+    #:              passes.  Single chip / replicated experts only.
     dispatch: str = "scatter"
 
     @staticmethod
@@ -87,10 +94,11 @@ class MoeConfig:
             head_dim=128, intermediate=2048, n_experts=8, experts_per_token=2,
             tied_embeddings=True, param_dtype=jnp.bfloat16, max_seq_len=4096,
             remat_policy="attn_out",
-            # single-chip bench config: sort dispatch measured 19% faster per
-            # moe_ffn forward than scatter on v5e (PERF.md r3).  Multi-chip
+            # single-chip bench config: the dropless grouped-matmul kernel
+            # measured fastest on v5e (60.6k tok/s vs sort's 57.9k vs
+            # scatter's 52.6k, PERF.md r3) AND drops no tokens.  Multi-chip
             # ep-sharded runs must use dispatch="scatter".
-            dispatch="sort",
+            dispatch="gmm",
         )
 
     @staticmethod
@@ -273,6 +281,148 @@ def _take_slots_bwd(cap, ne, res, d):
 _take_slots.defvjp(_take_slots_fwd, _take_slots_bwd)
 
 
+def _sort_by_expert(eidx: jax.Array, t: int, k: int, ne: int):
+    """Stable sort of the k-major assignment ids by expert.  Returns
+    (eidx_sorted, perm, counts, starts, local, inv_perm, by_token): the
+    shared prologue of the sort and gmm dispatch paths.  ``by_token`` lists
+    sorted-assignment indices token-major (each token's K rows consecutive),
+    which is what lets dispatch-gather VJPs be static reshape-sums."""
+    eidx_flat = eidx.T.reshape(t * k)  # k-major: k=0 block first
+    a_idx = jnp.arange(t * k, dtype=jnp.int32)
+    eidx_sorted, perm = jax.lax.sort_key_val(eidx_flat, a_idx, is_stable=True)
+    counts = jnp.sum(jax.nn.one_hot(eidx_flat, ne, dtype=jnp.int32), axis=0)  # [E]
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    )
+    local = a_idx - jnp.take(starts, eidx_sorted)  # position within expert
+    # one tiny int32 scatter builds the inverse permutation; everything
+    # else that needs original-order views gathers through it
+    inv_perm = jnp.zeros((t * k,), jnp.int32).at[perm].set(a_idx)
+    by_token = inv_perm.reshape(k, t).T.reshape(t * k)
+    return eidx_sorted, perm, counts, starts, local, inv_perm, by_token
+
+
+def _idx_zeros(*arrs):
+    """float0 cotangents for integer/bool index arguments of custom VJPs."""
+    return tuple(np.zeros(a.shape, jax.dtypes.float0) for a in arrs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _dispatch_gather(flat, tok_of_slot, valid, slot_by_token, t, k):
+    """Token rows -> tile-padded dispatch layout in ONE data gather
+    (``flat[tok_of_slot]``, invalid slots zeroed).  The VJP is also one
+    gather: every token has exactly K assignments, each landing at a unique
+    slot (``slot_by_token``), so the cotangent is a gather-by-slot plus a
+    static ``[T, K, e] -> [T, e]`` sum — no scatter, and no intermediate
+    sorted array materializes in either pass (the index composition that
+    replaced the two-pass sort-then-pad version bought back a full
+    read+write of the dispatch array per pass, PERF.md r3 gmm section)."""
+    del slot_by_token, t, k
+    return jnp.where(valid[:, None], jnp.take(flat, tok_of_slot, axis=0), 0)
+
+
+def _dispatch_gather_fwd(flat, tok_of_slot, valid, slot_by_token, t, k):
+    out = jnp.where(valid[:, None], jnp.take(flat, tok_of_slot, axis=0), 0)
+    return out, (slot_by_token, tok_of_slot, valid)
+
+
+def _dispatch_gather_bwd(t, k, res, d):
+    slot_by_token, tok_of_slot, valid = res
+    d_flat = jnp.take(d, slot_by_token, axis=0).reshape(t, k, d.shape[-1]).sum(axis=1)
+    return (d_flat, *_idx_zeros(tok_of_slot, valid, slot_by_token))
+
+
+_dispatch_gather.defvjp(_dispatch_gather_fwd, _dispatch_gather_bwd)
+
+
+@jax.custom_vjp
+def _combine_gather(y, slot_km, a_of_slot, valid):
+    """Expert outputs (padded layout) -> k-major assignment rows in ONE
+    gather (``y[slot_km]``); the VJP routes each valid slot's cotangent back
+    from its unique assignment (``a_of_slot = perm[row_of_slot]``) — again a
+    single gather, no scatter."""
+    return jnp.take(y, slot_km, axis=0)
+
+
+def _combine_gather_fwd(y, slot_km, a_of_slot, valid):
+    return jnp.take(y, slot_km, axis=0), (slot_km, a_of_slot, valid)
+
+
+def _combine_gather_bwd(res, d):
+    slot_km, a_of_slot, valid = res
+    dy = jnp.where(valid[:, None], jnp.take(d, a_of_slot, axis=0), 0)
+    return (dy, *_idx_zeros(slot_km, a_of_slot, valid))
+
+
+_combine_gather.defvjp(_combine_gather_fwd, _combine_gather_bwd)
+
+
+def _moe_ffn_gmm(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
+    """DROPLESS dispatch through the tile-aligned grouped-matmul kernel
+    (ops/grouped_matmul.py).  Each expert's sorted rows pad up to a multiple
+    of the m-tile (>= one tile, so zero-traffic experts still produce
+    defined — zero — weight grads); every row tile then belongs to exactly
+    one expert and the expert weights stream tile-by-tile via scalar
+    prefetch.  There are NO capacity buffers: dispatch and combine are
+    bijective gathers (slot<->row) in the forward AND the backward, no
+    capacity-factor compute inflation, and nothing is ever dropped.
+    Single-chip / replicated experts only (the padded layout does not shard
+    over ep; use dispatch='scatter' there)."""
+    from tpu_nexus.ops.grouped_matmul import BLOCK_M, gmm
+
+    ct = cfg.dtype
+    b, s, e = x.shape
+    t = b * s
+    ne, k = cfg.n_experts, cfg.experts_per_token
+    a = t * k
+    flat = x.reshape(t, e)
+    logits, probs, gate, eidx = _router(flat, layer, cfg)
+    eidx_sorted, perm, counts, starts, local, inv_perm, by_token = _sort_by_expert(
+        eidx, t, k, ne
+    )
+
+    bm = BLOCK_M if a >= 8192 else 128
+    padded_counts = jnp.maximum(((counts + bm - 1) // bm) * bm, bm)
+    padded_starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded_counts)[:-1].astype(jnp.int32)]
+    )
+    # static worst case: every expert wastes < one tile (+ the ceil of A)
+    m_pad = ((a + bm - 1) // bm) * bm + ne * bm
+
+    slot_of_row = jnp.take(padded_starts, eidx_sorted) + local  # [A], in-range
+    slot_ids = jnp.arange(m_pad, dtype=jnp.int32)
+    slot_expert = (
+        jnp.searchsorted(padded_starts, slot_ids, side="right").astype(jnp.int32) - 1
+    )
+    slot_local = slot_ids - jnp.take(padded_starts, slot_expert)
+    valid = slot_local < jnp.take(counts, slot_expert)
+    row_of_slot = jnp.minimum(jnp.take(starts, slot_expert) + slot_local, a - 1)
+    tile_expert = slot_expert.reshape(-1, bm)[:, 0]  # constant within a tile
+
+    # index composition (int32, cheap) so the BIG [*, emb] arrays move
+    # through exactly one gather per side per pass
+    tok_sorted = perm % t
+    tok_of_slot = jnp.take(tok_sorted, row_of_slot)        # slot -> token id
+    slot_by_token = jnp.take(slot_of_row, by_token)        # token-major slots
+    slot_km = jnp.take(slot_of_row, inv_perm)              # k-major slots
+    a_of_slot = jnp.take(perm, row_of_slot)                # slot -> k-major a
+
+    x_padded = _dispatch_gather(
+        flat.astype(ct), tok_of_slot, valid, slot_by_token, t, k
+    )  # [m_pad, e]
+
+    g = gmm(x_padded, layer["w_gate"].astype(ct), tile_expert, bm)
+    u = gmm(x_padded, layer["w_up"].astype(ct), tile_expert, bm)
+    y = gmm(jax.nn.silu(g) * u, layer["w_down"].astype(ct), tile_expert, bm)
+
+    y_km = _combine_gather(y, slot_km, a_of_slot, valid)  # [A, e], k-major
+    picked = y_km.reshape(k, t, e).transpose(1, 0, 2)
+    combined = jnp.sum(picked * gate[..., None].astype(ct), axis=1)
+
+    aux = _aux_losses(logits, probs, eidx, jnp.ones((t, k), jnp.float32), cfg)
+    return combined.reshape(b, s, e).astype(x.dtype), aux
+
+
 def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     """Sort-based dispatch: NO large scatter in the forward OR the backward.
 
@@ -296,25 +446,10 @@ def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
 
     # k-major assignment order (a = kk*T + tok), mirroring the scatter path
     # so both paths drop the same overflow assignments
-    eidx_flat = eidx.T.reshape(t * k)  # [T*K] int32
-    a_idx = jnp.arange(t * k, dtype=jnp.int32)
-    eidx_sorted, perm = jax.lax.sort_key_val(eidx_flat, a_idx, is_stable=True)
-    counts = jnp.sum(jax.nn.one_hot(eidx_flat, ne, dtype=jnp.int32), axis=0)  # [E]
-    starts = jnp.concatenate(
-        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1].astype(jnp.int32)]
+    eidx_sorted, perm, counts, starts, local, inv_perm, by_token = _sort_by_expert(
+        eidx, t, k, ne
     )
-    local = a_idx - jnp.take(starts, eidx_sorted)  # position within expert
     keep_sorted = local < cap
-
-    # one tiny int32 scatter builds the inverse permutation; everything
-    # else that needs original-order views gathers through it
-    inv_perm = jnp.zeros((t * k,), jnp.int32).at[perm].set(a_idx)
-    # ``by_token``: sorted-assignment indices ordered token-major — every
-    # token has exactly K assignments (at k-major slots kk*T + t), so
-    # inv_perm laid out [K, T] and transposed gives each token's K rows
-    # consecutively.  This is what makes the dispatch-gather VJP a static
-    # reshape-sum instead of a scatter-add.
-    by_token = inv_perm.reshape(k, t).T.reshape(t * k)
 
     tok_sorted = perm % t
     x_sorted = _take_by_token(flat.astype(ct), tok_sorted, by_token, t, k)  # [T*K, e]
@@ -348,9 +483,12 @@ def moe_ffn(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     """
     if cfg.dispatch == "sort":
         return _moe_ffn_sorted(x, layer, cfg)
+    if cfg.dispatch == "gmm":
+        return _moe_ffn_gmm(x, layer, cfg)
     if cfg.dispatch != "scatter":
         raise ValueError(
-            f"unknown MoeConfig.dispatch {cfg.dispatch!r}; use 'scatter' or 'sort'"
+            f"unknown MoeConfig.dispatch {cfg.dispatch!r}; use 'scatter', "
+            "'sort', or 'gmm'"
         )
     ct = cfg.dtype
     b, s, e = x.shape

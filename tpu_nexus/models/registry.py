@@ -168,13 +168,14 @@ class MoeAdapter(ModelAdapter):
                 "MoE family: the per-layer router aux losses would need to "
                 "ride the pipeline; shard experts over ep instead"
             )
-        if cfg.dispatch == "sort" and mesh is not None and mesh.shape.get("ep", 1) > 1:
-            # the sort path's per-expert dynamic slices cannot partition
-            # over ep — GSPMD would silently replicate the expert buffers
-            # and defeat expert parallelism, so refuse loudly here (the one
-            # place that sees both the config and the mesh)
+        if cfg.dispatch in ("sort", "gmm") and mesh is not None and mesh.shape.get("ep", 1) > 1:
+            # the sort path's per-expert dynamic slices and the gmm path's
+            # tile-padded row layout cannot partition over ep — GSPMD would
+            # silently replicate the expert buffers and defeat expert
+            # parallelism, so refuse loudly here (the one place that sees
+            # both the config and the mesh)
             raise ValueError(
-                "MoeConfig.dispatch='sort' is a single-chip/replicated-expert "
+                f"MoeConfig.dispatch={cfg.dispatch!r} is a single-chip/replicated-expert "
                 f"optimization and cannot run on an ep-sharded mesh (ep={mesh.shape['ep']}); "
                 "use dispatch='scatter' for expert parallelism"
             )

@@ -1,0 +1,232 @@
+"""Pallas TPU grouped matmul (megablox-style) for MoE expert compute.
+
+``gmm(lhs, rhs, tile_expert)`` multiplies row-groups of ``lhs [M, K]``
+against per-group weights ``rhs [E, K, N]``: rows are pre-sorted by expert
+and group boundaries are TILE-ALIGNED (the dispatch pads each expert's rows
+up to a multiple of the m-tile), so every ``[block_m, K]`` row tile belongs
+to exactly one expert.  The expert id per tile arrives as a scalar-prefetch
+array that the rhs BlockSpec index map reads — the kernel streams exactly
+one expert's ``[K, block_n]`` weight tile per grid step, so HBM traffic is
+O(tokens·K + tiles·K·block_n) and compute is proportional to the *actual*
+token count (no capacity-factor inflation, no dropped tokens).
+
+This is the TPU-native answer to the reference-free MoE bottleneck measured
+in PERF.md r3: with capacity buffers, dispatch+combine cost ≈55% of
+moe_ffn fwd+bwd; tile-aligned grouping deletes the buffers entirely.
+``jax.lax.ragged_dot`` covers the same contract but measured ~45% below the
+batched einsum per FLOP at bench shapes (PERF.md r3), hence this kernel.
+
+Backward splits into the two standard pieces, both grouped:
+* ``d_lhs = gmm(d_out, rhs^T)`` — the same kernel with swapped weight dims;
+* ``d_rhs = tgmm(lhs, d_out)`` — per-expert ``lhsᵀ·d_out`` accumulated in a
+  f32 VMEM-resident output block; row tiles are expert-sorted, so each
+  expert's output block is visited in one contiguous run (zero-init on the
+  run's first tile, accumulate after — no revisits, no races).
+
+Everything is static-shaped; the only data-dependent values are the
+scalar-prefetch tile→expert ids, which affect *addresses*, not shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# m-tile edge: must divide the padded row count; the MoE dispatch pads each
+# expert's rows to a multiple of this.  512 balances MXU efficiency against
+# per-expert padding waste (≤ E·512 wasted rows).  n/k tiles swept on v5e;
+# tgmm splits K too (its f32 [1, K, bn] output block at K=2048 blew the
+# 16 MB scoped-VMEM budget).  Env overrides for tuning sweeps.
+import os as _os
+
+BLOCK_M = int(_os.environ.get("NEXUS_GMM_BLOCK_M", 512))
+BLOCK_N = int(_os.environ.get("NEXUS_GMM_BLOCK_N", 1024))
+BLOCK_K = int(_os.environ.get("NEXUS_GMM_BLOCK_K", 512))
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def _block_for(n: int, target: int) -> int:
+    b = min(target, n)
+    while n % b:
+        b //= 2
+    return max(b, 1)
+
+
+# -- kernels -------------------------------------------------------------------
+
+
+def _gmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref):
+    """out[i, j] = lhs_tile · rhs[te[i]] — one whole-K dot per grid step.
+
+    m-tiles iterate MINOR-MOST: consecutive steps inside one expert's tile
+    run keep the same rhs block index, so the revisit optimization elides
+    the [K, block_n] weight DMA — expert weights stream from HBM once per
+    n-sweep instead of once per m-tile (the difference between ~32 MB and
+    ~2 GB of weight traffic per call at bench shapes)."""
+    del te_ref  # consumed by the rhs index map
+    out_ref[...] = jax.lax.dot_general(
+        lhs_ref[...], rhs_ref[0],
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(out_ref.dtype)
+
+
+def _tgmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref):
+    """out[te[i]] += lhs_tileᵀ · rhs_tile over the minor-most m-tile axis.
+    Tiles are expert-sorted, so each expert's output block is one contiguous
+    run of grid steps: zero-filled at the run's first tile, accumulated for
+    the rest, flushed when the block index changes."""
+    i = pl.program_id(2)
+    first = jnp.logical_or(i == 0, te_ref[jnp.maximum(i - 1, 0)] != te_ref[i])
+    acc = jax.lax.dot_general(
+        lhs_ref[...], rhs_ref[...],
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(first)
+    def _init():
+        out_ref[0] = acc
+
+    @pl.when(jnp.logical_not(first))
+    def _acc():
+        out_ref[0] += acc
+
+
+# -- reference path (CPU tests / non-TPU backends) ----------------------------
+
+
+def _gmm_ref(lhs, rhs, tile_expert, block_m):
+    nt = lhs.shape[0] // block_m
+    lt = lhs.reshape(nt, block_m, lhs.shape[1])
+    wt = jnp.take(rhs, tile_expert, axis=0)  # [nt, K, N] — test shapes only
+    return jnp.einsum(
+        "tbk,tkn->tbn", lt, wt, preferred_element_type=jnp.float32
+    ).astype(lhs.dtype).reshape(nt * block_m, rhs.shape[2])
+
+
+def _tgmm_ref(lhs, rhs, tile_expert, n_experts, block_m):
+    nt = lhs.shape[0] // block_m
+    lt = lhs.reshape(nt, block_m, lhs.shape[1])
+    rt = rhs.reshape(nt, block_m, rhs.shape[1])
+    per_tile = jnp.einsum(
+        "tbk,tbn->tkn", lt, rt, preferred_element_type=jnp.float32
+    )
+    onehot = jax.nn.one_hot(tile_expert, n_experts, dtype=per_tile.dtype)
+    return jnp.einsum("tkn,te->ekn", per_tile, onehot)
+
+
+# -- public entry points -------------------------------------------------------
+
+
+def gmm_supported(lhs, rhs) -> bool:
+    """Shapes the kernels handle (lane-dim multiples of 128 for the MXU);
+    callers fall back to the gather-einsum reference otherwise."""
+    m, k = lhs.shape
+    n = rhs.shape[2]
+    return _on_tpu() and k % 128 == 0 and n % 128 == 0 and m % 128 == 0
+
+
+def _gmm_raw(lhs, rhs, tile_expert, block_m, block_n, interpret):
+    m, k = lhs.shape
+    ne, _, n = rhs.shape
+    bm = _block_for(m, block_m)
+    bn = _block_for(n, block_n)
+    grid = (n // bn, m // bm)  # m minor-most: weight DMA elided in expert runs
+    return pl.pallas_call(
+        _gmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), lhs.dtype),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, k), lambda j, i, te: (i, 0)),
+                pl.BlockSpec((1, k, bn), lambda j, i, te: (te[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((bm, bn), lambda j, i, te: (i, j)),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(lhs.size + m * n) * lhs.dtype.itemsize
+            + grid[0] * k * bn * rhs.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(tile_expert, lhs, rhs)
+
+
+def _tgmm_raw(lhs, rhs, tile_expert, n_experts, block_m, block_n, interpret):
+    m, k = lhs.shape
+    n = rhs.shape[1]
+    bm = _block_for(m, block_m)
+    bn = _block_for(n, block_n)
+    bk = _block_for(k, BLOCK_K)
+    # m-tiles minor-most: expert runs stay contiguous per (k, n) block
+    grid = (k // bk, n // bn, m // bm)
+    return pl.pallas_call(
+        _tgmm_kernel,
+        out_shape=jax.ShapeDtypeStruct((n_experts, k, n), jnp.float32),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((bm, bk), lambda kb, j, i, te: (i, kb)),
+                pl.BlockSpec((bm, bn), lambda kb, j, i, te: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, bk, bn), lambda kb, j, i, te: (te[i], kb, j)),
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            bytes_accessed=(lhs.size + rhs.size) * lhs.dtype.itemsize
+            + n_experts * k * n * 4,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(tile_expert, lhs, rhs)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def gmm(lhs, rhs, tile_expert, block_m=BLOCK_M, block_n=BLOCK_N, interpret=False):
+    """Grouped matmul ``[M, K] × [E, K, N] → [M, N]`` with tile-aligned
+    expert runs; ``tile_expert [M / block_m]`` int32 maps each m-tile to its
+    expert.  Differentiable (custom VJP: transposed gmm + tgmm)."""
+    return _gmm_fwd(lhs, rhs, tile_expert, block_m, block_n, interpret)[0]
+
+
+def _gmm_fwd(lhs, rhs, tile_expert, block_m, block_n, interpret):
+    if interpret or gmm_supported(lhs, rhs):
+        out = _gmm_raw(lhs, rhs, tile_expert, block_m, block_n, interpret)
+    else:
+        out = _gmm_ref(lhs, rhs, tile_expert, _block_for(lhs.shape[0], block_m))
+    return out, (lhs, rhs, tile_expert)
+
+
+def _gmm_bwd(block_m, block_n, interpret, res, d_out):
+    lhs, rhs, tile_expert = res
+    rhs_t = jnp.swapaxes(rhs, 1, 2)  # [E, N, K]
+    if interpret or gmm_supported(d_out, rhs_t):
+        d_lhs = _gmm_raw(d_out, rhs_t, tile_expert, block_m, block_n, interpret)
+        d_rhs = _tgmm_raw(
+            lhs, d_out, tile_expert, rhs.shape[0], block_m, block_n, interpret
+        )
+    else:
+        bm = _block_for(lhs.shape[0], block_m)
+        d_lhs = _gmm_ref(d_out, rhs_t, tile_expert, bm)
+        d_rhs = _tgmm_ref(lhs, d_out, tile_expert, rhs.shape[0], bm)
+    import numpy as np
+
+    f0 = np.zeros(tile_expert.shape, jax.dtypes.float0)
+    return d_lhs.astype(lhs.dtype), d_rhs.astype(rhs.dtype), f0
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
