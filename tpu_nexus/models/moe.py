@@ -581,6 +581,105 @@ def moe_hidden(
     return rms_norm(x, params["out_norm"], cfg.norm_eps), aux
 
 
+def moe_hidden_pp(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: MoeConfig,
+    *,
+    n_stages: int,
+    microbatches: int = 0,
+    mesh: Any = None,
+    batch_axes: Any = ("dp", "fsdp"),
+    positions: Optional[jax.Array] = None,
+    attn_fn: Optional[AttnFn] = None,
+    attn_impl: str = "auto",
+):
+    """:func:`moe_hidden` over a pipeline-parallel layer stack (``pp`` mesh
+    axis) — the MoE counterpart of ``llama_hidden_pp``.  The router aux
+    accumulators (load-balance, router-z, dropped-frac sums) ride the
+    pipeline as part of each microbatch's carry pytree, so every
+    microbatch's aux arrives at the last stage with its activations; the
+    returned aux averages over microbatches AND layers.  Requires
+    ``dispatch='scatter'`` (the one dispatch whose ops are all plainly
+    vmappable over the stage axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_nexus.ops import attention as _ops_attention
+    from tpu_nexus.parallel.pipeline import auto_microbatches, pipeline_apply
+
+    if cfg.dispatch != "scatter":
+        raise ValueError(
+            f"pipeline parallelism requires MoeConfig.dispatch='scatter', got {cfg.dispatch!r}"
+        )
+    if tokens.shape[1] > cfg.max_seq_len:
+        raise ValueError(
+            f"sequence length {tokens.shape[1]} exceeds the config's "
+            f"max_seq_len {cfg.max_seq_len}"
+        )
+    if positions is None:
+        positions = jnp.broadcast_to(
+            jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape
+        )
+    if attn_fn is None:
+        def attn_fn(q, k, v, causal=True):
+            return _ops_attention(q, k, v, causal=causal, impl=attn_impl)
+
+    ct = cfg.dtype
+    b = tokens.shape[0]
+    x = params["embed"]["tokens"].astype(ct)[tokens]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+
+    axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes or ())
+    dp_extent = 1
+    if mesh is not None:
+        dp_extent = math.prod(mesh.shape.get(a, 1) for a in axes)
+    if not microbatches:
+        microbatches = auto_microbatches(b, n_stages, min_microbatch=dp_extent)
+
+    def layer_fn(carry, layer):
+        x, cos, sin, lb, rz, dr = carry
+        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        ffn_out, aux = moe_ffn(h, layer, cfg)
+        x = x + ffn_out
+        return (
+            x, cos, sin,
+            lb + aux["load_balance"], rz + aux["router_z"], dr + aux["dropped_frac"],
+        )
+
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer_fn, policy=remat_policy(cfg.remat_policy))
+
+    # per-microbatch scalar aux accumulators: [mb_dim-free] scalars do not
+    # survive the microbatch split, so carry them per-ROW ([B]) and mean at
+    # the end — row-shaped aux also shards like the batch
+    zeros = jnp.zeros((b,), jnp.float32)
+    spec = (
+        P(axes, None, None),
+        P(axes, None, None, None),
+        P(axes, None, None, None),
+        P(axes),
+        P(axes),
+        P(axes),
+    )
+    x, _, _, lb, rz, dr = pipeline_apply(
+        layer_fn,
+        params["layers"],
+        (x, cos, sin, zeros, zeros, zeros),
+        n_stages=n_stages,
+        microbatches=microbatches,
+        mesh=mesh,
+        microbatch_spec=spec,
+        unroll=cfg.scan_unroll,
+    )
+    aux = {
+        "load_balance": jnp.mean(lb) / cfg.n_layers,
+        "router_z": jnp.mean(rz) / cfg.n_layers,
+        "dropped_frac": jnp.mean(dr) / cfg.n_layers,
+    }
+    return rms_norm(x, params["out_norm"], cfg.norm_eps), aux
+
+
 def moe_head(params: Dict[str, Any], cfg: MoeConfig) -> jax.Array:
     if cfg.tied_embeddings:
         return params["embed"]["tokens"].astype(cfg.dtype).T

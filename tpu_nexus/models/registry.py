@@ -160,13 +160,20 @@ class MoeAdapter(ModelAdapter):
     def make_loss(self, train_cfg, mesh, rules=None):
         from tpu_nexus.workload.train import chunked_next_token_loss
 
+        from tpu_nexus.models.moe import moe_hidden_pp
+
         attn_fn = _ring_attn_fn(mesh)
         cfg = self.config
-        if mesh is not None and mesh.shape.get("pp", 1) > 1:
+        pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+        if pp > 1 and attn_fn is not None:
             raise ValueError(
-                "pipeline parallelism (pp > 1) is not yet supported for the "
-                "MoE family: the per-layer router aux losses would need to "
-                "ride the pipeline; shard experts over ep instead"
+                "pp > 1 with sp > 1 is not supported: ring attention cannot "
+                "run inside the pipeline's stage vmap"
+            )
+        if pp > 1 and cfg.dispatch != "scatter":
+            raise ValueError(
+                f"pipeline parallelism requires MoeConfig.dispatch='scatter' "
+                f"(plainly stage-vmappable ops), got {cfg.dispatch!r}"
             )
         if cfg.dispatch in ("sort", "gmm") and mesh is not None and mesh.shape.get("ep", 1) > 1:
             # the sort path's per-expert dynamic slices and the gmm path's
@@ -181,9 +188,18 @@ class MoeAdapter(ModelAdapter):
             )
         z_loss = getattr(train_cfg, "z_loss", 0.0)
         ce_chunk = getattr(train_cfg, "ce_chunk", 256)
+        pp_microbatches = getattr(train_cfg, "pp_microbatches", 0)
+        batch_axes = (rules or {}).get("batch", ("dp", "fsdp"))
 
         def loss_fn(params, tokens):
-            hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)
+            if pp > 1:
+                hidden, aux = moe_hidden_pp(
+                    params, tokens, cfg, n_stages=pp,
+                    microbatches=pp_microbatches, mesh=mesh,
+                    batch_axes=batch_axes,
+                )
+            else:
+                hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)
             head = moe_head(params, cfg)
             loss, metrics = chunked_next_token_loss(hidden, head, tokens, z_loss, chunk=ce_chunk)
             loss = (
