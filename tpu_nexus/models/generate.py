@@ -1,4 +1,4 @@
-"""KV-cache autoregressive decoding for the Llama family.
+"""KV-cache autoregressive decoding for the Llama AND MoE families.
 
 No reference counterpart (the reference supervises opaque algorithm
 containers, SURVEY.md §2.7); this completes the model zoo's inference
@@ -39,7 +39,33 @@ from tpu_nexus.models.llama import (
     rope_tables,
     _rope,
 )
+from tpu_nexus.models.moe import MoeConfig, moe_ffn, moe_head, moe_hidden
 from tpu_nexus.ops.rmsnorm import rms_norm
+
+ModelConfig = Any  # LlamaConfig | MoeConfig — same stacked-layer layout
+
+
+def _prefill_hidden_kv(params, tokens, cfg):
+    """Family dispatch for the prompt pass (router aux is irrelevant at
+    inference and dropped here)."""
+    if isinstance(cfg, MoeConfig):
+        hidden, _aux, kv = moe_hidden(params, tokens, cfg, return_kv=True)
+        return hidden, kv
+    return llama_hidden(params, tokens, cfg, return_kv=True)
+
+
+def _head(params, cfg):
+    return moe_head(params, cfg) if isinstance(cfg, MoeConfig) else llama_head(params, cfg)
+
+
+def _ffn_block(x, layer, cfg):
+    """Post-attention sub-block: dense SwiGLU (Llama) or routed experts
+    (MoE; per-step router over the B decode tokens, aux discarded)."""
+    if isinstance(cfg, MoeConfig):
+        h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
+        out, _aux = moe_ffn(h, layer, cfg)
+        return x + out
+    return mlp_block(x, layer, cfg)
 
 _NEG_INF = -1e30
 
@@ -67,7 +93,7 @@ def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array
 def prefill(
     params: Dict[str, Any],
     tokens: jax.Array,
-    cfg: LlamaConfig,
+    cfg: ModelConfig,
     max_len: int,
 ) -> Tuple[Cache, jax.Array]:
     """Run the prompt through the training forward once; return the padded
@@ -75,10 +101,10 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache max_len {max_len}")
-    hidden, (k, v) = llama_hidden(params, tokens, cfg, return_kv=True)
+    hidden, (k, v) = _prefill_hidden_kv(params, tokens, cfg)
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
     cache = {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
-    logits = jnp.einsum("be,ev->bv", hidden[:, -1], llama_head(params, cfg))
+    logits = jnp.einsum("be,ev->bv", hidden[:, -1], _head(params, cfg))
     return cache, logits
 
 
@@ -87,7 +113,7 @@ def decode_step(
     cache: Cache,
     token: jax.Array,
     pos: jax.Array,
-    cfg: LlamaConfig,
+    cfg: ModelConfig,
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step: ``token`` [B] at scalar position ``pos`` →
     (logits [B, vocab], updated cache).  Mirrors the training block exactly
@@ -110,21 +136,21 @@ def decode_step(
         cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
         o = cached_attention(q, ck, cv, pos + 1)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
-        x = mlp_block(x, layer, cfg)
+        x = _ffn_block(x, layer, cfg)
         return x, (ck, cv)
 
     x, (ck_all, cv_all) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
-    logits = jnp.einsum("be,ev->bv", hidden[:, 0], llama_head(params, cfg))
+    logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))
     return logits, {"k": ck_all, "v": cv_all}
 
 
 def generate(
     params: Dict[str, Any],
     prompt: jax.Array,
-    cfg: LlamaConfig,
+    cfg: ModelConfig,
     *,
     max_new_tokens: int,
     temperature: float = 0.0,

@@ -537,8 +537,11 @@ def moe_hidden(
     positions: Optional[jax.Array] = None,
     attn_fn: Optional[AttnFn] = None,
     attn_impl: str = "auto",
+    return_kv: bool = False,
 ):
-    """Final-norm hidden states [B, S, e] + accumulated router aux losses."""
+    """Final-norm hidden states [B, S, e] + accumulated router aux losses.
+    ``return_kv=True`` → ``(hidden, aux, (k, v))`` with K/V stacked per
+    layer ``[L, B, S, Hkv, D]`` (decode prefill, models/generate.py)."""
     from tpu_nexus.ops import attention as _ops_attention
 
     if tokens.shape[1] > cfg.max_seq_len:
@@ -560,17 +563,18 @@ def moe_hidden(
 
     def block(carry, layer):
         x, lb, rz = carry
-        x = attention_block(x, layer, cfg, cos, sin, attn_fn)
+        x, kv = attention_block(x, layer, cfg, cos, sin, attn_fn, collect_kv=True)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         ffn_out, aux = moe_ffn(h, layer, cfg)
         x = x + ffn_out
-        return (x, lb + aux["load_balance"], rz + aux["router_z"]), aux["dropped_frac"]
+        carry = (x, lb + aux["load_balance"], rz + aux["router_z"])
+        return carry, (aux["dropped_frac"], kv if return_kv else None)
 
     body = block
     if cfg.remat:
         body = jax.checkpoint(block, policy=remat_policy(cfg.remat_policy))
     zero = jnp.zeros((), jnp.float32)
-    (x, lb, rz), dropped = jax.lax.scan(
+    (x, lb, rz), (dropped, kv) = jax.lax.scan(
         body, (x, zero, zero), params["layers"], unroll=cfg.scan_unroll
     )
     aux = {
@@ -578,7 +582,10 @@ def moe_hidden(
         "router_z": rz / cfg.n_layers,
         "dropped_frac": jnp.mean(dropped),
     }
-    return rms_norm(x, params["out_norm"], cfg.norm_eps), aux
+    hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    if return_kv:
+        return hidden, aux, kv
+    return hidden, aux
 
 
 def moe_hidden_pp(
