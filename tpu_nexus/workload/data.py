@@ -31,6 +31,59 @@ def synthetic_tokens(
         yield rng.choice(vocab_size, size=(batch, seq_len), p=probs).astype(np.int32)
 
 
+def token_file_batches(
+    path: str,
+    batch: int,
+    seq_len: int,
+    seed: int = 0,
+) -> Iterator[np.ndarray]:
+    """Batches of random seq_len windows from a memory-mapped token corpus.
+
+    The corpus format is a 1-D integer ``.npy`` array of token ids —
+    self-describing (dtype + length in the header), memory-mapped so a
+    multi-gigabyte corpus costs no RSS and no startup time.  Sampling is
+    epochless uniform random windows, deterministic in ``seed``: the
+    harness hands each process ``seed + process_id`` (disjoint shards, no
+    data service) and restart-from-step fast-forwards the stream by
+    drawing and discarding, which reproduces exactly the batches the
+    interrupted run saw — the same contract :func:`synthetic_tokens`
+    established.
+    """
+    # validate eagerly (this wrapper is not a generator, so a bad corpus
+    # fails at construction, not at the first batch draw)
+    corpus = np.load(path, mmap_mode="r")
+    if corpus.ndim != 1 or not np.issubdtype(corpus.dtype, np.integer):
+        raise ValueError(
+            f"token corpus {path} must be a 1-D integer .npy array, got "
+            f"shape {corpus.shape} dtype {corpus.dtype}"
+        )
+    if corpus.shape[0] <= seq_len:
+        raise ValueError(
+            f"token corpus {path} has {corpus.shape[0]} tokens <= seq_len {seq_len}"
+        )
+
+    def gen() -> Iterator[np.ndarray]:
+        rng = np.random.default_rng(seed)
+        hi = corpus.shape[0] - seq_len
+        while True:
+            starts = rng.integers(0, hi, size=batch)
+            yield np.stack(
+                [corpus[s : s + seq_len] for s in starts]
+            ).astype(np.int32)
+
+    return gen()
+
+
+def write_token_npy(path: str, tokens: np.ndarray) -> str:
+    """Persist a 1-D token-id array as the corpus format above (helper for
+    tests and corpus-prep scripts)."""
+    tokens = np.asarray(tokens)
+    if tokens.ndim != 1 or not np.issubdtype(tokens.dtype, np.integer):
+        raise ValueError("tokens must be a 1-D integer array")
+    np.save(path, tokens)
+    return path if path.endswith(".npy") else path + ".npy"
+
+
 def synthetic_mnist(batch: int, seed: int = 0) -> Iterator[tuple]:
     """(images [B, 784] f32, labels [B] i32) pairs with class-dependent means
     so training actually separates them."""

@@ -81,6 +81,14 @@ class WorkloadConfig:
     checkpoint_every: int = 0  # 0 = disabled
     checkpoint_dir: str = ""
     seed: int = 0
+    #: path to a 1-D integer .npy token corpus (workload/data.py
+    #: token_file_batches); empty = the adapter's synthetic stream.  LM
+    #: adapters only (token batches [B, S]).
+    data_path: str = ""
+    #: every N train steps, run `eval_steps` loss-only batches on a
+    #: held-out stream (disjoint seed) and log/report eval_loss; 0 = off
+    eval_every: int = 0
+    eval_steps: int = 4
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "WorkloadConfig":
@@ -116,6 +124,9 @@ class WorkloadConfig:
             checkpoint_every=int(e.get("NEXUS_CHECKPOINT_EVERY", "0")),
             checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
             seed=int(e.get("NEXUS_SEED", "0")),
+            data_path=e.get("NEXUS_DATA_PATH", ""),
+            eval_every=int(e.get("NEXUS_EVAL_EVERY", "0")),
+            eval_steps=int(e.get("NEXUS_EVAL_STEPS", "4")),
         )
 
 
@@ -252,10 +263,26 @@ def run_workload(
     #    processes — e.g. the sp=2 cross-process ring rehearsal — batch rows
     #    are no longer process-aligned, so every process generates the SAME
     #    full global batch (base seed) and each device slices its shard.
+    def make_stream(batch: int, seed: int):
+        """Per-process batch stream: the corpus file when configured
+        (NEXUS_DATA_PATH), else the adapter's synthetic data — same
+        iterator contract, so resume fast-forward and multi-process
+        seeding work identically."""
+        if cfg.data_path:
+            if adapter.batch_axes() != ("batch", "seq"):
+                raise ValueError(
+                    "data_path requires a token-batch (LM) adapter; "
+                    f"{adapter.name!r} has batch axes {adapter.batch_axes()!r}"
+                )
+            from tpu_nexus.workload.data import token_file_batches
+
+            return token_file_batches(cfg.data_path, batch, cfg.seq_len, seed=seed)
+        return adapter.data(batch, cfg.seq_len, seed=seed)
+
     replicated_data = ctx.num_processes > 1 and _nonbatch_axis_spans_processes(mesh, cfg.rules)
     if data is None:
         if replicated_data:
-            data = adapter.data(cfg.batch_size, cfg.seq_len, seed=cfg.seed)
+            data = make_stream(cfg.batch_size, seed=cfg.seed)
         else:
             # only the row-split mode needs batch % processes == 0
             if cfg.batch_size % ctx.num_processes:
@@ -263,7 +290,7 @@ def run_workload(
                     f"batch {cfg.batch_size} not divisible by {ctx.num_processes} processes"
                 )
             local_batch = cfg.batch_size // ctx.num_processes
-            data = adapter.data(local_batch, cfg.seq_len, seed=cfg.seed + ctx.process_id)
+            data = make_stream(local_batch, seed=cfg.seed + ctx.process_id)
     # restart-from-step must also restart-from-*data*: fast-forward the
     # stream so resumed steps see the batches they would have seen, not a
     # replay of batch 0..N (which silently corrupts the training trajectory)
@@ -289,6 +316,20 @@ def run_workload(
             )
         return jax.tree.map(jax.numpy.asarray, raw)
 
+    eval_fn = None
+    eval_data = None
+    eval_loss: Optional[float] = None
+    if cfg.eval_every:
+        from tpu_nexus.workload.train import make_eval_step
+
+        eval_fn = make_eval_step(adapter, cfg.train, mesh, cfg.rules)
+        # held-out stream: a seed offset no training process uses (training
+        # seeds are cfg.seed + process_id), disjoint per process in
+        # row-split mode
+        eval_seed = cfg.seed + 7919 + (0 if replicated_data else ctx.process_id)
+        eval_batch = cfg.batch_size if replicated_data else cfg.batch_size // ctx.num_processes
+        eval_data = make_stream(eval_batch, seed=eval_seed)
+
     reporter.running()
     metrics: Dict[str, Any] = {}
     t0 = time.perf_counter()
@@ -306,6 +347,13 @@ def run_workload(
                     metrics = {k: float(v) for k, v in m.items()}
                     reporter.heartbeat(step + 1)
                     logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
+                if eval_fn and (step + 1) % cfg.eval_every == 0:
+                    losses = [
+                        eval_fn(state, to_global(next(eval_data)))["loss"]
+                        for _ in range(cfg.eval_steps)
+                    ]
+                    eval_loss = float(sum(losses)) / max(len(losses), 1)
+                    logger.info("step %d eval_loss %.4f", step + 1, eval_loss)
                 if ckpt and (step + 1) % cfg.checkpoint_every == 0:
                     uri = ckpt.save(step + 1, state)
                     reporter.tensor_checkpoint(uri, step + 1)
@@ -342,5 +390,6 @@ def run_workload(
         "resumed_from": resumed_from,
         "elapsed_s": elapsed,
         "tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        **({"eval_loss": eval_loss} if eval_loss is not None else {}),
         **metrics,
     }

@@ -203,6 +203,27 @@ def batch_shardings(model: Any, mesh: Mesh, rules: RuleTable) -> Any:
     )
 
 
+def make_eval_step(
+    model: Any,
+    train_cfg: TrainConfig,
+    mesh: Mesh,
+    rules: RuleTable,
+) -> Callable[[Dict[str, Any], Any], Dict[str, jax.Array]]:
+    """Jitted loss-only step (no grads, no state mutation) for periodic
+    held-out evaluation in the harness — same adapter loss, same shardings,
+    a fraction of the step cost."""
+    adapter = _as_adapter(model)
+    loss_fn = adapter.make_loss(train_cfg, mesh, rules=rules)
+    shardings = batch_shardings(adapter, mesh, rules)
+
+    def eval_fn(state, batch):
+        batch = jax.lax.with_sharding_constraint(batch, shardings)
+        loss, metrics = loss_fn(state["params"], batch)
+        return dict(metrics, loss=loss)
+
+    return jax.jit(eval_fn)
+
+
 def make_train_step(
     model: Any,
     train_cfg: TrainConfig,
