@@ -41,12 +41,21 @@ def main() -> int:
     from tpu_nexus.app.config import SupervisorConfig
     from tpu_nexus.app.dependencies import ApplicationServices
     from tpu_nexus.core.config import load_config
-    from tpu_nexus.workload.harness import WorkloadConfig, run_workload
 
     logging.basicConfig(level=logging.INFO, stream=sys.stderr)
     cfg = load_config(SupervisorConfig)
     store = ApplicationServices().with_store_for(cfg).store
-    result = run_workload(WorkloadConfig.from_env(), store=store)
+    mode = os.environ.get("NEXUS_MODE", "train")
+    if mode == "serve":
+        from tpu_nexus.workload.serve import ServeConfig, run_serving
+
+        result = run_serving(ServeConfig.from_env(), store=store)
+    elif mode == "train":
+        from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+
+        result = run_workload(WorkloadConfig.from_env(), store=store)
+    else:
+        raise SystemExit(f"unknown NEXUS_MODE {mode!r}; use 'train' or 'serve'")
     logging.getLogger(__name__).info("workload done: %s", result)
     return 0
 

@@ -1,0 +1,150 @@
+"""Serving workload: supervised batch-inference jobs.
+
+The reference supervises opaque *algorithm* containers — nothing restricts
+them to training (SURVEY.md §2.2: any pod carrying the run labels).  This
+module makes inference a first-class supervised workload: the same ledger
+protocol (RUNNING → heartbeats with per-chip progress → COMPLETED), the
+same fault-injection hooks and failure-trace capture path via the
+harness's env contract, but the inner loop is KV-cache batch decoding
+(models/generate.py) instead of a train step.
+
+Launcher contract: ``NEXUS_MODE=serve`` selects this loop in the workload
+container entrypoint; ``NEXUS_PROMPT_LEN`` / ``NEXUS_GEN_TOKENS`` /
+``NEXUS_TEMPERATURE`` shape the decode; ``NEXUS_STEPS`` counts generate
+rounds; ``NEXUS_CHECKPOINT_DIR`` restores trained weights (the tensor
+checkpoint written by the training harness — restored through the same
+train-state template so serve always loads exactly what train saved).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from tpu_nexus.checkpoint.store import CheckpointStore
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.registry import LlamaAdapter, MoeAdapter, adapter_for, get_adapter
+from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
+from tpu_nexus.workload.faults import FaultPlan, maybe_inject
+from tpu_nexus.workload.harness import LedgerReporter
+from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+from tpu_nexus.workload.train import TrainConfig, init_train_state
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass(frozen=True)
+class ServeConfig:
+    model: Any = field(default_factory=LlamaConfig.tiny)
+    batch_size: int = 8
+    prompt_len: int = 32
+    gen_tokens: int = 32
+    rounds: int = 10
+    temperature: float = 0.0
+    heartbeat_every: int = 2
+    checkpoint_dir: str = ""
+    seed: int = 0
+
+    @staticmethod
+    def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
+        import os
+
+        e = os.environ if env is None else env
+        return ServeConfig(
+            model=get_adapter(e.get("NEXUS_MODEL_PRESET", "tiny")),
+            batch_size=int(e.get("NEXUS_BATCH", "8")),
+            prompt_len=int(e.get("NEXUS_PROMPT_LEN", "32")),
+            gen_tokens=int(e.get("NEXUS_GEN_TOKENS", "32")),
+            rounds=int(e.get("NEXUS_STEPS", "10")),
+            temperature=float(e.get("NEXUS_TEMPERATURE", "0.0")),
+            heartbeat_every=int(e.get("NEXUS_HEARTBEAT_EVERY", "2")),
+            checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
+            seed=int(e.get("NEXUS_SEED", "0")),
+        )
+
+
+def run_serving(
+    cfg: ServeConfig,
+    store: Optional[CheckpointStore] = None,
+    ctx: Optional[ProcessContext] = None,
+    prompts: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """Run the batch-decode loop under the ledger protocol; returns summary
+    metrics (rounds, decoded tokens/s).  ``prompts`` is an injectable
+    iterator of int32 ``[B, prompt_len]`` arrays (tests); default is the
+    synthetic token stream."""
+    ctx = initialize_distributed(ctx)
+    reporter = LedgerReporter(store, ctx)
+    plan = FaultPlan.from_env()
+    adapter = adapter_for(cfg.model)
+    if not isinstance(adapter, (LlamaAdapter, MoeAdapter)):
+        raise ValueError(
+            f"serving requires an LM adapter (llama/moe), got {adapter.name!r}"
+        )
+    mcfg = adapter.config
+    logger.info("serving %s/%s: model %s", ctx.algorithm, ctx.run_id, adapter.name)
+
+    params = adapter.init(jax.random.PRNGKey(cfg.seed))
+    restored_from: Optional[int] = None
+    if cfg.checkpoint_dir:
+        ckpt = TensorCheckpointer(cfg.checkpoint_dir)
+        latest = ckpt.latest_step()
+        if latest is not None:
+            # restore through the train-state template so serve loads
+            # exactly the structure train saved, then keep only the params
+            template = init_train_state(
+                jax.random.PRNGKey(cfg.seed), adapter, TrainConfig()
+            )
+            params = ckpt.restore(template, latest)["params"]
+            restored_from = latest
+            logger.info("restored tensor checkpoint at step %d", latest)
+        ckpt.close()
+
+    if prompts is None:
+        prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
+
+    import functools
+
+    gen_fn = jax.jit(
+        functools.partial(
+            generate,
+            cfg=mcfg,
+            max_new_tokens=cfg.gen_tokens,
+            temperature=cfg.temperature,
+        )
+    )
+    key = jax.random.PRNGKey(cfg.seed)
+
+    reporter.running()
+    t0 = time.perf_counter()
+    tokens_done = 0
+    last = None
+    for r in range(cfg.rounds):
+        maybe_inject(plan, r)
+        batch = jax.numpy.asarray(next(prompts))
+        key, sub = jax.random.split(key)
+        last = gen_fn(params, batch, key=sub)
+        tokens_done += int(np.prod(last.shape))
+        if cfg.heartbeat_every and (r + 1) % cfg.heartbeat_every == 0:
+            jax.block_until_ready(last)
+            reporter.heartbeat(r + 1)
+            logger.info("round %d: %d tokens decoded", r + 1, tokens_done)
+    jax.block_until_ready(last)
+    elapsed = time.perf_counter() - t0
+    reporter.heartbeat(cfg.rounds)
+    if ctx.is_coordinator:
+        reporter.completed()
+    return {
+        "rounds": cfg.rounds,
+        "restored_from": restored_from,
+        "elapsed_s": elapsed,
+        "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        "last_tokens_shape": tuple(last.shape) if last is not None else None,
+    }
