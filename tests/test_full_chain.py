@@ -173,3 +173,55 @@ async def test_full_chain_launch_run_fail(tmp_path):
     assert client.deleted("Job") == [rid]
     jobs_after, _ = await client.list_objects("Job", NS)
     assert jobs_after == []
+
+
+async def test_full_chain_serve_mode(tmp_path):
+    """Launcher-composed manifest with NEXUS_MODE=serve: the REAL workload
+    subprocess runs the batch-decode loop and commits COMPLETED — inference
+    jobs ride the identical launch/env/ledger contract as training."""
+    ledger = str(tmp_path / "ledger.db")
+    store = SqliteCheckpointStore(ledger)
+    client = FakeKubeClient({})
+    rid = str(uuid.uuid4())
+    launcher = Launcher(client, store, use_jobset=False)
+    spec = LaunchSpec(
+        run_id=rid,
+        algorithm=ALGORITHM,
+        image="tpu-nexus-workload:test",
+        num_hosts=1,
+        namespace=NS,
+        env={
+            "NEXUS_MODE": "serve",
+            "NEXUS_STEPS": "3",
+            "NEXUS_BATCH": "2",
+            "NEXUS_PROMPT_LEN": "8",
+            "NEXUS_GEN_TOKENS": "4",
+            "NEXUS_HEARTBEAT_EVERY": "1",
+        },
+    )
+    await launcher.launch(spec)
+    jobs, _ = await client.list_objects("Job", NS)
+
+    env = dict(os.environ)
+    env.update(_manifest_env(jobs[0]))
+    env.update(
+        {
+            "NEXUS__CQL_STORE_TYPE": "sqlite",
+            "NEXUS__SQLITE_STORE_PATH": ledger,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        }
+    )
+    proc = await asyncio.to_thread(
+        subprocess.run,
+        [sys.executable, "-m", "tpu_nexus.workload"],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=240,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    cp = store.read_checkpoint(ALGORITHM, rid)
+    assert cp.lifecycle_stage == LifecycleStage.COMPLETED
+    assert cp.per_chip_steps  # decode-round heartbeats landed
