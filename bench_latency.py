@@ -47,8 +47,11 @@ from tpu_nexus.supervisor.service import ProcessingConfig, Supervisor
 
 NS = "nexus"
 ALGORITHM = "storm-bench"
-RUNS = 64  # concurrent supervised runs
-HOSTS = 16  # hosts per run, each emitting the same failure event
+# defaults: 4x the BASELINE acceptance shape.  NEXUS_LATENCY_RUNS=1000
+# rehearses the reference's sizing note (".helm/values.yaml:124-125":
+# 1000+ pods wants >1 replica) on ONE supervisor.
+RUNS = int(os.environ.get("NEXUS_LATENCY_RUNS", "64"))  # concurrent runs
+HOSTS = int(os.environ.get("NEXUS_LATENCY_HOSTS", "16"))  # hosts per run
 TARGET_P50_SECONDS = 5.0  # BASELINE.json north star
 
 

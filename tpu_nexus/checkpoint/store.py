@@ -183,6 +183,13 @@ class SqliteCheckpointStore(CheckpointStore):
     def _connection(self) -> sqlite3.Connection:
         if self._conn is None:
             conn = sqlite3.connect(self._path, check_same_thread=False)
+            # WAL + NORMAL: one fsync per batch instead of two per commit —
+            # measured 12x on the 1000-run latency storm (PERF.md).  Commits
+            # survive process crashes; an OS/power crash may lose the tail,
+            # which matches this store's role (the durable production ledger
+            # is Scylla/CQL; sqlite is the single-node/CI stand-in)
+            conn.execute("PRAGMA journal_mode=WAL")
+            conn.execute("PRAGMA synchronous=NORMAL")
             cols = ", ".join(f"{c} TEXT" if c != "restart_count" else f"{c} INTEGER" for c in _COLUMNS)
             conn.execute(
                 f"CREATE TABLE IF NOT EXISTS checkpoints ({cols}, PRIMARY KEY (algorithm, id))"
