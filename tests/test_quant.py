@@ -1,0 +1,95 @@
+"""Int8 weight-only quantization: numerics, pytree mechanics, and the
+zero-change flow through the existing forward/decode paths."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models import LlamaConfig, MoeConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_forward, llama_init
+from tpu_nexus.models.moe import moe_hidden, moe_init
+from tpu_nexus.models.quant import QTensor, quantize_params, quantize_tensor, quantized_bytes
+
+
+class TestQTensor:
+    def test_roundtrip_error_bounded(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (64, 4, 16))
+        qt = quantize_tensor(w, (-3,))
+        deq = np.asarray(qt.astype(jnp.float32))
+        # symmetric per-channel int8: error < scale/2 per element
+        scale = np.asarray(qt.s)
+        assert np.all(np.abs(deq - np.asarray(w)) <= scale / 2 + 1e-7)
+        assert qt.q.dtype == jnp.int8 and qt.s.shape == (1, 4, 16)
+
+    def test_is_pytree_and_scans(self):
+        """Stacked QTensors slice per layer under lax.scan like any weight."""
+        w = jax.random.normal(jax.random.PRNGKey(0), (3, 8, 8))
+        qt = quantize_tensor(w, (-2,))
+
+        def body(c, layer_qt):
+            return c @ layer_qt.astype(jnp.float32), None
+
+        out, _ = jax.lax.scan(body, jnp.eye(8), qt)
+        ref = jnp.eye(8)
+        for i in range(3):
+            ref = ref @ (np.asarray(w[i] / qt.s[i]).round().clip(-127, 127) * np.asarray(qt.s[i]))
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+class TestQuantizedModels:
+    def test_llama_forward_close_and_decodes(self):
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        qparams = quantize_params(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        lf = np.asarray(llama_forward(params, tokens, cfg))
+        lq = np.asarray(llama_forward(qparams, tokens, cfg))
+        rel = np.abs(lq - lf).max() / (np.abs(lf).max() + 1e-9)
+        assert rel < 0.05, rel
+        toks = generate(qparams, tokens, cfg, max_new_tokens=4)
+        assert toks.shape == (2, 4) and int(toks.max()) < cfg.vocab_size
+
+    def test_moe_forward_close(self):
+        cfg = dataclasses.replace(MoeConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        qparams = quantize_params(params)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, cfg.vocab_size)
+        hf, _ = moe_hidden(params, tokens, cfg)
+        hq, _ = moe_hidden(qparams, tokens, cfg)
+        rel = np.abs(np.asarray(hq - hf)).max() / (np.abs(np.asarray(hf)).max() + 1e-9)
+        assert rel < 0.1, rel
+
+    def test_bytes_shrink(self):
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        qparams = quantize_params(params)
+        assert quantized_bytes(qparams) < 0.6 * quantized_bytes(params)
+
+    def test_serve_int8_mode(self):
+        from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+        from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+        from tpu_nexus.parallel.distributed import ProcessContext
+        from tpu_nexus.workload.serve import ServeConfig, run_serving
+
+        ctx = ProcessContext(
+            run_id="q-1", algorithm="a", process_id=0, num_processes=1, coordinator=None
+        )
+        store = InMemoryCheckpointStore()
+        store.upsert_checkpoint(
+            CheckpointedRequest(algorithm="a", id="q-1", lifecycle_stage=LifecycleStage.BUFFERED)
+        )
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8, gen_tokens=4,
+            rounds=2, quantize="int8",
+        )
+        summary = run_serving(cfg, store=store, ctx=ctx)
+        assert summary["last_tokens_shape"] == (2, 4)
+        assert store.read_checkpoint("a", "q-1").lifecycle_stage == LifecycleStage.COMPLETED
+        with pytest.raises(ValueError, match="quantize mode"):
+            run_serving(
+                dataclasses.replace(cfg, quantize="fp4"), store=store, ctx=ctx
+            )

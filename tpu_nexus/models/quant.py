@@ -1,0 +1,103 @@
+"""Int8 weight-only quantization for memory-bound decoding.
+
+Decode re-reads every parameter each step and measured ~63% of HBM
+bandwidth on weight traffic (PERF.md r3 decode section) — so halving the
+bytes is the serving lever, and weight-only int8 does it without touching
+activations or accumulation.
+
+Design: a :class:`QTensor` pytree wrapper (int8 values + per-output-channel
+f32 scales) that implements ``.astype(dtype)`` as dequantization.  Every
+matmul weight in the model zoo is consumed as ``layer[name].astype(ct)``
+(models/llama.py, models/moe.py), so quantized params flow through the
+UNCHANGED forward/decode code — ``lax.scan`` slices the stacked q/s leaves
+per layer like any other weight, and XLA fuses the convert+scale into the
+dot-general's operand read, so the weights cross HBM as int8.
+
+Scales are symmetric per output channel (amax over the contraction dims /
+127), the standard weight-only recipe.  Embeddings/norms stay in the
+original dtype: norms are tiny, and the embedding table is consumed by
+row-gather (and, tied, as the head) where a full-table dequant per step
+would cost more than it saves.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor:
+    """Int8 values + broadcast-ready f32 scales; ``astype`` dequantizes."""
+
+    def __init__(self, q: jax.Array, s: jax.Array) -> None:
+        self.q = q
+        self.s = s
+
+    def tree_flatten(self):
+        return (self.q, self.s), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def astype(self, dtype) -> jax.Array:
+        return self.q.astype(dtype) * self.s.astype(dtype)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"QTensor(int8 {self.q.shape}, scales {self.s.shape})"
+
+
+#: contraction axes per weight name, counted from the END so the same rule
+#: covers the Llama stacks [L, ...] and the MoE expert stacks [L, E, ...]:
+#: qkv projections contract the embedding dim at -3; the output projection
+#: contracts (heads, head_dim) at (-3, -2); the MLP/expert mats contract
+#: their -2 dim.
+_CONTRACT_AXES: Dict[str, tuple] = {
+    "wq": (-3,),
+    "wk": (-3,),
+    "wv": (-3,),
+    "wo": (-3, -2),
+    "w_gate": (-2,),
+    "w_up": (-2,),
+    "w_down": (-2,),
+}
+
+
+def quantize_tensor(w: jax.Array, axes: tuple) -> QTensor:
+    w32 = w.astype(jnp.float32)
+    s = jnp.max(jnp.abs(w32), axis=axes, keepdims=True) / 127.0
+    s = jnp.maximum(s, 1e-12)
+    q = jnp.clip(jnp.round(w32 / s), -127, 127).astype(jnp.int8)
+    return QTensor(q, s)
+
+
+def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+    """Quantize every matmul weight stack of a Llama/MoE params tree
+    (norms, router, and embeddings keep their dtype).  The result drops
+    into :func:`tpu_nexus.models.generate.generate` (and the full forward)
+    unchanged."""
+    layers = dict(params["layers"])
+    for name, axes in _CONTRACT_AXES.items():
+        if name in layers:
+            layers[name] = quantize_tensor(layers[name], axes)
+    return {**params, "layers": layers}
+
+
+def quantized_bytes(params: Dict[str, Any]) -> int:
+    """Weight bytes a decode step reads (diagnostic for the memory-bound
+    model: int8 leaves count 1 byte + scales, others their itemsize)."""
+    total = 0
+    for leaf in jax.tree.leaves(params):
+        total += leaf.size * leaf.dtype.itemsize
+    return total
