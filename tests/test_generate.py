@@ -119,6 +119,24 @@ class TestMoeDecodeParity:
         assert toks.shape == (2, 3)
         assert int(toks.max()) < cfg.vocab_size
 
+    def test_moe_decode_forces_scatter_dispatch(self, moe_setup, monkeypatch):
+        """A training-tuned gmm/sort dispatch default must not leak into
+        the decode step (tile padding inflates query-length-1 compute
+        ~70x) — the decode ffn always routes through scatter."""
+        import dataclasses as dc
+
+        import tpu_nexus.models.moe as moe_mod
+
+        cfg, params, prompt = moe_setup
+        cfg = dc.replace(cfg, dispatch="gmm")
+
+        def boom(*a, **k):  # pragma: no cover - should never run
+            raise AssertionError("gmm dispatch reached the decode path")
+
+        monkeypatch.setattr(moe_mod, "_moe_ffn_gmm", boom)
+        toks = generate(params, prompt, cfg, max_new_tokens=2)
+        assert toks.shape == (2, 2)
+
 
 class TestGenerateApi:
     def test_jit_compiles_once(self, setup):

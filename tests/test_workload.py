@@ -167,6 +167,29 @@ class TestTokenCorpus:
         c = next(token_file_batches(path, batch=4, seq_len=32, seed=4))
         assert not np.array_equal(first_a, c)
 
+    def test_final_window_reachable_and_range_split(self, tmp_path):
+        from tpu_nexus.workload.data import token_file_batches, write_token_npy
+
+        path = str(tmp_path / "c.npy")
+        write_token_npy(path, np.arange(40, dtype=np.uint16))
+        # corpus of exactly seq_len: one valid window, must not be rejected
+        one = next(token_file_batches(path, batch=2, seq_len=40, seed=0))
+        np.testing.assert_array_equal(one[0], np.arange(40))
+        # the final token is reachable (inclusive window bound)
+        seen_last = False
+        stream = token_file_batches(path, batch=8, seq_len=8, seed=1)
+        for _ in range(50):
+            if (next(stream)[:, -1] == 39).any():
+                seen_last = True
+                break
+        assert seen_last
+        # range split: windows stay wholly inside [start, end)
+        tail = token_file_batches(path, batch=16, seq_len=8, seed=2, start=32)
+        b = next(tail)
+        assert b.min() >= 32 and b.max() == 39
+        head = token_file_batches(path, batch=16, seq_len=8, seed=2, end=32)
+        assert next(head).max() < 32
+
     def test_rejects_bad_corpus(self, tmp_path):
         from tpu_nexus.workload.data import token_file_batches, write_token_npy
 
@@ -178,7 +201,7 @@ class TestTokenCorpus:
             write_token_npy(str(tmp_path / "f.npy"), np.zeros((3, 3), np.int32))
         short = str(tmp_path / "short.npy")
         np.save(short, np.zeros((4,), np.int32))
-        with pytest.raises(ValueError, match="<= seq_len"):
+        with pytest.raises(ValueError, match="< seq_len"):
             token_file_batches(short, 2, 8)
 
     def test_harness_trains_from_corpus_with_eval(self, tmp_path):

@@ -45,6 +45,19 @@ from tpu_nexus.ops.rmsnorm import rms_norm
 ModelConfig = Any  # LlamaConfig | MoeConfig — same stacked-layer layout
 
 
+def _decode_cfg(cfg):
+    """Normalize a config for the decode path: MoE always uses scatter
+    dispatch here — the training-tuned gmm default pads each call's
+    assignments up to full m-tiles, which at decode token counts inflates
+    expert compute ~70x, and sort's contiguous slices win nothing at B
+    rows."""
+    if isinstance(cfg, MoeConfig) and cfg.dispatch != "scatter":
+        import dataclasses
+
+        return dataclasses.replace(cfg, dispatch="scatter")
+    return cfg
+
+
 def _prefill_hidden_kv(params, tokens, cfg):
     """Family dispatch for the prompt pass (router aux is irrelevant at
     inference and dropped here)."""
@@ -60,7 +73,9 @@ def _head(params, cfg):
 
 def _ffn_block(x, layer, cfg):
     """Post-attention sub-block: dense SwiGLU (Llama) or routed experts
-    (MoE; per-step router over the B decode tokens, aux discarded)."""
+    (MoE; per-step router over the B decode tokens, aux discarded).
+
+    The config arrives dispatch-normalized by :func:`_decode_cfg`."""
     if isinstance(cfg, MoeConfig):
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
         out, _aux = moe_ffn(h, layer, cfg)
@@ -98,6 +113,7 @@ def prefill(
 ) -> Tuple[Cache, jax.Array]:
     """Run the prompt through the training forward once; return the padded
     KV cache and the last position's logits ``[B, vocab]``."""
+    cfg = _decode_cfg(cfg)
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache max_len {max_len}")
@@ -118,6 +134,7 @@ def decode_step(
     """One autoregressive step: ``token`` [B] at scalar position ``pos`` →
     (logits [B, vocab], updated cache).  Mirrors the training block exactly
     (pre-norm GQA + RoPE + SwiGLU via :func:`mlp_block`)."""
+    cfg = _decode_cfg(cfg)
     ct = cfg.dtype
     b = token.shape[0]
     x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]

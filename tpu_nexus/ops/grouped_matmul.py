@@ -107,6 +107,7 @@ def _tgmm_kernel(te_ref, lhs_ref, rhs_ref, out_ref):
 
 
 def _gmm_ref(lhs, rhs, tile_expert, block_m):
+    _check_tiles(lhs.shape[0], block_m, tile_expert)
     nt = lhs.shape[0] // block_m
     lt = lhs.reshape(nt, block_m, lhs.shape[1])
     wt = jnp.take(rhs, tile_expert, axis=0)  # [nt, K, N] — test shapes only
@@ -116,6 +117,7 @@ def _gmm_ref(lhs, rhs, tile_expert, block_m):
 
 
 def _tgmm_ref(lhs, rhs, tile_expert, n_experts, block_m):
+    _check_tiles(lhs.shape[0], block_m, tile_expert)
     nt = lhs.shape[0] // block_m
     lt = lhs.reshape(nt, block_m, lhs.shape[1])
     rt = rhs.reshape(nt, block_m, rhs.shape[1])
@@ -137,11 +139,24 @@ def gmm_supported(lhs, rhs) -> bool:
     return _on_tpu() and k % 128 == 0 and n % 128 == 0 and m % 128 == 0
 
 
+def _check_tiles(m, bm, tile_expert):
+    """The tile→expert map must cover exactly the m-tiles: a silently
+    shrunk tile would read te[] out of bounds (compiled) or clamp to the
+    wrong expert (reference path)."""
+    if m % bm or tile_expert.shape[0] != m // bm:
+        raise ValueError(
+            f"tile_expert has {tile_expert.shape[0]} entries but lhs has "
+            f"{m} rows / {bm}-row tiles = {m / bm:g}; rows must be padded "
+            "to a tile multiple with one entry per tile"
+        )
+
+
 def _gmm_raw(lhs, rhs, tile_expert, block_m, block_n, interpret):
     m, k = lhs.shape
     ne, _, n = rhs.shape
     bm = _block_for(m, block_m)
     bn = _block_for(n, block_n)
+    _check_tiles(m, bm, tile_expert)
     grid = (n // bn, m // bm)  # m minor-most: weight DMA elided in expert runs
     return pl.pallas_call(
         _gmm_kernel,
@@ -171,6 +186,7 @@ def _tgmm_raw(lhs, rhs, tile_expert, n_experts, block_m, block_n, interpret):
     bm = _block_for(m, block_m)
     bn = _block_for(n, block_n)
     bk = _block_for(k, BLOCK_K)
+    _check_tiles(m, bm, tile_expert)
     # m-tiles minor-most: expert runs stay contiguous per (k, n) block
     grid = (k // bk, n // bn, m // bm)
     return pl.pallas_call(

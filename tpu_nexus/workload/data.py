@@ -7,7 +7,7 @@ iterator contract (yield int32 token arrays [batch, seq+?]).
 
 from __future__ import annotations
 
-from typing import Iterator
+from typing import Iterator, Optional
 
 import jax
 import jax.numpy as jnp
@@ -31,11 +31,18 @@ def synthetic_tokens(
         yield rng.choice(vocab_size, size=(batch, seq_len), p=probs).astype(np.int32)
 
 
+def token_corpus_len(path: str) -> int:
+    """Token count of a corpus file (mmap header read only)."""
+    return int(np.load(path, mmap_mode="r").shape[0])
+
+
 def token_file_batches(
     path: str,
     batch: int,
     seq_len: int,
     seed: int = 0,
+    start: int = 0,
+    end: Optional[int] = None,
 ) -> Iterator[np.ndarray]:
     """Batches of random seq_len windows from a memory-mapped token corpus.
 
@@ -48,6 +55,9 @@ def token_file_batches(
     drawing and discarding, which reproduces exactly the batches the
     interrupted run saw — the same contract :func:`synthetic_tokens`
     established.
+
+    ``start``/``end`` restrict sampling to a token range — the train/eval
+    split of one corpus file (windows are drawn wholly inside the range).
     """
     # validate eagerly (this wrapper is not a generator, so a bad corpus
     # fails at construction, not at the first batch draw)
@@ -57,16 +67,19 @@ def token_file_batches(
             f"token corpus {path} must be a 1-D integer .npy array, got "
             f"shape {corpus.shape} dtype {corpus.dtype}"
         )
-    if corpus.shape[0] <= seq_len:
+    end = corpus.shape[0] if end is None else min(end, corpus.shape[0])
+    if end - start < seq_len:
         raise ValueError(
-            f"token corpus {path} has {corpus.shape[0]} tokens <= seq_len {seq_len}"
+            f"token corpus {path} range [{start}, {end}) has "
+            f"{end - start} tokens < seq_len {seq_len}"
         )
 
     def gen() -> Iterator[np.ndarray]:
         rng = np.random.default_rng(seed)
-        hi = corpus.shape[0] - seq_len
+        # inclusive hi: the final window [end - seq_len, end) is reachable
+        hi = end - seq_len
         while True:
-            starts = rng.integers(0, hi, size=batch)
+            starts = rng.integers(start, hi + 1, size=batch)
             yield np.stack(
                 [corpus[s : s + seq_len] for s in starts]
             ).astype(np.int32)

@@ -263,20 +263,36 @@ def run_workload(
     #    processes — e.g. the sp=2 cross-process ring rehearsal — batch rows
     #    are no longer process-aligned, so every process generates the SAME
     #    full global batch (base seed) and each device slices its shard.
-    def make_stream(batch: int, seed: int):
+    def make_stream(batch: int, seed: int, part: str = "train"):
         """Per-process batch stream: the corpus file when configured
         (NEXUS_DATA_PATH), else the adapter's synthetic data — same
         iterator contract, so resume fast-forward and multi-process
-        seeding work identically."""
+        seeding work identically.  With a corpus AND eval enabled, the
+        file splits deterministically: train windows draw from the head,
+        eval ("part='eval'") from the held-out tail 2% (min one window) —
+        a seed offset alone would only re-draw overlapping train windows
+        and could not detect overfitting."""
         if cfg.data_path:
             if adapter.batch_axes() != ("batch", "seq"):
                 raise ValueError(
                     "data_path requires a token-batch (LM) adapter; "
                     f"{adapter.name!r} has batch axes {adapter.batch_axes()!r}"
                 )
-            from tpu_nexus.workload.data import token_file_batches
+            from tpu_nexus.workload.data import token_corpus_len, token_file_batches
 
-            return token_file_batches(cfg.data_path, batch, cfg.seq_len, seed=seed)
+            start, end = 0, None
+            if cfg.eval_every:
+                n = token_corpus_len(cfg.data_path)
+                split = min(int(n * 0.98), n - cfg.seq_len)
+                if split < cfg.seq_len:
+                    raise ValueError(
+                        f"corpus {cfg.data_path} too small ({n} tokens) to "
+                        f"hold both a train and an eval window of {cfg.seq_len}"
+                    )
+                start, end = (split, None) if part == "eval" else (0, split)
+            return token_file_batches(
+                cfg.data_path, batch, cfg.seq_len, seed=seed, start=start, end=end
+            )
         return adapter.data(batch, cfg.seq_len, seed=seed)
 
     replicated_data = ctx.num_processes > 1 and _nonbatch_axis_spans_processes(mesh, cfg.rules)
@@ -323,12 +339,13 @@ def run_workload(
         from tpu_nexus.workload.train import make_eval_step
 
         eval_fn = make_eval_step(adapter, cfg.train, mesh, cfg.rules)
-        # held-out stream: a seed offset no training process uses (training
-        # seeds are cfg.seed + process_id), disjoint per process in
-        # row-split mode
+        # held-out stream: the corpus tail split when a corpus is
+        # configured (see make_stream), plus a seed offset no training
+        # process uses (training seeds are cfg.seed + process_id),
+        # disjoint per process in row-split mode
         eval_seed = cfg.seed + 7919 + (0 if replicated_data else ctx.process_id)
         eval_batch = cfg.batch_size if replicated_data else cfg.batch_size // ctx.num_processes
-        eval_data = make_stream(eval_batch, seed=eval_seed)
+        eval_data = make_stream(eval_batch, seed=eval_seed, part="eval")
 
     reporter.running()
     metrics: Dict[str, Any] = {}

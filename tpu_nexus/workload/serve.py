@@ -136,6 +136,12 @@ def run_serving(
     key = jax.random.PRNGKey(cfg.seed)
 
     reporter.running()
+    # untimed warmup: the first call pays jit compilation of the prefill +
+    # decode scan, which would otherwise dominate the throughput metric at
+    # small round counts
+    warm = jax.numpy.asarray(next(prompts))
+    key, sub = jax.random.split(key)
+    jax.block_until_ready(gen_fn(params, warm, key=sub))
     t0 = time.perf_counter()
     tokens_done = 0
     last = None
