@@ -138,6 +138,38 @@ class TestMoeDecodeParity:
         assert toks.shape == (2, 2)
 
 
+class TestRaggedPrompts:
+    """Right-padded ragged batches must decode exactly what each row would
+    decode alone (per-row RoPE positions + pad-slot masking)."""
+
+    def test_ragged_rows_match_solo_decode(self, setup):
+        cfg, params, _ = setup
+        n_new = 4
+        p_short = jax.random.randint(jax.random.PRNGKey(5), (1, 5), 0, cfg.vocab_size)
+        p_long = jax.random.randint(jax.random.PRNGKey(6), (1, 8), 0, cfg.vocab_size)
+        solo_short = generate(params, p_short, cfg, max_new_tokens=n_new)
+        solo_long = generate(params, p_long, cfg, max_new_tokens=n_new)
+
+        padded = jnp.concatenate(
+            [jnp.pad(p_short, ((0, 0), (0, 3))), p_long], axis=0
+        )  # [2, 8] right-padded
+        lengths = jnp.asarray([5, 8], jnp.int32)
+        ragged = generate(
+            params, padded, cfg, max_new_tokens=n_new, prompt_lengths=lengths
+        )
+        np.testing.assert_array_equal(np.asarray(ragged[0]), np.asarray(solo_short[0]))
+        np.testing.assert_array_equal(np.asarray(ragged[1]), np.asarray(solo_long[0]))
+
+    def test_uniform_lengths_match_default_path(self, setup):
+        cfg, params, prompt = setup
+        a = generate(params, prompt, cfg, max_new_tokens=3)
+        b = generate(
+            params, prompt, cfg, max_new_tokens=3,
+            prompt_lengths=jnp.full((prompt.shape[0],), prompt.shape[1], jnp.int32),
+        )
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 class TestGenerateApi:
     def test_jit_compiles_once(self, setup):
         cfg, params, prompt = setup

@@ -87,19 +87,28 @@ _NEG_INF = -1e30
 Cache = Dict[str, jax.Array]  # {"k": [L,B,max_len,Hkv,D], "v": same}
 
 
-def cached_attention(q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array) -> jax.Array:
+def cached_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array,
+    valid: Optional[jax.Array] = None,
+) -> jax.Array:
     """GQA attention of a length-1 query against a fixed-size cache.
 
     ``q`` [B, 1, Hq, D]; ``k``/``v`` [B, max_len, Hkv, D]; ``kv_len`` scalar —
-    cache slots >= kv_len are masked out (they hold zeros/stale writes)."""
+    cache slots >= kv_len are masked out (they hold zeros/stale writes).
+    ``valid`` [B, max_len] bool overrides the uniform mask for ragged
+    prompts (per-row real-slot maps)."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
     qg = q.reshape(b, sq, hkv, g, d)
     scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
     scores = scores * (d**-0.5)
-    k_pos = jnp.arange(k.shape[1])
-    scores = jnp.where(k_pos < kv_len, scores, _NEG_INF)
+    if valid is None:
+        k_pos = jnp.arange(k.shape[1])
+        mask = k_pos < kv_len  # [max_len]
+    else:
+        mask = valid[:, None, None, None, :]  # [B, 1, 1, 1, max_len]
+    scores = jnp.where(mask, scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     out = jnp.einsum("bhgqk,bkhd->bqhgd", w, v, preferred_element_type=jnp.float32)
     return out.reshape(b, sq, hq, d).astype(q.dtype)
@@ -110,9 +119,16 @@ def prefill(
     tokens: jax.Array,
     cfg: ModelConfig,
     max_len: int,
+    prompt_lengths: Optional[jax.Array] = None,
 ) -> Tuple[Cache, jax.Array]:
     """Run the prompt through the training forward once; return the padded
-    KV cache and the last position's logits ``[B, vocab]``."""
+    KV cache and each row's last REAL position's logits ``[B, vocab]``.
+
+    Ragged prompts arrive RIGHT-padded with per-row ``prompt_lengths``
+    [B]: causal attention means real positions ``i < len`` only ever see
+    real keys, so the training forward needs no mask — pad positions
+    compute garbage that nothing reads (their K/V slots are masked out of
+    every later decode step instead)."""
     cfg = _decode_cfg(cfg)
     b, s = tokens.shape
     if s > max_len:
@@ -120,7 +136,12 @@ def prefill(
     hidden, (k, v) = _prefill_hidden_kv(params, tokens, cfg)
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
     cache = {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
-    logits = jnp.einsum("be,ev->bv", hidden[:, -1], _head(params, cfg))
+    if prompt_lengths is None:
+        last = hidden[:, -1]
+    else:
+        idx = (prompt_lengths - 1).astype(jnp.int32)[:, None, None]  # [B,1,1]
+        last = jnp.take_along_axis(hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)[:, 0]
+    logits = jnp.einsum("be,ev->bv", last, _head(params, cfg))
     return cache, logits
 
 
@@ -130,16 +151,33 @@ def decode_step(
     token: jax.Array,
     pos: jax.Array,
     cfg: ModelConfig,
+    prompt_lengths: Optional[jax.Array] = None,
+    prompt_width: Optional[int] = None,
 ) -> Tuple[jax.Array, Cache]:
-    """One autoregressive step: ``token`` [B] at scalar position ``pos`` →
-    (logits [B, vocab], updated cache).  Mirrors the training block exactly
-    (pre-norm GQA + RoPE + SwiGLU via :func:`mlp_block`)."""
+    """One autoregressive step: ``token`` [B] at scalar WRITE position
+    ``pos`` → (logits [B, vocab], updated cache).  Mirrors the training
+    block exactly (pre-norm GQA + RoPE + SwiGLU via :func:`mlp_block`).
+
+    Ragged mode (``prompt_lengths`` [B] + the right-padded ``prompt_width``
+    S): rows still decode in lockstep at shared cache slots, but each
+    row's RoPE position is its own ``len + (pos - S)`` and attention masks
+    out the row's pad slots ``[len, S)`` — the same trusted lockstep loop,
+    made per-row correct by index arithmetic instead of per-row scatters."""
     cfg = _decode_cfg(cfg)
     ct = cfg.dtype
     b = token.shape[0]
     x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]
-    positions = jnp.broadcast_to(pos[None, None], (b, 1))
-    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    if prompt_lengths is None:
+        positions = jnp.broadcast_to(pos[None, None], (b, 1))
+        valid = None
+    else:
+        assert prompt_width is not None, "ragged decode needs prompt_width"
+        positions = (prompt_lengths + (pos - prompt_width))[:, None]  # [B,1]
+        slot = jnp.arange(cache["k"].shape[2])
+        valid = (slot[None, :] < prompt_lengths[:, None]) | (
+            (slot[None, :] >= prompt_width) & (slot[None, :] <= pos)
+        )  # [B, max_len]
+    cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
 
     def body(x, xs):
         layer, ck, cv = xs
@@ -151,7 +189,7 @@ def decode_step(
         k = _rope(k, cos, sin)
         ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
         cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
-        o = cached_attention(q, ck, cv, pos + 1)
+        o = cached_attention(q, ck, cv, pos + 1, valid=valid)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         x = _ffn_block(x, layer, cfg)
         return x, (ck, cv)
@@ -173,10 +211,15 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    prompt_lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, S] →
     [B, max_new_tokens].  ``temperature=0`` is greedy; otherwise categorical
-    sampling with ``key``.  Jit-compatible (one prefill + one scan)."""
+    sampling with ``key``.  Jit-compatible (one prefill + one scan).
+
+    Ragged batches: RIGHT-pad prompts to a common width and pass
+    ``prompt_lengths`` [B] — each row continues from its own last real
+    token with per-row RoPE positions and pad-slot masking."""
     b, s = prompt.shape
     total = s + max_new_tokens
     max_len = max_len or total
@@ -189,7 +232,7 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by greedy; scan carry needs an array
 
-    cache, logits = prefill(params, prompt, cfg, max_len)
+    cache, logits = prefill(params, prompt, cfg, max_len, prompt_lengths)
 
     def sample(logits, k):
         if temperature == 0.0:
@@ -200,7 +243,10 @@ def generate(
         cache, logits, pos, key = carry
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
-        logits, cache = decode_step(params, cache, tok, pos, cfg)
+        logits, cache = decode_step(
+            params, cache, tok, pos, cfg,
+            prompt_lengths=prompt_lengths, prompt_width=s,
+        )
         return (cache, logits, pos + 1, key), tok
 
     (_, _, _, _), toks = jax.lax.scan(
