@@ -193,6 +193,30 @@ class TestGenerateApi:
         assert toks.shape == (2, 2)
         assert int(toks.max()) < cfg.vocab_size
 
+    def test_top_k_restricts_support(self, setup):
+        """top_k=1 sampling == greedy regardless of temperature."""
+        cfg, params, prompt = setup
+        greedy = generate(params, prompt, cfg, max_new_tokens=3)
+        k1 = generate(
+            params, prompt, cfg, max_new_tokens=3, temperature=1.5,
+            top_k=1, key=jax.random.PRNGKey(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(k1))
+
+    def test_top_p_tiny_nucleus_is_greedy(self, setup):
+        cfg, params, prompt = setup
+        greedy = generate(params, prompt, cfg, max_new_tokens=3)
+        p0 = generate(
+            params, prompt, cfg, max_new_tokens=3, temperature=1.0,
+            top_p=1e-6, key=jax.random.PRNGKey(9),
+        )
+        np.testing.assert_array_equal(np.asarray(greedy), np.asarray(p0))
+
+    def test_truncation_requires_temperature(self, setup):
+        cfg, params, prompt = setup
+        with pytest.raises(ValueError, match="temperature"):
+            generate(params, prompt, cfg, max_new_tokens=2, top_k=5)
+
     def test_window_guards(self, setup):
         cfg, params, prompt = setup
         with pytest.raises(ValueError, match="exceeds max_len"):

@@ -209,18 +209,25 @@ def generate(
     *,
     max_new_tokens: int,
     temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     prompt_lengths: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, S] →
     [B, max_new_tokens].  ``temperature=0`` is greedy; otherwise categorical
-    sampling with ``key``.  Jit-compatible (one prefill + one scan).
+    sampling with ``key``, optionally truncated to the ``top_k`` highest
+    logits and/or the ``top_p`` nucleus (smallest set of tokens whose
+    probability mass reaches p).  Jit-compatible (one prefill + one scan;
+    the truncations are static-shape sort/threshold masks).
 
     Ragged batches: RIGHT-pad prompts to a common width and pass
     ``prompt_lengths`` [B] — each row continues from its own last real
     token with per-row RoPE positions and pad-slot masking."""
     b, s = prompt.shape
+    if (top_k or top_p < 1.0) and temperature == 0.0:
+        raise ValueError("top_k/top_p truncation requires temperature > 0")
     total = s + max_new_tokens
     max_len = max_len or total
     if total > max_len:
@@ -237,7 +244,19 @@ def generate(
     def sample(logits, k):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        return jax.random.categorical(k, logits / temperature, axis=-1).astype(prompt.dtype)
+        logits = logits.astype(jnp.float32) / temperature
+        if top_k:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits >= kth, logits, _NEG_INF)
+        if top_p < 1.0:
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
+            probs = jax.nn.softmax(srt, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            # smallest prefix with mass >= p: keep logits >= the cutoff value
+            n_keep = jnp.sum(cum < top_p, axis=-1) + 1  # [B]
+            cutoff = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
+            logits = jnp.where(logits >= cutoff, logits, _NEG_INF)
+        return jax.random.categorical(k, logits, axis=-1).astype(prompt.dtype)
 
     def body(carry, _):
         cache, logits, pos, key = carry
