@@ -22,11 +22,11 @@ kernel on the hot path), bf16 params+optimizer, sized for one v5e chip.
 
 Tuning knobs (all env, all optional — defaults are the tuned configuration):
   NEXUS_BENCH_MODEL     nexus_1b (default) | nexus_moe (MoeConfig.nexus_moe:
-                        8 experts, top-2, static-capacity scatter dispatch;
+                        8 experts, top-2, dropless grouped-matmul dispatch;
                         MFU counts ACTIVE params per the MoE convention)
   NEXUS_BENCH_BATCH     per-chip batch size (default 16; moe default 64)
   NEXUS_BENCH_CAPACITY  MoE capacity factor override (default from config)
-  NEXUS_BENCH_DISPATCH  MoE dispatch override: scatter | sort
+  NEXUS_BENCH_DISPATCH  MoE dispatch override: scatter | sort | gmm
   NEXUS_BENCH_SEQ       sequence length (default 2048)
   NEXUS_BENCH_STEPS     timed steps (default 10)
   NEXUS_BENCH_REMAT     remat policy: dots | attn_out | nothing
@@ -198,7 +198,10 @@ def main() -> None:
     }
     if getattr(cfg, "n_experts", 0):
         record["dispatch"] = cfg.dispatch
-        record["capacity_factor"] = cfg.capacity_factor
+        if cfg.dispatch == "gmm":
+            record["dropless"] = True  # gmm ignores capacity_factor
+        else:
+            record["capacity_factor"] = cfg.capacity_factor
     print(json.dumps(record))
 
 
