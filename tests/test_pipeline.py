@@ -182,7 +182,7 @@ class TestPipelinedTrainStep:
         from tpu_nexus.models.registry import LlamaAdapter
 
         mesh = build_mesh(MeshSpec(pp=2, sp=2, fsdp=2))
-        with pytest.raises(ValueError, match="ring attention"):
+        with pytest.raises(ValueError, match="sp_attn='ulysses'"):
             LlamaAdapter(config=LlamaConfig.tiny()).make_loss(TrainConfig(), mesh)
 
     def test_moe_pp_step_matches_flat_step(self):

@@ -1,0 +1,131 @@
+"""Ulysses all-to-all sequence parallelism: numerics pinned against dense
+attention and the ring, plus the pp x sp composition it uniquely enables."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.ops import dense_attention
+from tpu_nexus.parallel import (
+    LOGICAL_RULES_FSDP_TP,
+    LOGICAL_RULES_FSDP_TP_PP,
+    MeshSpec,
+    build_mesh,
+)
+from tpu_nexus.parallel.ulysses import ulysses_attention, ulysses_supported
+from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+
+def _qkv(key, b=2, s=128, hq=8, hkv=4, d=32):
+    kq, kk, kv = jax.random.split(key, 3)
+    return (
+        jax.random.normal(kq, (b, s, hq, d), jnp.float32),
+        jax.random.normal(kk, (b, s, hkv, d), jnp.float32),
+        jax.random.normal(kv, (b, s, hkv, d), jnp.float32),
+    )
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, causal):
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=2, tp=2))
+        q, k, v = _qkv(jax.random.PRNGKey(0))
+        ref = dense_attention(q, k, v, causal=causal)
+
+        @jax.jit
+        def f(q, k, v):
+            return ulysses_attention(q, k, v, mesh=mesh, causal=causal, head_axis="tp")
+
+        with mesh:
+            sharded = jax.device_put(
+                (q, k, v), NamedSharding(mesh, P(("dp", "fsdp"), "sp", "tp", None))
+            )
+            out = f(*sharded)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), rtol=2e-2, atol=2e-2
+        )
+
+    def test_grads_match_dense(self):
+        mesh = build_mesh(MeshSpec(fsdp=2, sp=2, tp=2))
+        q, k, v = _qkv(jax.random.PRNGKey(1))
+
+        def loss_u(q, k, v):
+            with mesh:
+                return jnp.sum(
+                    ulysses_attention(q, k, v, mesh=mesh, causal=True).astype(jnp.float32) ** 2
+                )
+
+        def loss_d(q, k, v):
+            return jnp.sum(dense_attention(q, k, v, causal=True).astype(jnp.float32) ** 2)
+
+        gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_d, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-2, atol=3e-2
+            )
+
+    def test_head_cap_refused(self):
+        mesh = build_mesh(MeshSpec(fsdp=-1, sp=4, tp=2))  # sp*tp = 8 > hkv 4
+        assert not ulysses_supported(8, 4, mesh)
+        q, k, v = _qkv(jax.random.PRNGKey(2))
+        with pytest.raises(ValueError, match="sp_attn='ring'"):
+            ulysses_attention(q, k, v, mesh=mesh)
+
+
+class TestUlyssesTrainStep:
+    def _loss(self, mesh, rules, tcfg, cfg, tokens):
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, rules)
+        step = make_train_step(cfg, tcfg, mesh, rules)
+        with mesh:
+            _, m = step(state, tokens)
+        return float(m["loss"])
+
+    def test_ulysses_step_matches_ring_and_flat(self):
+        cfg = LlamaConfig.tiny()
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size)
+        flat = self._loss(
+            build_mesh(MeshSpec(fsdp=4, tp=2)), LOGICAL_RULES_FSDP_TP,
+            TrainConfig(warmup_steps=1, total_steps=10), cfg, tokens,
+        )
+        # tiny has hkv=2: the ulysses head cap allows sp*tp = 2
+        ring = self._loss(
+            build_mesh(MeshSpec(fsdp=4, sp=2)), LOGICAL_RULES_FSDP_TP,
+            TrainConfig(warmup_steps=1, total_steps=10, sp_attn="ring"), cfg, tokens,
+        )
+        uly = self._loss(
+            build_mesh(MeshSpec(fsdp=4, sp=2)), LOGICAL_RULES_FSDP_TP,
+            TrainConfig(warmup_steps=1, total_steps=10, sp_attn="ulysses"), cfg, tokens,
+        )
+        assert abs(uly - flat) < 2e-3, (uly, flat)
+        assert abs(uly - ring) < 2e-3, (uly, ring)
+
+    def test_pp_with_ulysses_composes(self):
+        """The composition ring cannot do: pipeline stages with the
+        sequence sharded over sp, attention via GSPMD all-to-alls inside
+        the vmapped stage body."""
+        cfg = LlamaConfig.tiny()
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 64), 0, cfg.vocab_size)
+        flat = self._loss(
+            build_mesh(MeshSpec(fsdp=4, tp=2)), LOGICAL_RULES_FSDP_TP,
+            TrainConfig(warmup_steps=1, total_steps=10), cfg, tokens,
+        )
+        pp_sp = self._loss(
+            build_mesh(MeshSpec(pp=2, fsdp=2, sp=2)), LOGICAL_RULES_FSDP_TP_PP,
+            TrainConfig(warmup_steps=1, total_steps=10, sp_attn="ulysses"), cfg, tokens,
+        )
+        assert abs(pp_sp - flat) < 2e-3, (pp_sp, flat)
+
+    def test_pp_with_ring_still_refused(self):
+        from tpu_nexus.models.registry import LlamaAdapter
+
+        mesh = build_mesh(MeshSpec(pp=2, sp=2, fsdp=2))
+        with pytest.raises(ValueError, match="ulysses"):
+            LlamaAdapter(config=LlamaConfig.tiny()).make_loss(
+                TrainConfig(sp_attn="ring"), mesh
+            )

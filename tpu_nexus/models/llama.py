@@ -366,10 +366,12 @@ def llama_hidden_pp(
     if cfg.remat:
         layer_fn = jax.checkpoint(layer_fn, policy=remat_policy(cfg.remat_policy))
 
+    # seq stays sharded over sp inside the pipeline (trivial when sp=1):
+    # ulysses attention re-shards around the attention op per stage
     spec = (
-        P(axes, None, None),          # x  [mb, S, E]
-        P(axes, None, None, None),    # cos [mb, S, 1, D/2]
-        P(axes, None, None, None),    # sin
+        P(axes, "sp", None),          # x  [mb, S, E]
+        P(axes, "sp", None, None),    # cos [mb, S, 1, D/2]
+        P(axes, "sp", None, None),    # sin
     )
     x, _, _ = pipeline_apply(
         layer_fn,

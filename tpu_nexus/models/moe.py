@@ -662,9 +662,9 @@ def moe_hidden_pp(
     # the end — row-shaped aux also shards like the batch
     zeros = jnp.zeros((b,), jnp.float32)
     spec = (
-        P(axes, None, None),
-        P(axes, None, None, None),
-        P(axes, None, None, None),
+        P(axes, "sp", None),
+        P(axes, "sp", None, None),
+        P(axes, "sp", None, None),
         P(axes),
         P(axes),
         P(axes),

@@ -24,22 +24,35 @@ from tpu_nexus.models.mnist import MnistConfig, mnist_axes, mnist_forward, mnist
 from tpu_nexus.models.moe import MoeConfig, moe_axes, moe_head, moe_hidden, moe_init
 
 
-def _ring_attn_fn(mesh):
-    """Ring attention when the mesh shards the sequence, else None (the
-    model dispatches to flash/XLA attention itself)."""
+def _sp_attn_fn(mesh, sp_attn: str = "ring"):
+    """Sequence-parallel attention when the mesh shards the sequence, else
+    None (the model dispatches to flash/XLA attention itself).  Two
+    strategies (TrainConfig.sp_attn): "ring" (shard_map + ppermute,
+    parallel/ring.py) or "ulysses" (GSPMD all-to-all re-sharding,
+    parallel/ulysses.py)."""
     import functools
-
-    from tpu_nexus.parallel.ring import ring_attention_sharded
 
     if mesh is None or mesh.shape.get("sp", 1) <= 1:
         return None
     head_axis = "tp" if mesh.shape.get("tp", 1) > 1 else None
-    ring = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
+    if sp_attn == "ulysses":
+        from tpu_nexus.parallel.ulysses import ulysses_attention
+
+        fn = functools.partial(ulysses_attention, mesh=mesh, head_axis=head_axis)
+    elif sp_attn == "ring":
+        from tpu_nexus.parallel.ring import ring_attention_sharded
+
+        fn = functools.partial(ring_attention_sharded, mesh=mesh, head_axis=head_axis)
+    else:
+        raise ValueError(f"unknown sp_attn {sp_attn!r}; use 'ring' or 'ulysses'")
 
     def attn_fn(q, k, v, causal=True):
-        return ring(q, k, v, causal=causal)
+        return fn(q, k, v, causal=causal)
 
     return attn_fn
+
+
+_ring_attn_fn = _sp_attn_fn  # historical alias
 
 
 class ModelAdapter:
@@ -98,19 +111,22 @@ class LlamaAdapter(ModelAdapter):
         from tpu_nexus.models.llama import llama_hidden_pp
         from tpu_nexus.workload.train import chunked_next_token_loss
 
-        attn_fn = _ring_attn_fn(mesh)
+        sp_attn = getattr(train_cfg, "sp_attn", "ring")
+        attn_fn = _sp_attn_fn(mesh, sp_attn)
         cfg = self.config
         z_loss = getattr(train_cfg, "z_loss", 0.0)
         ce_chunk = getattr(train_cfg, "ce_chunk", 256)
         pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-        if pp > 1 and attn_fn is not None:
+        if pp > 1 and attn_fn is not None and sp_attn == "ring":
             # ring attention is a shard_map region; vmapping it over the
             # pipeline's stage axis is untraced territory — refuse loudly
-            # rather than let GSPMD guess (pp already covers long-stack
-            # memory; shard long *sequences* over sp on a pp=1 mesh)
+            # rather than let GSPMD guess.  Ulysses (pure GSPMD
+            # re-annotation) composes with the pipeline: use
+            # sp_attn='ulysses' for pp x sp long-context training.
             raise ValueError(
-                "pp > 1 with sp > 1 is not supported: ring attention cannot "
-                "run inside the pipeline's stage vmap"
+                "pp > 1 with sp > 1 is not supported for sp_attn='ring' "
+                "(shard_map cannot run inside the pipeline's stage vmap); "
+                "use TrainConfig.sp_attn='ulysses'"
             )
         pp_microbatches = getattr(train_cfg, "pp_microbatches", 0)
         batch_axes = (rules or {}).get("batch", ("dp", "fsdp"))
@@ -120,7 +136,7 @@ class LlamaAdapter(ModelAdapter):
                 hidden = llama_hidden_pp(
                     params, tokens, cfg, n_stages=pp,
                     microbatches=pp_microbatches, mesh=mesh,
-                    batch_axes=batch_axes,
+                    batch_axes=batch_axes, attn_fn=attn_fn,
                 )
             else:
                 hidden = llama_hidden(params, tokens, cfg, attn_fn=attn_fn)
@@ -162,13 +178,15 @@ class MoeAdapter(ModelAdapter):
 
         from tpu_nexus.models.moe import moe_hidden_pp
 
-        attn_fn = _ring_attn_fn(mesh)
+        sp_attn = getattr(train_cfg, "sp_attn", "ring")
+        attn_fn = _sp_attn_fn(mesh, sp_attn)
         cfg = self.config
         pp = mesh.shape.get("pp", 1) if mesh is not None else 1
-        if pp > 1 and attn_fn is not None:
+        if pp > 1 and attn_fn is not None and sp_attn == "ring":
             raise ValueError(
-                "pp > 1 with sp > 1 is not supported: ring attention cannot "
-                "run inside the pipeline's stage vmap"
+                "pp > 1 with sp > 1 is not supported for sp_attn='ring' "
+                "(shard_map cannot run inside the pipeline's stage vmap); "
+                "use TrainConfig.sp_attn='ulysses'"
             )
         if pp > 1 and cfg.dispatch != "scatter":
             raise ValueError(
@@ -196,7 +214,7 @@ class MoeAdapter(ModelAdapter):
                 hidden, aux = moe_hidden_pp(
                     params, tokens, cfg, n_stages=pp,
                     microbatches=pp_microbatches, mesh=mesh,
-                    batch_axes=batch_axes,
+                    batch_axes=batch_axes, attn_fn=attn_fn,
                 )
             else:
                 hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)

@@ -14,7 +14,10 @@ JAX programs, so this package is where scale lives:
                     ``shard_map`` + ``ppermute`` so collectives ride ICI;
 * ``pipeline``    — pipeline parallelism (``pp`` axis) as a GSPMD program
                     transformation: stage-sharded layer stacks, microbatch
-                    scan, CollectivePermute handoffs derived by XLA.
+                    scan, CollectivePermute handoffs derived by XLA;
+* ``ulysses``     — all-to-all sequence parallelism (the second sp
+                    strategy): re-shard seq<->heads around attention via
+                    sharding annotations alone; composes with pipeline.
 """
 
 from tpu_nexus.parallel.mesh import MeshSpec, build_mesh, local_mesh

@@ -1,0 +1,83 @@
+"""Ulysses-style all-to-all sequence parallelism (GSPMD-native).
+
+The second of the two standard long-context strategies (the other is ring
+attention, :mod:`tpu_nexus.parallel.ring`): instead of rotating K/V blocks
+around a ring, re-shard *around* attention — outside it activations are
+sequence-sharded over ``sp``; inside it they are head-sharded over
+``(sp, tp)`` with the full sequence local.  The seq↔heads transposition is
+exactly an all-to-all, and because this implementation is nothing but two
+``with_sharding_constraint`` annotations, XLA/GSPMD derives those
+all-to-alls itself — no ``shard_map``, no hand-written collective, and the
+flash kernel runs unmodified on the full local sequence per head shard.
+
+Tradeoffs vs the ring (why both exist):
+
+* Ulysses moves each Q/K/V/O element twice (two all-to-alls) regardless of
+  sequence length; the ring moves K/V ``sp-1`` times but keeps Q/O still.
+  For GQA models with few KV heads the ring's traffic is smaller; for
+  MHA-ish head counts Ulysses usually wins and its collectives overlap
+  better (one fused a2a vs ``sp-1`` dependent ppermutes).
+* Ulysses caps ``sp`` at the head counts: ``Hq % (sp·tp) == 0`` AND
+  ``Hkv % (sp·tp) == 0`` (GQA KV heads are the binding limit).  The ring
+  has no such cap.
+* Being pure GSPMD, Ulysses composes with the pipeline transform (the
+  constraints vmap over the stage axis), where the ring's shard_map body
+  cannot — ``pp × sp`` long-context training is Ulysses-only.
+
+Select per run with ``TrainConfig.sp_attn = "ring" | "ulysses"``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpu_nexus.ops import attention as _ops_attention
+
+Axes = Union[str, Tuple[str, ...]]
+
+
+def ulysses_supported(n_heads: int, n_kv_heads: int, mesh: Mesh,
+                      seq_axis: str = "sp", head_axis: Optional[str] = "tp") -> bool:
+    """Head-divisibility feasibility check (the GQA KV heads bind)."""
+    extent = mesh.shape.get(seq_axis, 1) * (mesh.shape.get(head_axis, 1) if head_axis else 1)
+    return n_heads % extent == 0 and n_kv_heads % extent == 0
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    causal: bool = True,
+    batch_axes: Axes = ("dp", "fsdp"),
+    seq_axis: str = "sp",
+    head_axis: Optional[str] = "tp",
+    impl: str = "auto",
+) -> jax.Array:
+    """Attention over a sequence sharded on ``seq_axis``.
+
+    ``q`` [B, S, Hq, D], ``k``/``v`` [B, S, Hkv, D] arrive (logically)
+    seq-sharded; the constraints below transpose them to head-sharded with
+    full local sequence (all-to-all in, attention, all-to-all out)."""
+    hq, hkv = q.shape[2], k.shape[2]
+    if not ulysses_supported(hq, hkv, mesh, seq_axis, head_axis):
+        extent = mesh.shape.get(seq_axis, 1) * (mesh.shape.get(head_axis, 1) if head_axis else 1)
+        raise ValueError(
+            f"ulysses needs head counts divisible by sp·tp={extent}; got "
+            f"Hq={hq}, Hkv={hkv} — use sp_attn='ring' for this layout"
+        )
+    inner_heads = (seq_axis,) if head_axis is None else (seq_axis, head_axis)
+
+    def cons(x, spec):
+        return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    # in: gather seq, scatter heads — one all-to-all per operand
+    spec_in = P(batch_axes, None, inner_heads, None)
+    q, k, v = cons(q, spec_in), cons(k, spec_in), cons(v, spec_in)
+    o = _ops_attention(q, k, v, causal=causal, impl=impl)
+    # out: back to the seq-sharded layout the rest of the layer uses
+    return cons(o, P(batch_axes, seq_axis, head_axis, None))

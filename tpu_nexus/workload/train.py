@@ -43,6 +43,11 @@ class TrainConfig:
     #: microbatch count for pipeline parallelism (mesh pp > 1); 0 = auto
     #: (largest of 4·pp / 2·pp / pp dividing the batch — bubble ≤ 20%)
     pp_microbatches: int = 0
+    #: sequence-parallel attention strategy when the mesh shards sp:
+    #: "ring" (shard_map + ppermute — no head-count cap, least K/V traffic
+    #: for GQA) or "ulysses" (GSPMD all-to-all re-sharding — composes with
+    #: pipeline parallelism, needs heads divisible by sp·tp)
+    sp_attn: str = "ring"
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
