@@ -19,9 +19,11 @@ TPU-first design:
   the MXU has nothing to tile, so a flash kernel would only add launch
   overhead; the mask is a positional clamp (``k_pos <= pos``), not a
   causal triangle.
-* Rows decode in lockstep from a shared scalar position (prompts must be
-  equal length — left-pad upstream if not), which keeps the cache update a
-  single dynamic slice rather than a per-row scatter.
+* Rows decode in lockstep from shared scalar cache slots; ragged batches
+  RIGHT-pad to a common width and pass ``prompt_lengths`` — per-row RoPE
+  positions and pad-slot masks make each row exactly equal to its solo
+  decode while the cache update stays a single dynamic slice.  (Never
+  LEFT-pad: causal attention would attend pad tokens as real prefix.)
 """
 
 from __future__ import annotations
@@ -139,7 +141,8 @@ def prefill(
     if prompt_lengths is None:
         last = hidden[:, -1]
     else:
-        idx = (prompt_lengths - 1).astype(jnp.int32)[:, None, None]  # [B,1,1]
+        # clamp at 0: a (buggy) zero length must not wrap to the last pad
+        idx = jnp.maximum(prompt_lengths - 1, 0).astype(jnp.int32)[:, None, None]  # [B,1,1]
         last = jnp.take_along_axis(hidden, jnp.broadcast_to(idx, (b, 1, hidden.shape[-1])), axis=1)[:, 0]
     logits = jnp.einsum("be,ev->bv", last, _head(params, cfg))
     return cache, logits
@@ -228,6 +231,11 @@ def generate(
     b, s = prompt.shape
     if (top_k or top_p < 1.0) and temperature == 0.0:
         raise ValueError("top_k/top_p truncation requires temperature > 0")
+    vocab = getattr(cfg, "vocab_size", None)
+    if top_k and vocab and not (0 < top_k <= vocab):
+        raise ValueError(f"top_k {top_k} outside (0, vocab_size={vocab}]")
+    if not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p {top_p} outside (0, 1]")
     total = s + max_new_tokens
     max_len = max_len or total
     if total > max_len:
@@ -246,12 +254,12 @@ def generate(
             return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
         logits = logits.astype(jnp.float32) / temperature
         if top_k:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            # kth-largest per row without a full-vocab sort
+            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
             logits = jnp.where(logits >= kth, logits, _NEG_INF)
         if top_p < 1.0:
-            srt = jnp.sort(logits, axis=-1)[:, ::-1]  # descending
-            probs = jax.nn.softmax(srt, axis=-1)
-            cum = jnp.cumsum(probs, axis=-1)
+            srt = jnp.sort(logits, axis=-1)[:, ::-1]  # one descending sort
+            cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
             # smallest prefix with mass >= p: keep logits >= the cutoff value
             n_keep = jnp.sum(cum < top_p, axis=-1) + 1  # [B]
             cutoff = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)

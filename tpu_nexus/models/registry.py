@@ -52,9 +52,6 @@ def _sp_attn_fn(mesh, sp_attn: str = "ring"):
     return attn_fn
 
 
-_ring_attn_fn = _sp_attn_fn  # historical alias
-
-
 class ModelAdapter:
     """Contract the harness/train-step consume.  A batch is an arbitrary
     pytree of arrays; every method below must agree on its structure."""

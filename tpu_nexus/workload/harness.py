@@ -114,6 +114,10 @@ class WorkloadConfig:
             train=TrainConfig(
                 warmup_steps=int(e.get("NEXUS_WARMUP_STEPS", "10")),
                 total_steps=max(steps, 2),
+                # sequence-parallel attention strategy: ring (default) or
+                # ulysses (required for pp x sp meshes)
+                sp_attn=e.get("NEXUS_SP_ATTN", "ring"),
+                pp_microbatches=int(e.get("NEXUS_PP_MICROBATCHES", "0")),
             ),
             mesh=mesh,
             batch_size=int(e.get("NEXUS_BATCH", "8")),
