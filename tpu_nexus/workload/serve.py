@@ -48,6 +48,8 @@ class ServeConfig:
     gen_tokens: int = 32
     rounds: int = 10
     temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
     heartbeat_every: int = 2
     checkpoint_dir: str = ""
     seed: int = 0
@@ -68,6 +70,8 @@ class ServeConfig:
             gen_tokens=int(e.get("NEXUS_GEN_TOKENS", "32")),
             rounds=int(e.get("NEXUS_STEPS", "10")),
             temperature=float(e.get("NEXUS_TEMPERATURE", "0.0")),
+            top_k=int(e.get("NEXUS_TOP_K", "0")),
+            top_p=float(e.get("NEXUS_TOP_P", "1.0")),
             heartbeat_every=int(e.get("NEXUS_HEARTBEAT_EVERY", "2")),
             checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
             seed=int(e.get("NEXUS_SEED", "0")),
@@ -131,6 +135,8 @@ def run_serving(
             cfg=mcfg,
             max_new_tokens=cfg.gen_tokens,
             temperature=cfg.temperature,
+            top_k=cfg.top_k,
+            top_p=cfg.top_p,
         )
     )
     key = jax.random.PRNGKey(cfg.seed)
