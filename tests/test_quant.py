@@ -12,7 +12,7 @@ from tpu_nexus.models import LlamaConfig, MoeConfig
 from tpu_nexus.models.generate import generate
 from tpu_nexus.models.llama import llama_forward, llama_init
 from tpu_nexus.models.moe import moe_hidden, moe_init
-from tpu_nexus.models.quant import QTensor, quantize_params, quantize_tensor, quantized_bytes
+from tpu_nexus.models.quant import quantize_params, quantize_tensor, quantized_bytes
 
 
 class TestQTensor:

@@ -1,8 +1,6 @@
 """Ulysses all-to-all sequence parallelism: numerics pinned against dense
 attention and the ring, plus the pp x sp composition it uniquely enables."""
 
-import dataclasses
-
 import jax
 import jax.numpy as jnp
 import numpy as np

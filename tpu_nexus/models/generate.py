@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 
 from tpu_nexus.models.llama import (
-    LlamaConfig,
     llama_head,
     llama_hidden,
     mlp_block,
@@ -44,7 +43,7 @@ from tpu_nexus.models.llama import (
 from tpu_nexus.models.moe import MoeConfig, moe_ffn, moe_head, moe_hidden
 from tpu_nexus.ops.rmsnorm import rms_norm
 
-ModelConfig = Any  # LlamaConfig | MoeConfig — same stacked-layer layout
+ModelConfig = Any  # LlamaConfig or MoeConfig — same stacked-layer layout
 
 
 def _decode_cfg(cfg):

@@ -18,7 +18,6 @@ train-state template so serve always loads exactly what train saved).
 
 from __future__ import annotations
 
-import dataclasses
 import logging
 import time
 from dataclasses import dataclass, field
