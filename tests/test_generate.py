@@ -137,6 +137,27 @@ class TestMoeDecodeParity:
         toks = generate(params, prompt, cfg, max_new_tokens=2)
         assert toks.shape == (2, 2)
 
+    def test_moe_decode_capacity_is_dropless(self, moe_setup):
+        """The decode-normalized config must carry the dropless capacity
+        bound (cap >= T for any routing): a model trained dropless with
+        gmm must not silently drop assignments at serve time (ADVICE r3)."""
+        import dataclasses as dc
+
+        from tpu_nexus.models.generate import _decode_cfg
+        from tpu_nexus.models.moe import expert_capacity
+
+        cfg, _, _ = moe_setup
+        for dispatch, capf in (("gmm", 1.25), ("scatter", 1.25), ("sort", 0.5)):
+            d = _decode_cfg(dc.replace(cfg, dispatch=dispatch, capacity_factor=capf))
+            assert d.dispatch == "scatter"
+            assert d.capacity_factor >= cfg.n_experts / cfg.experts_per_token
+            # cap >= T even if every token routes to one expert
+            for t in (1, 8, 64):
+                assert expert_capacity(t, d) >= t
+        # an already-generous scatter config is left untouched
+        generous = dc.replace(cfg, dispatch="scatter", capacity_factor=16.0)
+        assert _decode_cfg(generous) is generous
+
 
 class TestRaggedPrompts:
     """Right-padded ragged batches must decode exactly what each row would

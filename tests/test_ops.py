@@ -205,6 +205,21 @@ class TestGroupedMatmul:
         for a, b in zip(g1, g2):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-2, atol=2e-1)
 
+    def test_gmm_checked_masks_absent_expert_grads(self):
+        """gmm_checked is the public boundary for callers that cannot
+        guarantee every expert owns a tile: the weight grad of an expert
+        absent from tile_expert must come back zero, not uninitialized
+        memory (ADVICE r3)."""
+        from tpu_nexus.ops.grouped_matmul import gmm_checked
+
+        lhs, rhs, te, bm = self._case(jax.random.PRNGKey(5), M=512, K=128, N=128)
+        te = jnp.asarray([0, 0, 2, 3], jnp.int32)  # expert 1 owns no tile
+        d_rhs = jax.grad(
+            lambda r: jnp.sum(gmm_checked(lhs, r, te, bm, 128, True) ** 2)
+        )(rhs)
+        np.testing.assert_array_equal(np.asarray(d_rhs[1]), 0)
+        assert np.abs(np.asarray(d_rhs[0])).sum() > 0  # present experts keep grads
+
     def test_empty_expert_gets_zero_tgmm_block(self):
         """Experts with zero row tiles must still produce defined (zero)
         weight-grad blocks — guaranteed upstream by min-one-tile padding;

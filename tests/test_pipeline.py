@@ -214,6 +214,20 @@ class TestPipelinedTrainStep:
         assert abs(float(m_pp["load_balance"]) - float(m_ref["load_balance"])) < 0.2
         assert np.isfinite(float(m_pp["loss"]))
 
+    def test_moe_explicit_microbatches_must_cover_dp_extent(self):
+        """The MoE path shares llama's refusal (ADVICE r3: it used to let
+        GSPMD silently pad every tick instead)."""
+        from tpu_nexus.models.moe import moe_hidden_pp, moe_init
+
+        cfg = MoeConfig.tiny()
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (8, 32), 0, cfg.vocab_size)
+        mesh = build_mesh(MeshSpec(pp=2, fsdp=4))
+        with pytest.raises(ValueError, match="data-parallel extent"):
+            moe_hidden_pp(
+                params, tokens, cfg, n_stages=2, microbatches=8, mesh=mesh
+            )
+
     def test_moe_pp_requires_scatter_dispatch(self):
         from tpu_nexus.models.registry import MoeAdapter
 

@@ -91,6 +91,15 @@ JOB_TEMPLATE_NAME_KEY = "science.sneaksanddata.com/algorithm-template-name"
 #: k8s-standard pod->job backlink; how a pod event maps to its run id
 #: (reference services/supervisor_test.go:246)
 POD_JOB_NAME_LABEL = "batch.kubernetes.io/job-name"
+#: JobSet controller's backlink stamped on child Jobs AND their pods.  For
+#: JobSet-launched runs the child Job is named `{run_id}-workers-0`, so the
+#: job-name backlink alone resolves a request id with no ledger row — the
+#: jobset-name label is the authoritative pod/child-job -> run mapping
+#: (generalization of the reference's pod->run backlink,
+#: services/supervisor.go:231-251, to the multi-host JobSet shape)
+JOBSET_NAME_LABEL = "jobset.sigs.k8s.io/jobset-name"
+#: JobSet controller's replicated-job backlink on child Jobs/pods
+JOBSET_REPLICATEDJOB_LABEL = "jobset.sigs.k8s.io/replicatedjob-name"
 
 
 def _utcnow() -> datetime:

@@ -11,6 +11,7 @@ assertions.
 from __future__ import annotations
 
 import asyncio
+import copy
 from typing import Any, AsyncIterator, Dict, List, Optional, Tuple
 
 from tpu_nexus.k8s.client import (
@@ -20,7 +21,11 @@ from tpu_nexus.k8s.client import (
     KubeClientError,
     NotFoundError,
 )
-from tpu_nexus.checkpoint.models import POD_JOB_NAME_LABEL
+from tpu_nexus.checkpoint.models import (
+    JOBSET_NAME_LABEL,
+    JOBSET_REPLICATEDJOB_LABEL,
+    POD_JOB_NAME_LABEL,
+)
 
 
 def _key(obj: Dict[str, Any]) -> Tuple[str, str]:
@@ -29,9 +34,18 @@ def _key(obj: Dict[str, Any]) -> Tuple[str, str]:
 
 
 class FakeKubeClient(KubeClient):
-    def __init__(self, objects: Optional[Dict[str, List[Dict[str, Any]]]] = None) -> None:
+    def __init__(
+        self,
+        objects: Optional[Dict[str, List[Dict[str, Any]]]] = None,
+        jobset_controller: bool = False,
+    ) -> None:
         """`objects` maps kind -> list of API dicts (the seeded cluster
-        state)."""
+        state).  With ``jobset_controller=True`` the fake also plays the
+        JobSet + Job controllers: a created JobSet materializes its child
+        Jobs (`{js}-{replicatedJob}-{idx}`) and their pods, labeled exactly
+        as the real controllers label them (jobset-name/replicatedjob-name
+        backlinks, batch.kubernetes.io/job-name, completion-index
+        annotation) — the deployment shape VERDICT r3 found untested."""
         self._objects: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {
             kind: {} for kind in KIND_API
         }
@@ -42,6 +56,9 @@ class FakeKubeClient(KubeClient):
         #: recorded write actions: (verb, kind, namespace, name, extra)
         self.actions: List[Tuple[str, str, str, str, Dict[str, Any]]] = []
         self._rv = 1
+        self._jobset_controller = jobset_controller
+        self._materialized_jobsets: set = set()
+        self._uid_counter = 0
 
     # -- seeding / injection (test API) -------------------------------------
 
@@ -57,6 +74,76 @@ class FakeKubeClient(KubeClient):
         self._rv += 1
         for queue in self._watchers.get(kind, []):
             queue.put_nowait((event_type, obj))
+        if self._jobset_controller and kind == "JobSet" and event_type == "ADDED":
+            name = (obj.get("metadata") or {}).get("name", "")
+            if name and name not in self._materialized_jobsets:
+                self._materialized_jobsets.add(name)
+                self._materialize_jobset_children(obj)
+
+    def _next_uid(self) -> str:
+        self._uid_counter += 1
+        return f"fake-uid-{self._uid_counter}"
+
+    def _materialize_jobset_children(self, jobset: Dict[str, Any]) -> None:
+        """What the JobSet controller + Job controller do: create the child
+        Job per replicatedJob replica, then its pods.  Child Jobs get the
+        replicatedJobs template's metadata labels plus the jobset backlinks;
+        pods get the pod template's labels plus the job-name backlink and the
+        jobset-name label (the real JobSet controller stamps it on pods too)."""
+        meta = jobset.get("metadata") or {}
+        js_name, ns = meta.get("name", ""), meta.get("namespace", "")
+        for rj in (jobset.get("spec") or {}).get("replicatedJobs", []):
+            rj_name = rj.get("name", "")
+            template = rj.get("template") or {}
+            for ridx in range(int(rj.get("replicas", 1) or 1)):
+                # fresh copy per replica: sibling Jobs must not share one
+                # mutable spec dict (real k8s objects are independent)
+                job_spec = copy.deepcopy(template.get("spec") or {})
+                job_name = f"{js_name}-{rj_name}-{ridx}"
+                job_labels = dict(((template.get("metadata") or {}).get("labels")) or {})
+                job_labels[JOBSET_NAME_LABEL] = js_name
+                job_labels[JOBSET_REPLICATEDJOB_LABEL] = rj_name
+                self.inject(
+                    "ADDED",
+                    "Job",
+                    {
+                        "apiVersion": "batch/v1",
+                        "kind": "Job",
+                        "metadata": {
+                            "name": job_name,
+                            "namespace": ns,
+                            "uid": self._next_uid(),
+                            "labels": job_labels,
+                        },
+                        "spec": job_spec,
+                        "status": {},
+                    },
+                )
+                pod_template = job_spec.get("template") or {}
+                pod_labels_base = dict(((pod_template.get("metadata") or {}).get("labels")) or {})
+                for i in range(int(job_spec.get("parallelism", 1) or 1)):
+                    pod_labels = dict(pod_labels_base)
+                    pod_labels[POD_JOB_NAME_LABEL] = job_name
+                    pod_labels[JOBSET_NAME_LABEL] = js_name
+                    pod_labels[JOBSET_REPLICATEDJOB_LABEL] = rj_name
+                    self.inject(
+                        "ADDED",
+                        "Pod",
+                        {
+                            "kind": "Pod",
+                            "metadata": {
+                                "name": f"{job_name}-{i}",
+                                "namespace": ns,
+                                "uid": self._next_uid(),
+                                "labels": pod_labels,
+                                "annotations": {
+                                    "batch.kubernetes.io/job-completion-index": str(i)
+                                },
+                            },
+                            "spec": copy.deepcopy(pod_template.get("spec") or {}),
+                            "status": {"phase": "Pending"},
+                        },
+                    )
 
     # -- KubeClient ----------------------------------------------------------
 
@@ -106,16 +193,30 @@ class FakeKubeClient(KubeClient):
             raise NotFoundError(f"{kind} {namespace}/{name} not found")
         self.inject("DELETED", kind, obj)
         if kind in ("Job", "JobSet"):
-            # background propagation: dependent pods are garbage-collected
+            # re-creating a same-named JobSet must re-materialize children
+            # even before the deferred GC below runs, so clear synchronously
+            if kind == "JobSet":
+                self._materialized_jobsets.discard(name)
+            # background propagation: dependents are garbage-collected
             # asynchronously (reference relies on DeletePropagationBackground,
             # services/supervisor.go:262)
-            asyncio.get_running_loop().call_soon(self._gc_pods_of_job, name)
+            asyncio.get_running_loop().call_soon(self._gc_dependents, kind, name)
 
-    def _gc_pods_of_job(self, job_name: str) -> None:
+    def _gc_dependents(self, kind: str, name: str) -> None:
+        if kind == "JobSet":
+            self._materialized_jobsets.discard(name)
+            # cascade: child Jobs first (which cascades to their pods)
+            jobs = self._objects.get("Job", {})
+            for _, job in list(jobs.items()):
+                labels = (job.get("metadata") or {}).get("labels") or {}
+                if labels.get(JOBSET_NAME_LABEL) == name:
+                    self.inject("DELETED", "Job", job)
+                    self._gc_dependents("Job", (job.get("metadata") or {}).get("name", ""))
         pods = self._objects.get("Pod", {})
-        for key, pod in list(pods.items()):
+        backlink = JOBSET_NAME_LABEL if kind == "JobSet" else POD_JOB_NAME_LABEL
+        for _, pod in list(pods.items()):
             labels = (pod.get("metadata") or {}).get("labels") or {}
-            if labels.get(POD_JOB_NAME_LABEL) == job_name:
+            if labels.get(backlink) == name:
                 self.inject("DELETED", "Pod", pod)
 
     # -- assertion helpers ---------------------------------------------------

@@ -139,6 +139,21 @@ class PodObj:
 
         return self.meta.labels.get(POD_JOB_NAME_LABEL, "")
 
+    def jobset_name(self) -> str:
+        """The owning-JobSet backlink the JobSet controller stamps on child
+        pods (jobset.sigs.k8s.io/jobset-name) — empty for plain-Job pods."""
+        from tpu_nexus.checkpoint.models import JOBSET_NAME_LABEL
+
+        return self.meta.labels.get(JOBSET_NAME_LABEL, "")
+
+    def run_id(self) -> str:
+        """Pod -> run id.  The jobset-name backlink wins: for JobSet-launched
+        runs the child Job is named `{run_id}-workers-0`, so the job-name
+        backlink names a resource that has no ledger row (the run id IS the
+        JobSet name).  Plain-Job pods fall back to the reference's job-name
+        semantics (services/supervisor.go:231,241,251)."""
+        return self.jobset_name() or self.job_name()
+
 
 @dataclass
 class Condition:
@@ -171,6 +186,20 @@ class JobObj:
             conditions=[Condition.from_api(c) for c in (status.get("conditions") or [])],
             raw=obj,
         )
+
+    def jobset_name(self) -> str:
+        """Owning-JobSet backlink on controller-created child Jobs — empty
+        for top-level (plain) Jobs."""
+        from tpu_nexus.checkpoint.models import JOBSET_NAME_LABEL
+
+        return self.meta.labels.get(JOBSET_NAME_LABEL, "")
+
+    def run_id(self) -> str:
+        """Job -> run id: the k8s Job name IS the request id (reference
+        services/supervisor.go:160,177-180) — unless this is a JobSet child
+        Job, whose name is `{run_id}-workers-0`; then the jobset-name
+        backlink carries the run id."""
+        return self.jobset_name() or self.meta.name
 
 
 @dataclass

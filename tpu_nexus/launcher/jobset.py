@@ -203,7 +203,13 @@ def compose_jobset(spec: LaunchSpec) -> Dict[str, Any]:
                 {
                     "name": "workers",
                     "replicas": 1,
-                    "template": {"spec": job["spec"]},
+                    # template metadata labels propagate to the child Job —
+                    # the supervisor's event filter must recognize child-Job
+                    # events (e.g. BackoffLimitExceeded) as run events
+                    "template": {
+                        "metadata": {"labels": run_labels(spec)},
+                        "spec": job["spec"],
+                    },
                 }
             ],
         },

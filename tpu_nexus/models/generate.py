@@ -47,15 +47,27 @@ ModelConfig = Any  # LlamaConfig or MoeConfig — same stacked-layer layout
 
 
 def _decode_cfg(cfg):
-    """Normalize a config for the decode path: MoE always uses scatter
+    """Normalize a config for the decode path.  MoE always uses scatter
     dispatch here — the training-tuned gmm default pads each call's
     assignments up to full m-tiles, which at decode token counts inflates
-    expert compute ~70x, and sort's contiguous slices win nothing at B
-    rows."""
-    if isinstance(cfg, MoeConfig) and cfg.dispatch != "scatter":
+    expert compute ~70x, and sort's contiguous slices win nothing at B rows.
+
+    Scatter dispatch is capacity-bounded, so the capacity factor is raised
+    to the dropless bound ``n_experts / experts_per_token`` (making
+    ``expert_capacity >= T`` for any routing): a model trained dropless with
+    gmm must not silently drop assignments at serve time under routing
+    imbalance, and at decode token counts (T = B) the extra slots are
+    trivial memory."""
+    if isinstance(cfg, MoeConfig):
         import dataclasses
 
-        return dataclasses.replace(cfg, dispatch="scatter")
+        dropless = cfg.n_experts / cfg.experts_per_token
+        if cfg.dispatch != "scatter" or cfg.capacity_factor < dropless:
+            return dataclasses.replace(
+                cfg,
+                dispatch="scatter",
+                capacity_factor=max(cfg.capacity_factor, dropless),
+            )
     return cfg
 
 

@@ -21,7 +21,6 @@ Design choices (all for the XLA compilation model, not ported from anywhere):
 
 from __future__ import annotations
 
-import math
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, Optional
 
@@ -339,24 +338,13 @@ def llama_hidden_pp(
     """
     from jax.sharding import PartitionSpec as P
 
-    from tpu_nexus.parallel.pipeline import auto_microbatches, pipeline_apply
+    from tpu_nexus.parallel.pipeline import pipeline_apply, resolve_microbatches
 
     x, cos, sin, attn_fn = _forward_preamble(params, tokens, cfg, positions, attn_fn, attn_impl)
     axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes or ())
-    dp_extent = 1
-    if mesh is not None:
-        dp_extent = math.prod(mesh.shape.get(a, 1) for a in axes)
-    if not microbatches:
-        microbatches = auto_microbatches(x.shape[0], n_stages, min_microbatch=dp_extent)
-    elif x.shape[0] % microbatches or (x.shape[0] // microbatches) % dp_extent:
-        # an explicit pp_microbatches that leaves microbatches smaller than
-        # (or ragged over) the data-parallel extent would silently pad every
-        # tick's batch sharding — refuse rather than waste dp/fsdp devices
-        raise ValueError(
-            f"pp_microbatches={microbatches} gives microbatch size "
-            f"{x.shape[0] / microbatches} from batch {x.shape[0]}, which is not a "
-            f"multiple of the data-parallel extent {dp_extent} ({'×'.join(axes) or '-'})"
-        )
+    microbatches = resolve_microbatches(
+        x.shape[0], n_stages, microbatches, mesh=mesh, batch_axes=axes
+    )
 
     def layer_fn(carry, layer):
         x, cos, sin = carry

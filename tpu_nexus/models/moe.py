@@ -612,7 +612,7 @@ def moe_hidden_pp(
     from jax.sharding import PartitionSpec as P
 
     from tpu_nexus.ops import attention as _ops_attention
-    from tpu_nexus.parallel.pipeline import auto_microbatches, pipeline_apply
+    from tpu_nexus.parallel.pipeline import pipeline_apply, resolve_microbatches
 
     if cfg.dispatch != "scatter":
         raise ValueError(
@@ -637,11 +637,9 @@ def moe_hidden_pp(
     cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
 
     axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes or ())
-    dp_extent = 1
-    if mesh is not None:
-        dp_extent = math.prod(mesh.shape.get(a, 1) for a in axes)
-    if not microbatches:
-        microbatches = auto_microbatches(b, n_stages, min_microbatch=dp_extent)
+    microbatches = resolve_microbatches(
+        b, n_stages, microbatches, mesh=mesh, batch_axes=axes
+    )
 
     def layer_fn(carry, layer):
         x, cos, sin, lb, rz, dr = carry

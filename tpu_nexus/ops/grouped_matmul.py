@@ -215,7 +215,17 @@ def _tgmm_raw(lhs, rhs, tile_expert, n_experts, block_m, block_n, interpret):
 def gmm(lhs, rhs, tile_expert, block_m=BLOCK_M, block_n=BLOCK_N, interpret=False):
     """Grouped matmul ``[M, K] × [E, K, N] → [M, N]`` with tile-aligned
     expert runs; ``tile_expert [M / block_m]`` int32 maps each m-tile to its
-    expert.  Differentiable (custom VJP: transposed gmm + tgmm)."""
+    expert.  Differentiable (custom VJP: transposed gmm + tgmm).
+
+    INVARIANT (backward only): ``tile_expert`` must mention EVERY expert in
+    ``[0, E)`` at least once — the tgmm kernel writes ``d_rhs[e]`` only on
+    tiles routed to ``e``, so an expert with no tile would keep its
+    ``[K, N]`` gradient block as uninitialized device memory.  The MoE
+    dispatch satisfies this structurally (``padded_counts`` reserves at
+    least one tile per expert); other callers must either guarantee the
+    same or use :func:`gmm_checked`, which zero-masks uncovered experts'
+    gradient blocks at the cost of one elementwise pass over ``d_rhs``.
+    The forward pass has no such requirement."""
     return _gmm_fwd(lhs, rhs, tile_expert, block_m, block_n, interpret)[0]
 
 
@@ -246,3 +256,23 @@ def _gmm_bwd(block_m, block_n, interpret, res, d_out):
 
 
 gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def gmm_checked(lhs, rhs, tile_expert, block_m=BLOCK_M, block_n=BLOCK_N, interpret=False):
+    """:func:`gmm` for callers that CANNOT guarantee every expert has a
+    tile: identical forward; the backward zero-masks ``d_rhs`` blocks of
+    experts absent from ``tile_expert`` (otherwise uninitialized memory).
+    Costs one extra elementwise pass over ``d_rhs`` — the internal MoE
+    dispatch uses :func:`gmm` because its padding covers all experts."""
+    return _gmm_fwd(lhs, rhs, tile_expert, block_m, block_n, interpret)[0]
+
+
+def _gmm_checked_bwd(block_m, block_n, interpret, res, d_out):
+    _, rhs, tile_expert = res
+    d_lhs, d_rhs, f0 = _gmm_bwd(block_m, block_n, interpret, res, d_out)
+    present = jnp.zeros((rhs.shape[0],), bool).at[tile_expert].set(True)
+    return d_lhs, jnp.where(present[:, None, None], d_rhs, 0).astype(d_rhs.dtype), f0
+
+
+gmm_checked.defvjp(_gmm_fwd, _gmm_checked_bwd)

@@ -56,6 +56,36 @@ def auto_microbatches(batch: int, n_stages: int, min_microbatch: int = 1) -> int
     )
 
 
+def resolve_microbatches(
+    batch: int,
+    n_stages: int,
+    microbatches: int = 0,
+    mesh: Optional[Mesh] = None,
+    batch_axes: Any = ("dp", "fsdp"),
+) -> int:
+    """Auto-pick (``microbatches=0``) or VALIDATE an explicit microbatch
+    count against the data-parallel extent.  An explicit count whose
+    microbatch size is not a multiple of the dp/fsdp extent would silently
+    let GSPMD pad every tick's batch sharding — both model families must
+    refuse it loudly (ADVICE r3: the MoE path skipped this check)."""
+    import math
+
+    axes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes or ())
+    dp_extent = 1
+    if mesh is not None:
+        dp_extent = math.prod(mesh.shape.get(a, 1) for a in axes)
+    if not microbatches:
+        return auto_microbatches(batch, n_stages, min_microbatch=dp_extent)
+    if batch % microbatches or (batch // microbatches) % dp_extent:
+        raise ValueError(
+            f"pp_microbatches={microbatches} gives microbatch size "
+            f"{batch / microbatches} from batch {batch}, which is not a "
+            f"multiple of the data-parallel extent {dp_extent} "
+            f"({'×'.join(axes) or '-'})"
+        )
+    return microbatches
+
+
 def _constrain(tree: Any, mesh: Optional[Mesh], spec_tree: Any) -> Any:
     """with_sharding_constraint over a pytree of PartitionSpecs (no-op when
     mesh/specs are absent)."""

@@ -49,7 +49,13 @@ def is_nexus_run_event(
         return False
     if ref.kind == "Job":
         job: Optional[JobObj] = get_cached_object(ref.name, obj_ns, informers.get("Job"))
-        return job is not None and _is_run_labeled(job.meta.labels)
+        if job is None:
+            return False
+        if _is_run_labeled(job.meta.labels):
+            return True
+        # JobSet child Jobs may carry only controller-stamped labels; fall
+        # back to the owning JobSet via the jobset-name backlink
+        return _owning_jobset_is_run(job.jobset_name(), obj_ns, informers)
     if ref.kind == "JobSet":
         jobset: Optional[JobSetObj] = get_cached_object(ref.name, obj_ns, informers.get("JobSet"))
         return jobset is not None and _is_run_labeled(jobset.meta.labels)
@@ -61,8 +67,19 @@ def is_nexus_run_event(
             return True
         # fall back to the owning Job's labels via the job-name backlink
         job_name = pod.job_name()
-        if not job_name:
-            return False
-        job = get_cached_object(job_name, obj_ns, informers.get("Job"))
-        return job is not None and _is_run_labeled(job.meta.labels)
+        if job_name:
+            job = get_cached_object(job_name, obj_ns, informers.get("Job"))
+            if job is not None and _is_run_labeled(job.meta.labels):
+                return True
+        # ... then to the owning JobSet via the jobset-name backlink
+        return _owning_jobset_is_run(pod.jobset_name(), obj_ns, informers)
     return False
+
+
+def _owning_jobset_is_run(
+    jobset_name: str, namespace: str, informers: Dict[str, Informer]
+) -> bool:
+    if not jobset_name:
+        return False
+    jobset = get_cached_object(jobset_name, namespace, informers.get("JobSet"))
+    return jobset is not None and _is_run_labeled(jobset.meta.labels)

@@ -443,8 +443,16 @@ class Supervisor:
 
     async def _delete_run_object(self, result: RunStatusAnalysisResult) -> None:
         """Delete the run's Job or JobSet with background propagation;
-        NotFound is fine (already gone)."""
-        kind = "JobSet" if result.object_kind == "JobSet" else "Job"
+        NotFound is fine (already gone).
+
+        The run id always names the TOP-LEVEL resource: for JobSet-launched
+        runs, pod/child-job events resolve their run id via the jobset-name
+        backlink, so the delete must target the owning JobSet — deleting the
+        child Job `{run}-workers-0` would just make the JobSet controller
+        recreate it (or worse, count it against the failure policy).
+        _resolve_run_kind covers JobSet-kind results too: their JobSet was
+        in the informer cache at classification time."""
+        kind = self._resolve_run_kind(result.request_id)
         try:
             await self._client.delete_object(kind, self.namespace, result.request_id)
         except NotFoundError:

@@ -249,10 +249,16 @@ def _classify_event(
         job: Optional[JobObj] = get_cached_object(ref.name, obj_ns, informers.get("Job"))
         if job is None:
             return None  # stale event: job no longer cached (reference :161-164)
-        # the k8s Job name IS the request id; the template label carries the
-        # algorithm name (reference :160,177-181)
-        request_id = job.meta.name
+        # the k8s Job name IS the request id (reference :160,177-181) — except
+        # for JobSet child Jobs (`{run}-workers-0`), where the jobset-name
+        # backlink carries the run id; the template label carries the
+        # algorithm name, falling back to the owning JobSet's labels
+        request_id = job.run_id()
         algorithm = job.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
+        if not algorithm and job.jobset_name():
+            owner = get_cached_object(job.jobset_name(), obj_ns, informers.get("JobSet"))
+            if owner is not None:
+                algorithm = owner.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
         uid, kind = job.meta.uid, "Job"
         if event.reason == "FailedCreate":
             return _result(
@@ -319,14 +325,18 @@ def _classify_event(
         pod: Optional[PodObj] = get_cached_object(ref.name, obj_ns, informers.get("Pod"))
         if pod is None:
             return None  # stale (reference :218-221)
-        # pod -> run id via the job-name backlink (reference :231,241,251)
-        request_id = pod.job_name()
-        job = get_cached_object(request_id, obj_ns, informers.get("Job")) if request_id else None
+        # pod -> run id: jobset-name backlink first (multi-host runs — the
+        # child Job `{run}-workers-0` has no ledger row), then the
+        # reference's job-name backlink (:231,241,251)
+        request_id = pod.run_id()
+        owner = None
+        if pod.jobset_name():
+            owner = get_cached_object(pod.jobset_name(), obj_ns, informers.get("JobSet"))
+        if owner is None and pod.job_name():
+            owner = get_cached_object(pod.job_name(), obj_ns, informers.get("Job"))
         algorithm = (
-            job.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
-            if job is not None
-            else pod.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
-        )
+            owner.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "") if owner is not None else ""
+        ) or pod.meta.labels.get(JOB_TEMPLATE_NAME_KEY, "")
         uid, kind = pod.meta.uid, "Pod"
         if event.reason == "Started":
             return _result(

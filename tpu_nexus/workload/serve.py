@@ -34,7 +34,6 @@ from tpu_nexus.parallel.distributed import ProcessContext, initialize_distribute
 from tpu_nexus.workload.faults import FaultPlan, maybe_inject
 from tpu_nexus.workload.harness import LedgerReporter
 from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
-from tpu_nexus.workload.train import TrainConfig, init_train_state
 
 logger = logging.getLogger(__name__)
 
@@ -105,12 +104,9 @@ def run_serving(
         ckpt = TensorCheckpointer(cfg.checkpoint_dir)
         latest = ckpt.latest_step()
         if latest is not None:
-            # restore through the train-state template so serve loads
-            # exactly the structure train saved, then keep only the params
-            template = init_train_state(
-                jax.random.PRNGKey(cfg.seed), adapter, TrainConfig()
-            )
-            params = ckpt.restore(template, latest)["params"]
+            # params-only, template-free: serve must not assume the training
+            # run's TrainConfig (its opt-state structure is irrelevant here)
+            params = ckpt.restore_params(latest)
             restored_from = latest
             logger.info("restored tensor checkpoint at step %d", latest)
         ckpt.close()

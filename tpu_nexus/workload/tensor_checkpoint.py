@@ -50,6 +50,22 @@ class TensorCheckpointer:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
         return self._mngr.restore(step, args=self._ocp.args.StandardRestore(state_like))
 
+    def restore_params(self, step: Optional[int] = None) -> Dict[str, Any]:
+        """Template-free restore of the ``params`` subtree only.
+
+        Serving must not depend on reconstructing the *training* run's
+        opt-state structure (a train-state template built from a default
+        TrainConfig silently breaks the moment an optimizer knob changes
+        the opt-state tree — ADVICE r3).  Orbax's template-free restore
+        reads the saved structure from checkpoint metadata; the optimizer
+        moments are deserialized and discarded (acceptable IO cost at serve
+        startup; Orbax's partial-restore API does not compose with
+        StandardSave through the CheckpointManager)."""
+        step = self._mngr.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        return self._mngr.restore(step)["params"]
+
     def uri_for(self, step: int) -> str:
         return f"{self.directory}/{step}"
 
