@@ -130,3 +130,51 @@ def test_sqlite_persistence_across_reopen(tmp_path):
     got = s2.read_checkpoint("test-algorithm", "f47ac10b-58cc-4372-a567-0e02b2c3d479")
     assert got is not None and got.per_chip_steps == {"h0/c0": 7}
     s2.close()
+
+
+def test_compare_and_set_applies_only_on_match(store):
+    cp = make_cp(lifecycle_stage=LifecycleStage.RUNNING, restart_count=1)
+    store.upsert_checkpoint(cp)
+    key = (cp.algorithm, cp.id)
+
+    # mismatched expectation: nothing written
+    assert not store.compare_and_set(
+        *key,
+        {"lifecycle_stage": LifecycleStage.BUFFERED},
+        {"lifecycle_stage": LifecycleStage.FAILED},
+    )
+    assert store.read_checkpoint(*key).lifecycle_stage == LifecycleStage.RUNNING
+
+    # matched (multi-column) expectation: applied
+    assert store.compare_and_set(
+        *key,
+        {"lifecycle_stage": LifecycleStage.RUNNING, "restart_count": 1},
+        {"lifecycle_stage": LifecycleStage.PREEMPTED, "restart_count": 2,
+         "preempted_generation": "gen-uid-7"},
+    )
+    got = store.read_checkpoint(*key)
+    assert got.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert got.restart_count == 2
+    assert got.preempted_generation == "gen-uid-7"
+
+    # missing row: False, no write
+    assert not store.compare_and_set(
+        "no-such-alg", "no-such-id", {"lifecycle_stage": "X"}, {"lifecycle_stage": "Y"}
+    )
+
+    # the loser of a CAS race observes the winner's value, not its own
+    assert not store.compare_and_set(
+        *key,
+        {"restart_count": 1},
+        {"restart_count": 99},
+    )
+    assert store.read_checkpoint(*key).restart_count == 2
+
+
+def test_compare_and_set_rejects_unknown_and_merge_only_columns(store):
+    cp = make_cp()
+    store.upsert_checkpoint(cp)
+    with pytest.raises(ValueError):
+        store.compare_and_set(cp.algorithm, cp.id, {"nope": 1}, {"tag": "x"})
+    with pytest.raises(ValueError):
+        store.compare_and_set(cp.algorithm, cp.id, {"tag": "x"}, {"per_chip_steps": {}})

@@ -156,6 +156,51 @@ class TestWrites:
         assert got.per_chip_steps == {"host0/chip0": 128, "host1/chip3": 127}
         assert got.hlo_trace_ref == "gs://traces/run/module_0001.hlo"
 
+    def test_compare_and_set_lwt_against_real_coordinator(self, store):
+        """The LWT path (UPDATE … IF) against a real Paxos coordinator:
+        applied on match, refused on mismatch, and two racing writers
+        resolve to exactly one winner."""
+        import threading
+
+        rid = str(uuid.uuid4())
+        store.upsert_checkpoint(_full_checkpoint("it-cas", rid))
+        assert store.compare_and_set(
+            "it-cas", rid,
+            {"lifecycle_stage": LifecycleStage.RUNNING},
+            {"lifecycle_stage": LifecycleStage.PREEMPTED, "restart_count": 1,
+             "preempted_generation": "gen-1"},
+        )
+        got = store.read_checkpoint("it-cas", rid)
+        assert got.lifecycle_stage == LifecycleStage.PREEMPTED
+        assert got.restart_count == 1 and got.preempted_generation == "gen-1"
+        # stale expectation refused by the coordinator
+        assert not store.compare_and_set(
+            "it-cas", rid,
+            {"lifecycle_stage": LifecycleStage.RUNNING},
+            {"lifecycle_stage": LifecycleStage.FAILED},
+        )
+        # two racing increments from the same observed count: one winner
+        results = []
+        barrier = threading.Barrier(2)
+
+        def racer():
+            barrier.wait()
+            results.append(
+                store.compare_and_set(
+                    "it-cas", rid,
+                    {"restart_count": 1},
+                    {"restart_count": 2},
+                )
+            )
+
+        threads = [threading.Thread(target=racer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(results) == [False, True]
+        assert store.read_checkpoint("it-cas", rid).restart_count == 2
+
     def test_update_fields_rejects_unknown_column(self, store):
         with pytest.raises(Exception):
             store.update_fields("it-update", str(uuid.uuid4()), {"evil; DROP": "x"})

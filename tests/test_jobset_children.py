@@ -247,6 +247,93 @@ async def test_child_pod_event_without_ledger_row_deletes_owning_jobset():
     await fx.task
 
 
+def _recreate_children(fx, rid):
+    """What the JobSet Recreate policy does after a preemption: the child
+    Job and its pods come back under the SAME names with FRESH uids — the
+    new pod generation that makes the next preemption a distinct incident."""
+    jobs = fx.client._objects["Job"]
+    pods = fx.client._objects["Pod"]
+    for (ns, name), job in list(jobs.items()):
+        if (job["metadata"].get("labels") or {}).get(JOBSET_NAME_LABEL) == rid:
+            fresh = {**job, "metadata": {**job["metadata"], "uid": str(uuid.uuid4())}}
+            fx.client.inject("ADDED", "Job", fresh)
+    for (ns, name), pod in list(pods.items()):
+        if (pod["metadata"].get("labels") or {}).get(JOBSET_NAME_LABEL) == rid:
+            fresh = {**pod, "metadata": {**pod["metadata"], "uid": str(uuid.uuid4())}}
+            fx.client.inject("ADDED", "Pod", fresh)
+
+
+async def test_restart_budget_exhaustion_goes_terminal():
+    """VERDICT r3 weak #6: the launcher composes failurePolicy.maxRestarts=3
+    but nothing capped the ledger's restart accounting — a preemption loop
+    never went terminal.  Drive 4 distinct preemption incidents (the JobSet
+    controller recreating the children — fresh pod generation — and the
+    harness heartbeating RUNNING between them): the first 3 count as
+    restarts; the 4th lands DEADLINE_EXCEEDED with a trace explaining the
+    spent budget, and the JobSet is deleted."""
+    fx = JobSetFixture()
+    rid = str(uuid.uuid4())
+    await fx.launch(rid)
+    cp = fx.checkpoint(rid).deep_copy()
+    cp.lifecycle_stage = LifecycleStage.RUNNING
+    fx.store.upsert_checkpoint(cp)
+    await fx.start()
+
+    for incident in range(1, 4):
+        fx.client.inject(
+            "ADDED", "Event",
+            _event("TPUPreempted", f"TPU node preempted (incident {incident})",
+                   "Pod", f"{rid}-workers-0-0"),
+        )
+        assert await fx.supervisor.idle(timeout=10)
+        cp = fx.checkpoint(rid)
+        assert cp.lifecycle_stage == LifecycleStage.PREEMPTED
+        assert cp.restart_count == incident, (incident, cp.restart_count)
+        # controller recreates the workers (new generation); harness
+        # heartbeats RUNNING again
+        _recreate_children(fx, rid)
+        cp = cp.deep_copy()
+        cp.lifecycle_stage = LifecycleStage.RUNNING
+        fx.store.upsert_checkpoint(cp)
+        await asyncio.sleep(0.01)
+
+    fx.client.inject(
+        "ADDED", "Event",
+        _event("TPUPreempted", "TPU node preempted (incident 4)", "Pod", f"{rid}-workers-0-0"),
+    )
+    await fx.stop()
+    cp = fx.checkpoint(rid)
+    assert cp.lifecycle_stage == LifecycleStage.DEADLINE_EXCEEDED
+    assert cp.restart_count == 3  # never advertises a 4th restart
+    assert cp.algorithm_failure_cause == MSG_DEADLINE_EXCEEDED
+    assert "maxRestarts=3" in cp.algorithm_failure_details
+    assert fx.client.deleted("JobSet") == [rid]
+
+
+async def test_same_incident_fanout_does_not_escalate_at_budget():
+    """The Nth host's event for the FINAL allowed restart must stay a
+    suppressed duplicate, not tip the run over the budget."""
+    fx = JobSetFixture()
+    rid = str(uuid.uuid4())
+    await fx.launch(rid)
+    cp = fx.checkpoint(rid).deep_copy()
+    cp.lifecycle_stage = LifecycleStage.RUNNING
+    cp.restart_count = 2  # two incidents already recorded
+    fx.store.upsert_checkpoint(cp)
+    await fx.start()
+    # the 3rd (last allowed) incident fans out to both hosts within seconds
+    for i in range(2):
+        fx.client.inject(
+            "ADDED", "Event",
+            _event("TPUPreempted", "TPU node preempted", "Pod", f"{rid}-workers-0-{i}"),
+        )
+    await fx.stop()
+    cp = fx.checkpoint(rid)
+    assert cp.lifecycle_stage == LifecycleStage.PREEMPTED  # NOT terminal
+    assert cp.restart_count == 3
+    assert fx.client.deleted("JobSet") == []
+
+
 async def test_jobset_delete_cascades_to_children():
     """Background-propagation parity in the fake: deleting the JobSet GCs
     child Jobs and their pods (the supervisor relies on this to not re-fire

@@ -423,6 +423,7 @@ class CqlCheckpointStore(CheckpointStore):
             "per_chip_steps": {k: int(v) for k, v in cp.per_chip_steps.items()} or None,
             "tensor_checkpoint_uri": cp.tensor_checkpoint_uri,
             "restart_count": cp.restart_count,
+            "preempted_generation": cp.preempted_generation,
         }
         cols = ", ".join(values)
         literals = ", ".join(to_literal(v) for v in values.values())
@@ -452,6 +453,35 @@ class CqlCheckpointStore(CheckpointStore):
             f"UPDATE {self.table} SET {sets} "
             f"WHERE algorithm = {quote_text(algorithm)} AND id = {quote_text(id)}"
         )
+
+    def compare_and_set(
+        self,
+        algorithm: str,
+        id: str,
+        expected: Dict[str, Any],
+        fields: Dict[str, Any],
+    ) -> bool:
+        """CQL lightweight transaction: ``UPDATE … IF col = val AND …``.
+
+        The coordinator runs Paxos for the conditional write and answers
+        with a result set whose first column is the ``[applied]`` boolean
+        (plus the current values when not applied) — the real
+        multi-replica-safe primitive the in-memory/sqlite stores emulate."""
+        _validate_field_names(fields)
+        _validate_field_names(expected)
+        if not fields:
+            return True
+        sets = ", ".join(f"{k} = {to_literal(v)}" for k, v in fields.items())
+        # empty `expected` still rides the LWT as IF EXISTS: a plain UPDATE
+        # would blind-UPSERT a phantom row on a missing id and "succeed",
+        # diverging from the other backends' row-must-exist contract
+        conds = " AND ".join(f"{k} = {to_literal(v)}" for k, v in expected.items()) or "EXISTS"
+        rows = self._execute(
+            f"UPDATE {self.table} SET {sets} "
+            f"WHERE algorithm = {quote_text(algorithm)} AND id = {quote_text(id)} "
+            f"IF {conds}"
+        )
+        return bool(rows and rows[0].get("[applied]"))
 
     def _query_index(self, column: str, value: str) -> List[CheckpointedRequest]:
         rows = self._execute(

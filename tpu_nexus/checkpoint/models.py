@@ -135,6 +135,12 @@ class CheckpointedRequest:
     per_chip_steps: Dict[str, int] = field(default_factory=dict)
     tensor_checkpoint_uri: str = ""
     restart_count: int = 0
+    #: uid of the child-Job generation whose preemption was last COUNTED —
+    #: the JobSet Recreate policy gives every restart a fresh child-Job uid,
+    #: so this fences one incident's multi-host event fan-out across
+    #: SUPERVISOR REPLICAS without trusting any wall clock: an event whose
+    #: pod belongs to an already-recorded generation is the same incident
+    preempted_generation: str = ""
 
     def is_finished(self) -> bool:
         """True for terminal stages; guards late events on finished runs

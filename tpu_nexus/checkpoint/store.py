@@ -56,11 +56,27 @@ _COLUMNS = [
     "per_chip_steps",
     "tensor_checkpoint_uri",
     "restart_count",
+    "preempted_generation",
 ]
 
 
 class CheckpointStoreError(Exception):
     pass
+
+
+def _normalize_sql_value(value):
+    """Bind the same representations ``to_row()`` produces — sqlite3's
+    implicit datetime adapter is deprecated (removal slated) and dicts
+    aren't bindable at all.  Shared by every sqlite write path so CAS
+    conditions always compare the representation upsert stored."""
+    import json
+    from datetime import datetime
+
+    if isinstance(value, datetime):
+        return value.isoformat()
+    if isinstance(value, dict):
+        return json.dumps(value, sort_keys=True)
+    return value
 
 
 def _validate_field_names(fields: Dict[str, object]) -> None:
@@ -121,6 +137,33 @@ class CheckpointStore:
             setattr(cp, key, value)
         self.upsert_checkpoint(cp)
 
+    def compare_and_set(
+        self,
+        algorithm: str,
+        id: str,
+        expected: Dict[str, object],
+        fields: Dict[str, object],
+    ) -> bool:
+        """Atomically apply ``fields`` iff every ``expected`` column still
+        holds the given value; returns False (nothing written) on mismatch
+        or missing row.  The supervisor's lifecycle commits ride this so two
+        replicas observing one event storm cannot double-apply a transition
+        (the chart's own ``replicas:`` knob scales past one at ~1000 pods —
+        reference .helm/values.yaml:124-125).  Backends override with a real
+        atomic primitive (CQL lightweight transaction ``UPDATE … IF``,
+        sqlite conditioned UPDATE); this default check-then-write is only
+        safe single-writer."""
+        _validate_field_names(fields)
+        _validate_field_names(expected)  # per_chip_steps is merge-only: not comparable
+        cp = self.read_checkpoint(algorithm, id)
+        if cp is None:
+            return False
+        for key, value in expected.items():
+            if getattr(cp, key) != value:
+                return False
+        self.update_fields(algorithm, id, fields)
+        return True
+
     def close(self) -> None:
         pass
 
@@ -167,6 +210,26 @@ class InMemoryCheckpointStore(CheckpointStore):
             if cp is not None:
                 for key, value in fields.items():
                     setattr(cp, key, value)
+
+    def compare_and_set(
+        self,
+        algorithm: str,
+        id: str,
+        expected: Dict[str, object],
+        fields: Dict[str, object],
+    ) -> bool:
+        _validate_field_names(fields)
+        _validate_field_names(expected)
+        with self._lock:
+            cp = self._rows.get((algorithm, id))
+            if cp is None:
+                return False
+            for key, value in expected.items():
+                if getattr(cp, key) != value:
+                    return False
+            for key, value in fields.items():
+                setattr(cp, key, value)
+            return True
 
 
 class SqliteCheckpointStore(CheckpointStore):
@@ -271,28 +334,43 @@ class SqliteCheckpointStore(CheckpointStore):
         _validate_field_names(fields)
         if not fields:
             return
-
-        def normalize(value):
-            # bind the same representations to_row() produces — sqlite3's
-            # implicit datetime adapter is deprecated (removal slated) and
-            # dicts aren't bindable at all
-            import json
-            from datetime import datetime
-
-            if isinstance(value, datetime):
-                return value.isoformat()
-            if isinstance(value, dict):
-                return json.dumps(value, sort_keys=True)
-            return value
-
         sets = ", ".join(f"{k}=?" for k in fields)
         with self._lock:
             conn = self._connection()
             conn.execute(
                 f"UPDATE checkpoints SET {sets} WHERE algorithm=? AND id=?",
-                [*(normalize(v) for v in fields.values()), algorithm, id],
+                [*(_normalize_sql_value(v) for v in fields.values()), algorithm, id],
             )
             conn.commit()
+
+    def compare_and_set(
+        self,
+        algorithm: str,
+        id: str,
+        expected: Dict[str, object],
+        fields: Dict[str, object],
+    ) -> bool:
+        """One conditioned UPDATE: sqlite serializes writers, so rowcount
+        tells atomically whether every expected column still matched."""
+        _validate_field_names(fields)
+        _validate_field_names(expected)
+        if not fields:
+            return True
+        sets = ", ".join(f"{k}=?" for k in fields)
+        conds = " AND ".join(f"{k}=?" for k in expected) or "1=1"
+        with self._lock:
+            conn = self._connection()
+            cur = conn.execute(
+                f"UPDATE checkpoints SET {sets} WHERE algorithm=? AND id=? AND {conds}",
+                [
+                    *(_normalize_sql_value(v) for v in fields.values()),
+                    algorithm,
+                    id,
+                    *(_normalize_sql_value(v) for v in expected.values()),
+                ],
+            )
+            conn.commit()
+            return cur.rowcount == 1
 
     def close(self) -> None:
         with self._lock:

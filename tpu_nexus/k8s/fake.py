@@ -199,25 +199,38 @@ class FakeKubeClient(KubeClient):
                 self._materialized_jobsets.discard(name)
             # background propagation: dependents are garbage-collected
             # asynchronously (reference relies on DeletePropagationBackground,
-            # services/supervisor.go:262)
-            asyncio.get_running_loop().call_soon(self._gc_dependents, kind, name)
+            # services/supervisor.go:262).  The victim set is SNAPSHOTTED by
+            # uid now — real k8s GC tracks ownerReference uids, so a
+            # same-named resource re-created before the GC tick keeps its
+            # fresh children
+            victims = self._dependents_of(kind, name)
+            asyncio.get_running_loop().call_soon(self._gc_victims, victims)
 
-    def _gc_dependents(self, kind: str, name: str) -> None:
+    def _dependents_of(self, kind: str, name: str) -> List[Tuple[str, Dict[str, Any]]]:
+        """(kind, object) snapshot of the dependents a controller would GC."""
+        out: List[Tuple[str, Dict[str, Any]]] = []
         if kind == "JobSet":
-            self._materialized_jobsets.discard(name)
-            # cascade: child Jobs first (which cascades to their pods)
-            jobs = self._objects.get("Job", {})
-            for _, job in list(jobs.items()):
+            for job in self._objects.get("Job", {}).values():
                 labels = (job.get("metadata") or {}).get("labels") or {}
                 if labels.get(JOBSET_NAME_LABEL) == name:
-                    self.inject("DELETED", "Job", job)
-                    self._gc_dependents("Job", (job.get("metadata") or {}).get("name", ""))
-        pods = self._objects.get("Pod", {})
-        backlink = JOBSET_NAME_LABEL if kind == "JobSet" else POD_JOB_NAME_LABEL
-        for _, pod in list(pods.items()):
-            labels = (pod.get("metadata") or {}).get("labels") or {}
-            if labels.get(backlink) == name:
-                self.inject("DELETED", "Pod", pod)
+                    out.append(("Job", job))
+                    out.extend(
+                        self._dependents_of("Job", (job.get("metadata") or {}).get("name", ""))
+                    )
+        else:
+            for pod in self._objects.get("Pod", {}).values():
+                labels = (pod.get("metadata") or {}).get("labels") or {}
+                if labels.get(POD_JOB_NAME_LABEL) == name:
+                    out.append(("Pod", pod))
+        return out
+
+    def _gc_victims(self, victims: List[Tuple[str, Dict[str, Any]]]) -> None:
+        for kind, obj in victims:
+            meta = obj.get("metadata") or {}
+            current = self._objects.get(kind, {}).get((meta.get("namespace", ""), meta.get("name", "")))
+            # uid fence: only GC the exact generation that was deleted
+            if current is not None and (current.get("metadata") or {}).get("uid") == meta.get("uid"):
+                self.inject("DELETED", kind, obj)
 
     # -- assertion helpers ---------------------------------------------------
 

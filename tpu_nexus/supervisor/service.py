@@ -26,7 +26,7 @@ import asyncio
 import time
 from collections import deque
 from dataclasses import dataclass
-from datetime import timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Dict, Optional
 
 from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
@@ -42,6 +42,7 @@ from tpu_nexus.supervisor import resolvers
 from tpu_nexus.supervisor.taxonomy import (
     DECISION_STAGE,
     DELETES_JOB,
+    MSG_DEADLINE_EXCEEDED,
     DecisionAction,
     RunStatusAnalysisResult,
     _pod_termination_text,
@@ -230,6 +231,21 @@ class Supervisor:
             return "JobSet"
         return "Job"
 
+    def _jobset_max_restarts(self, request_id: str) -> Optional[int]:
+        """The run's composed ``failurePolicy.maxRestarts`` from the cached
+        JobSet spec, or None for plain-Job runs (no controller restart
+        budget).  The ledger must not advertise restarts the controller
+        will never perform."""
+        informer = self._factory.informers.get("JobSet")
+        jobset = informer.get(request_id) if informer is not None else None
+        if jobset is None:
+            return None
+        policy = (jobset.raw.get("spec") or {}).get("failurePolicy") or {}
+        try:
+            return int(policy["maxRestarts"])
+        except (KeyError, TypeError, ValueError):
+            return None
+
     # -- hot loop (reference onEvent, services/supervisor.go:137-258) --------
 
     def _on_event(self, event_type: str, event: EventObj) -> None:
@@ -326,14 +342,39 @@ class Supervisor:
         result.hlo_trace_ref = extract_hlo_trace_ref(text) or result.hlo_trace_ref
         return result
 
+    def _is_duplicate_incident(
+        self,
+        result: RunStatusAnalysisResult,
+        observed: CheckpointedRequest,
+        key: tuple,
+        first_restart_count: int,
+    ) -> bool:
+        """Is this preemption event a duplicate of an already-counted
+        incident?  Three wall-clock-free signals, any of which suffices:
+
+        (a) generation fence: the event's pod belongs to a child-Job
+            generation whose preemption is already recorded in the ledger —
+            the same incident no matter WHICH replica recorded it or what
+            stage the row has since moved to;
+        (b) this process's own monotonic record (same-process fan-out when
+            no generation uid was resolvable);
+        (c) the row's restart_count grew since this decision first read it —
+            a concurrent writer (another replica) counted the incident
+            between our read and our CAS."""
+        if result.generation_uid and observed.preempted_generation == result.generation_uid:
+            return True
+        if observed.lifecycle_stage == LifecycleStage.PREEMPTED and self._is_same_preemption(key):
+            return True
+        return observed.restart_count > first_restart_count
+
     async def _supervise_action_locked(
         self, result: RunStatusAnalysisResult, key: tuple
     ) -> RunStatusAnalysisResult:
         result = self._reenrich(result)
-        checkpoint = await asyncio.to_thread(
+        observed = await asyncio.to_thread(
             self._store.read_checkpoint, result.algorithm_name, result.request_id
         )
-        if checkpoint is None:
+        if observed is None:
             # missing metadata: delete the Job anyway (background propagation)
             # and raise — the actor re-delivers with backoff (reference
             # :265-273)
@@ -342,88 +383,152 @@ class Supervisor:
                 f"no checkpoint for run {result.algorithm_name}/{result.request_id}; "
                 "job deleted, no metadata saved"
             )
-        if checkpoint.is_finished():
-            # protects cancelled/finished runs from late events (reference
-            # :275-279)
-            self._log.v(1).info(
-                "run already finished; skipping",
-                request_id=result.request_id,
-                stage=checkpoint.lifecycle_stage,
-            )
-            # the run is terminal: drop its dedup state (the refcounted lock
-            # entry evicts itself when the last straggler leaves)
-            self._preempt_seen.pop(key, None)
-            return result
+        first_restart_count = observed.restart_count
 
-        updated = checkpoint.deep_copy()  # mutation discipline (reference :281)
-        stage = DECISION_STAGE[result.action]
-        if not LifecycleStage.can_transition(checkpoint.lifecycle_stage, stage):
-            # stage partial order (first-writer-wins generalization of the
-            # IsFinished guard, SURVEY §7.4): e.g. a stale queued decision
-            # must not regress RUNNING to a pre-run stage
-            self._log.v(1).info(
-                "transition refused by stage partial order",
-                request_id=result.request_id,
-                current=checkpoint.lifecycle_stage,
-                requested=stage,
-            )
-            return result
-
-        if result.action in DELETES_JOB:
-            await self._delete_run_object(result)
-            updated.lifecycle_stage = stage
-            updated.algorithm_failure_cause = result.run_status_message
-            updated.algorithm_failure_details = result.run_status_trace
-        elif result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
-            # TPU policy axis: no delete — record preemption and let the
-            # JobSet restart policy / launcher resume from the tensor
-            # checkpoint (SURVEY §7.4).
-            if checkpoint.lifecycle_stage == LifecycleStage.PREEMPTED and self._is_same_preemption(key):
-                # one preemption incident fans out to N hosts' events within
-                # seconds; counting each would inflate restart_count N-fold
-                # (found by the chaos storm test).  Outside the dedup window
-                # it IS a new incident — the replacement pod was reclaimed
-                # before the workload ever heartbeated RUNNING — and counts.
+        # Commit via compare-and-set on the observed row state (CQL LWT in
+        # production): two supervisor replicas observing one storm cannot
+        # double-apply a transition — the loser's CAS fails, it re-reads, and
+        # the guards re-decide against the fresh row.
+        for _attempt in range(4):
+            if observed.is_finished():
+                # protects cancelled/finished runs from late events
+                # (reference :275-279); also the exactly-once terminal seam:
+                # the replica that lost the terminal CAS lands here
                 self._log.v(1).info(
-                    "duplicate preemption event; already PREEMPTED",
+                    "run already finished; skipping",
                     request_id=result.request_id,
+                    stage=observed.lifecycle_stage,
+                )
+                self._preempt_seen.pop(key, None)
+                return result
+
+            if result.action == DecisionAction.TO_PREEMPT_RESTARTABLE and not (
+                self._is_duplicate_incident(result, observed, key, first_restart_count)
+            ):
+                # a NEW preemption incident against a spent JobSet restart
+                # budget cannot restart — the controller fails the JobSet at
+                # maxRestarts, so recording another PREEMPTED would advertise
+                # a restart that will never happen; escalate to the
+                # reference's retry-exhausted terminal stage instead.
+                # Same-incident duplicates are exempt: the Nth host's event
+                # for restart N must not escalate.
+                budget = self._jobset_max_restarts(result.request_id)
+                if budget is not None and observed.restart_count >= budget:
+                    self._log.info(
+                        "restart budget exhausted; escalating preemption to terminal",
+                        request_id=result.request_id,
+                        restart_count=observed.restart_count,
+                        max_restarts=budget,
+                    )
+                    result.action = DecisionAction.TO_FAIL_DEADLINE_EXCEEDED
+                    result.run_status_message = MSG_DEADLINE_EXCEEDED
+                    result.run_status_trace = (
+                        f"{result.run_status_trace}\n"
+                        f"restart budget exhausted: {observed.restart_count} restarts "
+                        f"recorded >= JobSet failurePolicy.maxRestarts={budget}; the "
+                        "controller will not restart this run again"
+                    ).strip()
+
+            stage = DECISION_STAGE[result.action]
+            if not LifecycleStage.can_transition(observed.lifecycle_stage, stage):
+                # stage partial order (first-writer-wins generalization of
+                # the IsFinished guard, SURVEY §7.4): e.g. a stale queued
+                # decision must not regress RUNNING to a pre-run stage
+                self._log.v(1).info(
+                    "transition refused by stage partial order",
+                    request_id=result.request_id,
+                    current=observed.lifecycle_stage,
+                    requested=stage,
                 )
                 return result
-            updated.lifecycle_stage = stage
-            updated.algorithm_failure_cause = result.run_status_message
-            updated.algorithm_failure_details = result.run_status_trace
-            updated.restart_count += 1
-        else:  # ToRunning
-            updated.lifecycle_stage = stage
-        if result.hlo_trace_ref:
-            updated.hlo_trace_ref = result.hlo_trace_ref
-        updated.touch()
-        await asyncio.to_thread(self._store.upsert_checkpoint, updated)
-        if updated.is_finished():
-            # run just went terminal: drop its preemption-dedup record too,
-            # or every preempted-then-terminated run would leak one entry
-            # for the supervisor's lifetime
-            self._preempt_seen.pop(key, None)
-        if result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
-            # record the COUNTED preemption only after the commit landed —
-            # a failed upsert is re-delivered by the actor and must not be
-            # suppressed as its own duplicate
-            self._record_preemption(key)
-        self.decisions_executed += 1
-        if result.detected_at:
-            latency = time.perf_counter() - result.detected_at
-            self.commit_latencies.append(latency)
-            self._metrics.timing("detect_to_commit_seconds", latency, tags={"action": result.action})
-        # durable export of the north-star percentile (SURVEY §6: p50 <5s):
-        # gauges every 16th decision so the number lives in the metrics plane,
-        # not only in this process's deque.  Outside the detected_at gate —
-        # watchdog/resync decisions without a detect timestamp must not
-        # swallow export slots.
-        if self.decisions_executed % 16 == 0 and self.commit_latencies:
-            summary = self.latency_summary()
-            self._metrics.gauge("detect_to_commit_p50_seconds", summary["p50"])
-            self._metrics.gauge("detect_to_commit_p95_seconds", summary["p95"])
-        return result
+
+            fields: Dict[str, object] = {
+                "lifecycle_stage": stage,
+                "last_modified": datetime.now(timezone.utc),
+            }
+            expected: Dict[str, object] = {"lifecycle_stage": observed.lifecycle_stage}
+            if result.action in DELETES_JOB:
+                # delete BEFORE the ledger write (reference order :289→:301);
+                # idempotent across CAS retries (NotFound passes)
+                await self._delete_run_object(result)
+                fields["algorithm_failure_cause"] = result.run_status_message
+                fields["algorithm_failure_details"] = result.run_status_trace
+            elif result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
+                # TPU policy axis: no delete — record preemption and let the
+                # JobSet restart policy / launcher resume from the tensor
+                # checkpoint (SURVEY §7.4).
+                if self._is_duplicate_incident(result, observed, key, first_restart_count):
+                    # one incident fans out to N hosts' events (and to every
+                    # replica); counting each would inflate restart_count
+                    self._log.v(1).info(
+                        "duplicate preemption event; incident already counted",
+                        request_id=result.request_id,
+                    )
+                    return result
+                fields["algorithm_failure_cause"] = result.run_status_message
+                fields["algorithm_failure_details"] = result.run_status_trace
+                fields["restart_count"] = observed.restart_count + 1
+                if result.generation_uid:
+                    fields["preempted_generation"] = result.generation_uid
+                expected["restart_count"] = observed.restart_count
+            # else ToRunning: stage only
+            if result.hlo_trace_ref:
+                fields["hlo_trace_ref"] = result.hlo_trace_ref
+
+            committed = await asyncio.to_thread(
+                self._store.compare_and_set,
+                result.algorithm_name,
+                result.request_id,
+                expected,
+                fields,
+            )
+            if committed:
+                if LifecycleStage.is_terminal(stage):
+                    # run just went terminal: drop its preemption-dedup
+                    # record too, or every preempted-then-terminated run
+                    # would leak one entry for the supervisor's lifetime
+                    self._preempt_seen.pop(key, None)
+                if result.action == DecisionAction.TO_PREEMPT_RESTARTABLE:
+                    # record the COUNTED preemption only after the commit
+                    # landed — a failed commit is re-evaluated and must not
+                    # be suppressed as its own duplicate
+                    self._record_preemption(key)
+                self.decisions_executed += 1
+                if result.detected_at:
+                    latency = time.perf_counter() - result.detected_at
+                    self.commit_latencies.append(latency)
+                    self._metrics.timing(
+                        "detect_to_commit_seconds", latency, tags={"action": result.action}
+                    )
+                # durable export of the north-star percentile (SURVEY §6:
+                # p50 <5s): gauges every 16th decision so the number lives in
+                # the metrics plane, not only in this process's deque.
+                # Outside the detected_at gate — watchdog/resync decisions
+                # without a detect timestamp must not swallow export slots.
+                if self.decisions_executed % 16 == 0 and self.commit_latencies:
+                    summary = self.latency_summary()
+                    self._metrics.gauge("detect_to_commit_p50_seconds", summary["p50"])
+                    self._metrics.gauge("detect_to_commit_p95_seconds", summary["p95"])
+                return result
+
+            self._log.v(1).info(
+                "ledger CAS conflict; re-reading",
+                request_id=result.request_id,
+                expected_stage=expected["lifecycle_stage"],
+            )
+            self._metrics.count("ledger_cas_conflicts", tags={"action": result.action})
+            observed = await asyncio.to_thread(
+                self._store.read_checkpoint, result.algorithm_name, result.request_id
+            )
+            if observed is None:
+                raise LookupError(
+                    f"checkpoint for {result.algorithm_name}/{result.request_id} "
+                    "disappeared during CAS retry"
+                )
+        raise RuntimeError(
+            f"ledger CAS conflict persisted after 4 attempts for "
+            f"{result.algorithm_name}/{result.request_id}"
+        )  # actor re-delivers with backoff
 
     def latency_summary(self) -> Dict[str, float]:
         """Percentiles of the detect→commit window over the rolling deque."""

@@ -112,6 +112,12 @@ class RunStatusAnalysisResult:
     #: monotonic timestamp when the triggering event entered classification;
     #: drives the fault-detect -> checkpoint-commit latency metric
     detected_at: float = 0.0
+    #: uid of the pod's owning (child-)Job at classification time — the pod
+    #: GENERATION.  JobSet Recreate / Job re-creation mint a fresh uid per
+    #: restart, so this fences one preemption incident's multi-host fan-out
+    #: across supervisor replicas without any wall clock (ledger column
+    #: preempted_generation).  Empty when the owner was not cached.
+    generation_uid: str = ""
 
 
 # -- TPU failure signatures ----------------------------------------------------
@@ -365,10 +371,20 @@ def _classify_event(
             )
         if event.reason in ("TPUPreempted", "Preempted", "Evicted"):
             text = f"{event.message}\n{_pod_termination_text(pod)}".strip()
-            return _result(
+            res = _result(
                 DecisionAction.TO_PREEMPT_RESTARTABLE,
                 algorithm, request_id, MSG_PREEMPTED, text, uid, kind, detected_at, pod.meta.name,
             )
+            # incident identity: the owning (child-)Job's uid — every JobSet
+            # restart / Job re-creation mints a new one
+            owning_job = (
+                get_cached_object(pod.job_name(), obj_ns, informers.get("Job"))
+                if pod.job_name()
+                else None
+            )
+            if owning_job is not None:
+                res.generation_uid = owning_job.meta.uid
+            return res
         return None  # logged no-op upstream (reference :254-257)
 
     return None
