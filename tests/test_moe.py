@@ -151,12 +151,76 @@ class TestMoeFfn:
         per_expert = np.abs(gw).reshape(cfg.n_experts, -1).sum(axis=1)
         assert (per_expert == 0).any(), per_expert
 
-    def test_gmm_dispatch_refused_on_ep_mesh(self):
+    def test_gmm_ep_matches_single_chip_gmm_fwd_and_grads(self):
+        """The shard_map expert-parallel gmm path (VERDICT r3 #2) must
+        reproduce the single-chip dropless gmm exactly — same routing, same
+        tile layout per local expert, combine via psum — forward AND grads
+        (f32, tight tolerances)."""
         import dataclasses
 
-        cfg = dataclasses.replace(MoeConfig.tiny(), dispatch="gmm")
+        from tpu_nexus.models.moe import _moe_ffn_gmm_ep
+
+        cfg = dataclasses.replace(MoeConfig.tiny(), dtype=jnp.float32, dispatch="gmm")
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        layer = _layer0(params)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, cfg.hidden), jnp.float32)
         mesh = build_mesh(MeshSpec(fsdp=2, ep=2, tp=2))
-        with pytest.raises(ValueError, match="ep-sharded"):
+
+        def f_single(x, layer):
+            out, aux = moe_ffn(x, layer, cfg)
+            return jnp.sum(out**2), (out, aux)
+
+        def f_ep(x, layer):
+            out, aux = _moe_ffn_gmm_ep(x, layer, cfg, mesh)
+            return jnp.sum(out**2), (out, aux)
+
+        (_, (out_1, aux_1)), g_1 = jax.value_and_grad(f_single, (0, 1), has_aux=True)(x, layer)
+        with mesh:
+            (_, (out_2, aux_2)), g_2 = jax.jit(
+                jax.value_and_grad(f_ep, (0, 1), has_aux=True)
+            )(x, layer)
+        assert float(aux_2["dropped_frac"]) == 0.0
+        np.testing.assert_allclose(np.asarray(out_1), np.asarray(out_2), rtol=1e-4, atol=1e-4)
+        for name in ("router", "w_gate", "w_up", "w_down"):
+            np.testing.assert_allclose(
+                np.asarray(g_1[1][name]), np.asarray(g_2[1][name]),
+                rtol=5e-4, atol=5e-4, err_msg=name,
+            )
+        np.testing.assert_allclose(np.asarray(g_1[0]), np.asarray(g_2[0]), rtol=5e-4, atol=5e-4)
+
+    def test_gmm_ep_grad_parity_vs_scatter_in_train_step(self):
+        """Adapter-level: a full sharded train step with dispatch='gmm' on
+        an ep=2 mesh matches the scatter dispatch (ample capacity, nothing
+        dropped) — the mesh composition the dryrun ships."""
+        import dataclasses
+
+        base = dataclasses.replace(MoeConfig.tiny(), dtype=jnp.float32, param_dtype=jnp.float32)
+        cfg_s = dataclasses.replace(base, capacity_factor=float(base.n_experts))
+        cfg_g = dataclasses.replace(base, dispatch="gmm")
+        mesh = build_mesh(MeshSpec(fsdp=2, ep=2, tp=2))
+        tcfg = TrainConfig(warmup_steps=2, total_steps=50, learning_rate=1e-2)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (4, 32), 0, base.vocab_size)
+
+        metrics_by_dispatch = {}
+        for cfg_ in (cfg_s, cfg_g):
+            state = init_train_state(
+                jax.random.PRNGKey(0), cfg_, tcfg, mesh, LOGICAL_RULES_FSDP_TP
+            )
+            step_fn = make_train_step(cfg_, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+            with mesh:
+                state, metrics = step_fn(state, tokens)
+            metrics_by_dispatch[cfg_.dispatch] = {k: float(v) for k, v in metrics.items()}
+        m_s, m_g = metrics_by_dispatch["scatter"], metrics_by_dispatch["gmm"]
+        assert m_g["dropped_frac"] == 0.0
+        assert abs(m_s["ce_loss"] - m_g["ce_loss"]) < 1e-4, (m_s, m_g)
+        assert abs(m_s["load_balance"] - m_g["load_balance"]) < 1e-5
+
+    def test_gmm_ep_indivisible_experts_refused(self):
+        import dataclasses
+
+        cfg = dataclasses.replace(MoeConfig.tiny(), n_experts=6, dispatch="gmm")
+        mesh = build_mesh(MeshSpec(fsdp=2, ep=4))
+        with pytest.raises(ValueError, match="divisible by the ep extent"):
             adapter_for(cfg).make_loss(TrainConfig(), mesh)
 
     def test_unknown_dispatch_rejected(self):

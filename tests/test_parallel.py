@@ -211,3 +211,51 @@ class TestProcessContext:
     def test_defaults_single_process(self):
         ctx = process_context_from_env({})
         assert ctx.num_processes == 1 and ctx.is_coordinator
+
+
+class TestDataParallel:
+    """dp was the one rules-table axis no test had ever run >1 (VERDICT r3
+    weak #3): plain data parallelism — replicated params, batch split over
+    dp — must match the fsdp-only step and really replicate."""
+
+    def test_dp2_train_step_matches_fsdp_only(self):
+        import dataclasses
+
+        from tpu_nexus.models import LlamaConfig
+        from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+        cfg = dataclasses.replace(
+            LlamaConfig.tiny(), dtype=jnp.float32, param_dtype=jnp.float32
+        )
+        tcfg = TrainConfig(warmup_steps=2, total_steps=50, learning_rate=1e-2)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (8, 32), 0, cfg.vocab_size)
+
+        losses = {}
+        for name, spec in (
+            ("dp2", MeshSpec(dp=2, fsdp=2, tp=2)),
+            ("fsdp_only", MeshSpec(fsdp=4, tp=2)),
+        ):
+            mesh = build_mesh(spec)
+            state = init_train_state(
+                jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP
+            )
+            if name == "dp2":
+                # params REPLICATE over dp (the defining property of plain
+                # data parallelism) while still sharding over fsdp
+                wq_spec = state["params"]["layers"]["wq"].sharding.spec
+                flat = [
+                    a
+                    for entry in wq_spec
+                    for a in (entry if isinstance(entry, tuple) else (entry,))
+                ]
+                assert "dp" not in flat, wq_spec
+                assert "fsdp" in flat, wq_spec
+            step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+            with mesh:
+                for _ in range(3):
+                    state, metrics = step_fn(state, tokens)
+            losses[name] = float(metrics["loss"])
+        # same global batch, same init, different mesh factorization: the
+        # gradient all-reduce over dp must reproduce the fsdp-only step
+        assert np.isfinite(losses["dp2"])
+        assert abs(losses["dp2"] - losses["fsdp_only"]) < 1e-4, losses

@@ -78,8 +78,18 @@ class MoeConfig:
     #:              tile via scalar prefetch.  No capacity buffers, no
     #:              capacity-factor compute inflation, no dropped tokens;
     #:              dispatch AND combine are bijective gathers in both
-    #:              passes.  Single chip / replicated experts only.
+    #:              passes.  On an ep-sharded mesh the layer runs under
+    #:              shard_map (manual over ep only): each shard runs
+    #:              local-expert gmm on its slice of the sorted tokens and
+    #:              the combine is one psum — see _moe_ffn_gmm_ep.
     dispatch: str = "scatter"
+    #: ep-sharded gmm only: static per-shard row budget as a multiple of
+    #: the fair share (A/ep assignments).  XLA needs static shapes, so a
+    #: shard cannot size its buffer by the actual routed count; 2.0 means
+    #: routing may skew 2x over fair share before assignments drop (the
+    #: load-balance loss keeps real skew far below this; drops are
+    #: reported in the dropped_frac aux).
+    ep_row_factor: float = 2.0
 
     @staticmethod
     def mixtral_8x7b() -> "MoeConfig":
@@ -94,10 +104,10 @@ class MoeConfig:
             head_dim=128, intermediate=2048, n_experts=8, experts_per_token=2,
             tied_embeddings=True, param_dtype=jnp.bfloat16, max_seq_len=4096,
             remat_policy="attn_out",
-            # single-chip bench config: the dropless grouped-matmul kernel
-            # measured fastest on v5e (60.6k tok/s vs sort's 57.9k vs
-            # scatter's 52.6k, PERF.md r3) AND drops no tokens.  Multi-chip
-            # ep-sharded runs must use dispatch="scatter".
+            # the dropless grouped-matmul kernel measured fastest on v5e
+            # (60.6k tok/s vs sort's 57.9k vs scatter's 52.6k, PERF.md r3)
+            # AND drops no tokens; on ep-sharded meshes it runs under
+            # shard_map (_moe_ffn_gmm_ep) with local-expert gmm + psum
             dispatch="gmm",
         )
 
@@ -423,6 +433,173 @@ def _moe_ffn_gmm(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     return combined.reshape(b, s, e).astype(x.dtype), aux
 
 
+def _moe_ffn_gmm_ep(
+    x: jax.Array,
+    layer: Dict[str, jax.Array],
+    cfg: MoeConfig,
+    mesh: Any,
+    ep_axis: str = "ep",
+    batch_axes: Any = ("dp", "fsdp"),
+    seq_axis: str = "sp",
+    tp_axis: str = "tp",
+):
+    """The dropless grouped-matmul dispatch under EXPERT PARALLELISM.
+
+    FULL-manual shard_map (same mode as the ring-attention shard_map — XLA's
+    CPU backend miscompiles when a partial-manual region composes with a
+    full-manual one in the same program): batch shards over dp/fsdp, seq
+    over sp, expert weights over ep with their mlp dim over tp (the same
+    layout GSPMD gives the scatter path; the fsdp dim of the weights is
+    gathered at region entry, exactly like GSPMD's fsdp all-gather).  Each
+    (dp, fsdp, sp) coordinate routes ITS tokens — the ep group shares them,
+    so the (cheap, f32) router is replicated across ep and all shards
+    agree — then builds the tile-aligned gmm layout for its LOCAL experts
+    and combines with one psum over (ep, tp): ep sums the disjoint expert
+    contributions, tp the partial down-projection products.  No all-to-all
+    is needed because the token axes are orthogonal to ``ep``; per-shard
+    compute is proportional to the tokens routed to local experts, which
+    is the point of expert parallelism.
+
+    Static shapes force a per-shard row budget (``cfg.ep_row_factor`` x the
+    fair share); assignments past a shard's budget drop (reported via
+    dropped_frac) — with the load-balance loss active this is ~never hit.
+    Non-local and dropped assignments point their slots at a reserved
+    never-valid DUMPSTER tile whose rows are zero in the forward and whose
+    cotangent rows are zero in the backward (the combine-gather masks it),
+    so the single-chip gather/VJP helpers carry over unchanged."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_nexus.ops.grouped_matmul import BLOCK_M, gmm
+    from tpu_nexus.parallel.smap import shard_map_compat
+
+    n_ep = int(mesh.shape[ep_axis])
+    ne, k = cfg.n_experts, cfg.experts_per_token
+    if ne % n_ep:
+        raise ValueError(f"n_experts {ne} not divisible by ep={n_ep}")
+    el = ne // n_ep
+    ct = cfg.dtype
+    baxes = (batch_axes,) if isinstance(batch_axes, str) else tuple(batch_axes)
+    data_axes = baxes + (seq_axis,)
+
+    def body(x_in, fl):
+        # LOCAL token block of this (dp, fsdp, sp) coordinate
+        b, s, e = x_in.shape
+        t = b * s
+        a = t * k
+        bm = BLOCK_M if a >= 8192 else 128
+        # static per-shard tile budget: ep_row_factor x fair share, plus
+        # one tile per local expert (the every-expert-has-a-tile backward
+        # invariant), plus one never-allocated dumpster tile
+        fair = -(-a // n_ep)
+        n_alloc_tiles = -(-int(fair * cfg.ep_row_factor) // bm) + el
+        m_pad = (n_alloc_tiles + 1) * bm
+
+        flat = x_in.reshape(t, e)
+        logits, probs, gate, eidx = _router(flat, fl, cfg)
+        eidx_sorted, perm, counts, starts, local, inv_perm, by_token = _sort_by_expert(
+            eidx, t, k, ne
+        )
+        first = (jax.lax.axis_index(ep_axis) * el).astype(jnp.int32)
+        counts_l = jax.lax.dynamic_slice(counts, (first,), (el,))
+        starts_l = jax.lax.dynamic_slice(starts, (first,), (el,))
+
+        # tile allocation: each local expert wants ceil(count/bm) tiles
+        # (>= 1); the cumulative allocation is capped so that every LATER
+        # expert keeps at least one reserved tile — min of two sequences
+        # that both step by >= 1, so t_alloc >= 1 always holds
+        want = jnp.maximum((counts_l + bm - 1) // bm, 1).astype(jnp.int32)
+        cum_want = jnp.cumsum(want)
+        cap_cum = n_alloc_tiles - (el - 1 - jnp.arange(el, dtype=jnp.int32))
+        cum_alloc = jnp.minimum(cum_want, cap_cum)
+        t_alloc = jnp.diff(cum_alloc, prepend=0)
+        padded_counts = t_alloc * bm
+        padded_starts = ((cum_alloc - t_alloc) * bm).astype(jnp.int32)
+        kept_counts = jnp.minimum(counts_l, padded_counts)
+
+        # slot side (per-shard padded layout)
+        slot_ids = jnp.arange(m_pad, dtype=jnp.int32)
+        slot_e = jnp.clip(
+            jnp.searchsorted(padded_starts, slot_ids, side="right").astype(jnp.int32) - 1,
+            0,
+            el - 1,
+        )
+        slot_local = slot_ids - jnp.take(padded_starts, slot_e)
+        valid = slot_local < jnp.take(kept_counts, slot_e)
+        row_of_slot = jnp.minimum(jnp.take(starts_l, slot_e) + slot_local, a - 1)
+        tile_expert = slot_e.reshape(-1, bm)[:, 0]
+
+        # assignment side: local+kept assignments get their slot; everything
+        # else points at the dumpster (always-invalid last slot)
+        e_rel = eidx_sorted - first
+        is_local = (e_rel >= 0) & (e_rel < el)
+        e_rel_c = jnp.clip(e_rel, 0, el - 1)
+        kept_sorted = is_local & (local < jnp.take(kept_counts, e_rel_c))
+        slot_of_row = jnp.where(
+            kept_sorted, jnp.take(padded_starts, e_rel_c) + local, m_pad - 1
+        )
+
+        tok_sorted = perm % t
+        tok_of_slot = jnp.take(tok_sorted, row_of_slot)
+        slot_by_token = jnp.take(slot_of_row, by_token)
+        slot_km = jnp.take(slot_of_row, inv_perm)
+        a_of_slot = jnp.take(perm, row_of_slot)
+
+        x_padded = _dispatch_gather(
+            flat.astype(ct), tok_of_slot, valid, slot_by_token, t, k
+        )  # [m_pad, e]
+        g = gmm(x_padded, fl["w_gate"].astype(ct), tile_expert, bm)
+        u = gmm(x_padded, fl["w_up"].astype(ct), tile_expert, bm)
+        y = gmm(jax.nn.silu(g) * u, fl["w_down"].astype(ct), tile_expert, bm)
+
+        y_km = _combine_gather(y, slot_km, a_of_slot, valid)  # [A, e]
+        picked = y_km.reshape(k, t, e).transpose(1, 0, 2)
+        # non-local/dropped rows are already zero (dumpster), so gate alone;
+        # psum: ep sums disjoint expert contributions, tp the partial
+        # products of the f-sharded down projection
+        combined_local = jnp.sum(picked * gate[..., None].astype(ct), axis=1)
+        combined = jax.lax.psum(combined_local, (ep_axis, tp_axis))
+
+        keep_km = jnp.take(kept_sorted, inv_perm).astype(jnp.float32)
+        keep_tk = jax.lax.psum(keep_km.reshape(k, t).T, ep_axis)  # [t, K]
+        # aux losses over the GLOBAL token population: local means averaged
+        # over the equal-sized (dp, fsdp, sp) token blocks.  density and
+        # router_prob are pmean'd BEFORE their product (the load-balance
+        # loss is bilinear; a pmean of local products would be wrong).
+        onehot = jax.nn.one_hot(eidx, ne, dtype=jnp.float32)
+        density = jax.lax.pmean(jnp.mean(onehot.sum(axis=1), axis=0), data_axes)
+        router_prob = jax.lax.pmean(jnp.mean(probs, axis=0), data_axes)
+        load_balance = ne * jnp.sum(density / k * router_prob)
+        z = jax.lax.pmean(
+            jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1))), data_axes
+        )
+        dropped = 1.0 - jax.lax.pmean(jnp.mean(keep_tk), data_axes)
+        aux = {"load_balance": load_balance, "router_z": z, "dropped_frac": dropped}
+        return combined.reshape(b, s, e).astype(x_in.dtype), aux
+
+    ffn_layer = {key: layer[key] for key in ("router", "w_gate", "w_up", "w_down")}
+    in_specs = (
+        # tokens: batch over dp/fsdp, seq over sp, ep-replicated
+        P(baxes, seq_axis, None),
+        {
+            # fsdp dims gather at entry (= GSPMD's per-layer fsdp all-gather)
+            "router": P(None, None),
+            "w_gate": P(ep_axis, None, tp_axis),
+            "w_up": P(ep_axis, None, tp_axis),
+            "w_down": P(ep_axis, tp_axis, None),
+        },
+    )
+    out_specs = (
+        P(baxes, seq_axis, None),
+        {"load_balance": P(), "router_z": P(), "dropped_frac": P()},
+    )
+    # check_vma off: the gmm pallas kernels and the dispatch/combine custom
+    # VJPs carry no varying-manual-axes annotations
+    fn = shard_map_compat(
+        body, check_vma=False, mesh=mesh, in_specs=in_specs, out_specs=out_specs
+    )
+    return fn(x, ffn_layer)
+
+
 def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     """Sort-based dispatch: NO large scatter in the forward OR the backward.
 
@@ -474,16 +651,25 @@ def _moe_ffn_sorted(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
     return combined.reshape(b, s, e).astype(x.dtype), aux
 
 
-def moe_ffn(x: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
+def moe_ffn(
+    x: jax.Array,
+    layer: Dict[str, jax.Array],
+    cfg: MoeConfig,
+    mesh: Any = None,
+    ep_axis: str = "ep",
+):
     """The expert layer: [B, S, e] -> ([B, S, e], aux dict).
 
-    Static-capacity dispatch (``cfg.dispatch``: "scatter" | "sort");
-    overflow tokens contribute nothing (their residual connection carries
-    them through).
+    Dispatch per ``cfg.dispatch`` ("scatter" | "sort" | "gmm"); with a mesh
+    whose ``ep`` extent exceeds 1, "gmm" routes through the shard_map
+    expert-parallel path (:func:`_moe_ffn_gmm_ep`).  Capacity-bounded paths
+    drop overflow tokens (their residual connection carries them through).
     """
     if cfg.dispatch == "sort":
         return _moe_ffn_sorted(x, layer, cfg)
     if cfg.dispatch == "gmm":
+        if mesh is not None and mesh.shape.get(ep_axis, 1) > 1:
+            return _moe_ffn_gmm_ep(x, layer, cfg, mesh, ep_axis)
         return _moe_ffn_gmm(x, layer, cfg)
     if cfg.dispatch != "scatter":
         raise ValueError(
@@ -538,6 +724,7 @@ def moe_hidden(
     attn_fn: Optional[AttnFn] = None,
     attn_impl: str = "auto",
     return_kv: bool = False,
+    mesh: Any = None,
 ):
     """Final-norm hidden states [B, S, e] + accumulated router aux losses.
     ``return_kv=True`` → ``(hidden, aux, (k, v))`` with K/V stacked per
@@ -565,7 +752,7 @@ def moe_hidden(
         x, lb, rz = carry
         x, kv = attention_block(x, layer, cfg, cos, sin, attn_fn, collect_kv=True)
         h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-        ffn_out, aux = moe_ffn(h, layer, cfg)
+        ffn_out, aux = moe_ffn(h, layer, cfg, mesh=mesh)
         x = x + ffn_out
         carry = (x, lb + aux["load_balance"], rz + aux["router_z"])
         return carry, (aux["dropped_frac"], kv if return_kv else None)

@@ -190,16 +190,22 @@ class MoeAdapter(ModelAdapter):
                 f"pipeline parallelism requires MoeConfig.dispatch='scatter' "
                 f"(plainly stage-vmappable ops), got {cfg.dispatch!r}"
             )
-        if cfg.dispatch in ("sort", "gmm") and mesh is not None and mesh.shape.get("ep", 1) > 1:
-            # the sort path's per-expert dynamic slices and the gmm path's
-            # tile-padded row layout cannot partition over ep — GSPMD would
-            # silently replicate the expert buffers and defeat expert
-            # parallelism, so refuse loudly here (the one place that sees
-            # both the config and the mesh)
+        ep = mesh.shape.get("ep", 1) if mesh is not None else 1
+        if cfg.dispatch == "sort" and ep > 1:
+            # the sort path's per-expert dynamic slices cannot partition
+            # over ep — GSPMD would silently replicate the expert buffers
+            # and defeat expert parallelism, so refuse loudly here (the one
+            # place that sees both the config and the mesh).  gmm HAS an
+            # ep path (shard_map, _moe_ffn_gmm_ep) and is allowed.
             raise ValueError(
-                f"MoeConfig.dispatch={cfg.dispatch!r} is a single-chip/replicated-expert "
-                f"optimization and cannot run on an ep-sharded mesh (ep={mesh.shape['ep']}); "
-                "use dispatch='scatter' for expert parallelism"
+                f"MoeConfig.dispatch='sort' is a single-chip/replicated-expert "
+                f"optimization and cannot run on an ep-sharded mesh (ep={ep}); "
+                "use dispatch='gmm' (dropless) or 'scatter' for expert parallelism"
+            )
+        if cfg.dispatch == "gmm" and ep > 1 and cfg.n_experts % ep:
+            raise ValueError(
+                f"dispatch='gmm' over ep={ep} needs n_experts ({cfg.n_experts}) "
+                "divisible by the ep extent"
             )
         z_loss = getattr(train_cfg, "z_loss", 0.0)
         ce_chunk = getattr(train_cfg, "ce_chunk", 256)
@@ -214,7 +220,7 @@ class MoeAdapter(ModelAdapter):
                     batch_axes=batch_axes, attn_fn=attn_fn,
                 )
             else:
-                hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn)
+                hidden, aux = moe_hidden(params, tokens, cfg, attn_fn=attn_fn, mesh=mesh)
             head = moe_head(params, cfg)
             loss, metrics = chunked_next_token_loss(hidden, head, tokens, z_loss, chunk=ce_chunk)
             loss = (

@@ -35,12 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # jax >= 0.8
-    from jax import shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map
-
 from tpu_nexus.ops.attention import checkpoint_name as _checkpoint_name
+from tpu_nexus.parallel.smap import shard_map_compat
 
 _NEG_INF = -1e30
 
@@ -366,12 +362,11 @@ def ring_attention_sharded(
         max(1, k.shape[2] // n_tp),
         q.shape[3],
     )
-    kwargs = {"mesh": mesh, "in_specs": (spec, spec, spec), "out_specs": spec}
-    if will_use_pallas:
-        try:
-            fn = shard_map(body, check_vma=False, **kwargs)
-        except TypeError:  # pragma: no cover - jax < 0.8 spells it check_rep
-            fn = shard_map(body, check_rep=False, **kwargs)
-    else:
-        fn = shard_map(body, **kwargs)
+    fn = shard_map_compat(
+        body,
+        check_vma=not will_use_pallas,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
     return fn(q, k, v)

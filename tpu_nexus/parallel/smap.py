@@ -1,0 +1,27 @@
+"""shard_map across jax versions — one compat seam instead of per-call-site
+import/keyword shims (the spelling has already moved twice: the import from
+``jax.experimental.shard_map`` to ``jax.shard_map``, and the replication
+check from ``check_rep`` to ``check_vma``)."""
+
+from __future__ import annotations
+
+try:  # pragma: no cover - version-dependent import
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover - older jax spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def shard_map_compat(body, *, check_vma: bool = True, **kwargs):
+    """``jax.shard_map`` with the replication check optionally disabled.
+
+    ``check_vma=False`` is required around pallas kernels (their out_shapes
+    carry no varying-manual-axes annotations) and custom-VJP helpers with
+    no vma rules; leave it on elsewhere — it catches collective/sharding
+    bugs at trace time.
+    """
+    if check_vma:
+        return _shard_map(body, **kwargs)
+    try:
+        return _shard_map(body, check_vma=False, **kwargs)
+    except TypeError:  # pragma: no cover - jax < 0.8 spells it check_rep
+        return _shard_map(body, check_rep=False, **kwargs)
