@@ -29,7 +29,10 @@ Tuning knobs (all env, all optional — defaults are the tuned configuration):
   NEXUS_BENCH_DISPATCH  MoE dispatch override: scatter | sort | gmm
   NEXUS_BENCH_SEQ       sequence length (default 2048)
   NEXUS_BENCH_STEPS     timed steps (default 10)
-  NEXUS_BENCH_REMAT     remat policy: dots | attn_out | nothing
+  NEXUS_BENCH_REMAT     remat policy: dots | attn_out | qkv | nothing
+  NEXUS_BENCH_UNROLL    layer-scan unroll factor (default from config)
+  NEXUS_BENCH_OPTIMIZER adamw (default) | adamw-bf16 (bf16 moments, frees
+                        ~3.8 GB for remat/unroll headroom) | adafactor
   NEXUS_BENCH_PROFILE   directory: capture a jax.profiler trace of the timed
                         window into it (artifact for perf archaeology)
 """
@@ -142,6 +145,7 @@ def main() -> None:
         warmup_steps=10,
         total_steps=1000,
         ce_chunk=int(os.environ.get("NEXUS_BENCH_CE_CHUNK", "256")),
+        optimizer=os.environ.get("NEXUS_BENCH_OPTIMIZER", "adamw"),
     )
     mesh = build_mesh(MeshSpec(fsdp=-1))
     rules = LOGICAL_RULES_FSDP_TP

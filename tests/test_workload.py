@@ -57,6 +57,84 @@ class TestTrainStep:
         assert losses[-1] < losses[0] - 0.3, losses[:3] + losses[-3:]
         assert int(state["step"]) == 30
 
+    @pytest.mark.parametrize("optimizer", ["adamw-bf16", "adafactor"])
+    def test_compressed_optimizer_states_train(self, optimizer):
+        """TrainConfig.optimizer knob (VERDICT r3 #4): bf16-moment adamw and
+        adafactor both train the tiny model down, and the bf16 variant
+        really stores its moments in bf16 (the memory the knob exists to
+        free)."""
+        cfg = LlamaConfig.tiny()
+        tcfg = TrainConfig(
+            warmup_steps=2, total_steps=100, learning_rate=3e-3, optimizer=optimizer
+        )
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        if optimizer == "adamw-bf16":
+            moment_dtypes = {
+                leaf.dtype
+                for leaf in jax.tree.leaves(state["opt_state"])
+                if hasattr(leaf, "dtype") and leaf.ndim > 0
+            }
+            assert any(d == jnp.bfloat16 for d in moment_dtypes), moment_dtypes
+        step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        data = synthetic_tokens(8, 64, cfg.vocab_size, seed=0)
+        losses = []
+        with mesh:
+            for _ in range(30):
+                state, m = step_fn(state, jnp.asarray(next(data)))
+                losses.append(float(m["loss"]))
+        assert all(np.isfinite(losses)), losses
+        assert losses[-1] < losses[0] - 0.3, losses[:3] + losses[-3:]
+
+    def test_adamw_bf16_tracks_adamw_trajectory(self):
+        """The bf16-moment storage must not meaningfully bend the training
+        trajectory: after 10 steps on identical data the loss gap vs f32
+        adamw stays small."""
+        cfg = LlamaConfig.tiny()
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        final = {}
+        for optimizer in ("adamw", "adamw-bf16"):
+            tcfg = TrainConfig(
+                warmup_steps=2, total_steps=100, learning_rate=3e-3, optimizer=optimizer
+            )
+            state = init_train_state(
+                jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP
+            )
+            step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+            data = synthetic_tokens(8, 64, cfg.vocab_size, seed=0)
+            with mesh:
+                for _ in range(10):
+                    state, m = step_fn(state, jnp.asarray(next(data)))
+            final[optimizer] = float(m["loss"])
+        assert abs(final["adamw"] - final["adamw-bf16"]) < 0.05, final
+
+    def test_unknown_optimizer_rejected(self):
+        from tpu_nexus.workload.train import make_optimizer
+
+        with pytest.raises(ValueError, match="unknown TrainConfig.optimizer"):
+            make_optimizer(TrainConfig(optimizer="sgd"))
+
+    def test_qkv_remat_policy_matches_attn_out(self):
+        """The new 'qkv' remat policy is numerics-neutral (it only changes
+        WHAT the backward replays): one train step agrees with attn_out."""
+        final = {}
+        for policy in ("attn_out", "qkv"):
+            cfg = dataclasses.replace(
+                LlamaConfig.tiny(), remat=True, remat_policy=policy,
+                dtype=jnp.float32, param_dtype=jnp.float32,
+            )
+            tcfg = TrainConfig(warmup_steps=2, total_steps=100, learning_rate=3e-3)
+            mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+            state = init_train_state(
+                jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP
+            )
+            step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+            data = synthetic_tokens(8, 64, cfg.vocab_size, seed=0)
+            with mesh:
+                state, m = step_fn(state, jnp.asarray(next(data)))
+            final[policy] = float(m["loss"])
+        assert abs(final["attn_out"] - final["qkv"]) < 1e-5, final
+
     def test_params_actually_sharded(self):
         cfg = LlamaConfig.tiny()
         mesh = build_mesh(MeshSpec(fsdp=4, tp=2))

@@ -202,13 +202,19 @@ def attention_block(x, layer, cfg, cos, sin, attn_fn, *, collect_kv: bool = Fals
 
     ``collect_kv=True`` additionally returns the (post-RoPE) K/V — the
     prefill path of KV-cache decoding (models/generate.py)."""
+    from tpu_nexus.ops.attention import checkpoint_name as _ckpt
+
     ct = cfg.dtype
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
     q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
     k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
     v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
-    q = _rope(q, cos, sin)
-    k = _rope(k, cos, sin)
+    # post-RoPE q/k/v are the attention backward's inputs; naming them lets
+    # the "qkv" remat policy skip re-running norm+projections+RoPE in the
+    # replay (free under other policies — unsaved names cost nothing)
+    q = _ckpt(_rope(q, cos, sin), "q_rope")
+    k = _ckpt(_rope(k, cos, sin), "k_rope")
+    v = _ckpt(v, "v_rope")
     o = attn_fn(q, k, v, causal=True)
     x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
     if collect_kv:
@@ -235,6 +241,13 @@ def remat_policy(name: str):
     policies = {
         "dots": jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
         "attn_out": jax.checkpoint_policies.save_only_these_names("attn_out", "attn_lse"),
+        # "qkv": attn_out plus the post-RoPE q/k/v projections — the remat
+        # replay skips norm+projections+RoPE AND the attention op; ~3.7 GB
+        # at bench shapes, affordable once optimizer moments are bf16
+        # (TrainConfig.optimizer="adamw-bf16" frees ~3.8 GB)
+        "qkv": jax.checkpoint_policies.save_only_these_names(
+            "attn_out", "attn_lse", "q_rope", "k_rope", "v_rope"
+        ),
         "nothing": jax.checkpoint_policies.nothing_saveable,
     }
     return policies[name]

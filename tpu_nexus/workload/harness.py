@@ -118,6 +118,7 @@ class WorkloadConfig:
                 # ulysses (required for pp x sp meshes)
                 sp_attn=e.get("NEXUS_SP_ATTN", "ring"),
                 pp_microbatches=int(e.get("NEXUS_PP_MICROBATCHES", "0")),
+                optimizer=e.get("NEXUS_OPTIMIZER", "adamw"),
             ),
             mesh=mesh,
             batch_size=int(e.get("NEXUS_BATCH", "8")),

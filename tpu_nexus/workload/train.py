@@ -48,6 +48,58 @@ class TrainConfig:
     #: for GQA) or "ulysses" (GSPMD all-to-all re-sharding — composes with
     #: pipeline parallelism, needs heads divisible by sp·tp)
     sp_attn: str = "ring"
+    #: optimizer family / state precision:
+    #:  "adamw"      — f32 first+second moments (8 bytes/param);
+    #:  "adamw-bf16" — moments STORED bf16, math in f32 (4 bytes/param —
+    #:                 frees ~3.8 GB on the 0.95 B bench model, buying the
+    #:                 remat/unroll headroom PERF.md r3 priced out);
+    #:  "adafactor"  — factored second moment, no first moment
+    #:                 (sub-byte/param; the large-model memory floor).
+    optimizer: str = "adamw"
+
+
+def _scale_by_adam_bf16(b1: float, b2: float, eps: float = 1e-8):
+    """Adam whose moment STORAGE is bf16 while every update computes in f32.
+
+    bf16's 8 mantissa bits resolve the (1 - b) EMA increments at the
+    defaults (1-b1 = 0.1, 1-b2 = 0.05 — both well above 2^-8 relative), so
+    the quantization perturbs step DIRECTION negligibly while halving
+    optimizer-state HBM.  Bias correction matches optax.scale_by_adam.
+    """
+
+    def init_fn(params):
+        zeros_bf16 = lambda p: jnp.zeros_like(p, dtype=jnp.bfloat16)  # noqa: E731
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32),
+            mu=jax.tree.map(zeros_bf16, params),
+            nu=jax.tree.map(zeros_bf16, params),
+        )
+
+    def update_fn(updates, state, params=None):
+        del params
+        count = optax.safe_int32_increment(state.count)
+        c1 = 1.0 - b1 ** count.astype(jnp.float32)
+        c2 = 1.0 - b2 ** count.astype(jnp.float32)
+
+        def one(g, m, n):
+            # ONE fused chain per leaf, f32 intermediates cast back to bf16
+            # immediately: whole-tree f32 moment transients (2x params — the
+            # very memory the bf16 storage frees) must never be live at once
+            g32 = g.astype(jnp.float32)
+            m32 = b1 * m.astype(jnp.float32) + (1.0 - b1) * g32
+            n32 = b2 * n.astype(jnp.float32) + (1.0 - b2) * jnp.square(g32)
+            upd = (m32 / c1) / (jnp.sqrt(n32 / c2) + eps)
+            return upd, m32.astype(jnp.bfloat16), n32.astype(jnp.bfloat16)
+
+        triples = jax.tree.map(one, updates, state.mu, state.nu)
+        is_triple = lambda x: isinstance(x, tuple) and len(x) == 3  # noqa: E731
+        pick = lambda i: jax.tree.map(  # noqa: E731
+            lambda t: t[i], triples, is_leaf=is_triple
+        )
+        new_state = optax.ScaleByAdamState(count=count, mu=pick(1), nu=pick(2))
+        return pick(0), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
@@ -58,9 +110,28 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
         decay_steps=max(cfg.total_steps, cfg.warmup_steps + 1),
         end_value=cfg.learning_rate * 0.1,
     )
-    return optax.chain(
-        optax.clip_by_global_norm(cfg.grad_clip_norm),
-        optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
+    if cfg.optimizer == "adamw":
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm),
+            optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, weight_decay=cfg.weight_decay),
+        )
+    if cfg.optimizer == "adamw-bf16":
+        # same chain shape as optax.adamw: scale_by_adam -> decayed weights
+        # -> learning rate, moments stored bf16
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm),
+            _scale_by_adam_bf16(cfg.b1, cfg.b2),
+            optax.add_decayed_weights(cfg.weight_decay),
+            optax.scale_by_learning_rate(schedule),
+        )
+    if cfg.optimizer == "adafactor":
+        return optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip_norm),
+            optax.adafactor(learning_rate=schedule),
+        )
+    raise ValueError(
+        f"unknown TrainConfig.optimizer {cfg.optimizer!r}; "
+        "use 'adamw', 'adamw-bf16', or 'adafactor'"
     )
 
 
@@ -170,10 +241,16 @@ def state_shardings(init_fn, key, model, mesh, rules) -> Any:
     state_shape = jax.eval_shape(init_fn, key)
     replicated = NamedSharding(mesh, P())
     params_structure = jax.tree.structure(state_shape["params"])
+    param_shapes = [leaf.shape for leaf in jax.tree.leaves(state_shape["params"])]
 
     def is_param_tree(subtree) -> bool:
         try:
-            return jax.tree.structure(subtree) == params_structure
+            if jax.tree.structure(subtree) != params_structure:
+                return False
+            # structure alone is not enough: adafactor's factored moments
+            # mirror the param TREE but hold rank-1 row/col factors whose
+            # shapes the param shardings do not fit — those replicate
+            return [leaf.shape for leaf in jax.tree.leaves(subtree)] == param_shapes
         except Exception:  # unhashable/exotic nodes: not a param mirror
             return False
 
