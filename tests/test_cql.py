@@ -393,6 +393,7 @@ def _x509_material():
     import datetime
     import ipaddress
 
+    pytest.importorskip("cryptography")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import ec

