@@ -133,7 +133,9 @@ class _ApiServerStub:
 
 class _TimingStore:
     """Store wrapper stamping the wall-clock of each run's first terminal
-    upsert (for the transport-inclusive e2e number)."""
+    commit (for the transport-inclusive e2e number).  The r4 supervisor
+    commits transitions via compare_and_set, so that path must stamp too —
+    an upsert-only stamp silently empties the e2e metric."""
 
     def __init__(self, inner):
         self._inner = inner
@@ -142,10 +144,19 @@ class _TimingStore:
     def read_checkpoint(self, algorithm, request_id):
         return self._inner.read_checkpoint(algorithm, request_id)
 
+    def _stamp(self, request_id, stage):
+        if LifecycleStage.is_terminal(stage) and request_id not in self.terminal_at:
+            self.terminal_at[request_id] = time.monotonic()
+
     def upsert_checkpoint(self, cp):
         self._inner.upsert_checkpoint(cp)
-        if cp.is_finished() and cp.id not in self.terminal_at:
-            self.terminal_at[cp.id] = time.monotonic()
+        self._stamp(cp.id, cp.lifecycle_stage)
+
+    def compare_and_set(self, algorithm, request_id, expected, fields):
+        applied = self._inner.compare_and_set(algorithm, request_id, expected, fields)
+        if applied and "lifecycle_stage" in fields:
+            self._stamp(request_id, fields["lifecycle_stage"])
+        return applied
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
