@@ -45,14 +45,20 @@ class TestServe:
 
     def test_serves_trained_checkpoint(self, tmp_path):
         """Train with checkpointing, then serve from the saved weights —
-        the restore path goes through the same train-state template."""
+        params-only template-free restore, so serve never reconstructs the
+        training run's opt-state structure.  The training run deliberately
+        uses a NON-default optimizer (adamw-bf16: different opt-state tree
+        than default adamw) — the exact scenario ADVICE r3 flagged, where a
+        default-TrainConfig template would fail or silently mismatch."""
         from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+        from tpu_nexus.workload.train import TrainConfig
 
         train_store = _seeded_store()
         tcfg = WorkloadConfig(
             model=LlamaConfig.tiny(), mesh=MeshSpec(fsdp=-1), batch_size=4,
             seq_len=32, steps=4, heartbeat_every=2, checkpoint_every=2,
             checkpoint_dir=str(tmp_path),
+            train=TrainConfig(warmup_steps=2, total_steps=50, optimizer="adamw-bf16"),
         )
         run_workload(tcfg, store=train_store, ctx=CTX)
 

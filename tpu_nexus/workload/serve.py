@@ -12,8 +12,8 @@ Launcher contract: ``NEXUS_MODE=serve`` selects this loop in the workload
 container entrypoint; ``NEXUS_PROMPT_LEN`` / ``NEXUS_GEN_TOKENS`` /
 ``NEXUS_TEMPERATURE`` shape the decode; ``NEXUS_STEPS`` counts generate
 rounds; ``NEXUS_CHECKPOINT_DIR`` restores trained weights (the tensor
-checkpoint written by the training harness — restored through the same
-train-state template so serve always loads exactly what train saved).
+checkpoint written by the training harness — params-only, template-free,
+so serve never depends on the training run's optimizer/opt-state layout).
 """
 
 from __future__ import annotations
