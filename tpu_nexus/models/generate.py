@@ -193,23 +193,34 @@ def decode_step(
         )  # [B, max_len]
     cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
 
-    def body(x, xs):
-        layer, ck, cv = xs
+    def body(carry, xs):
+        # The stacked caches ride the CARRY, written in place with
+        # one-position dynamic updates — passing them as scan xs/ys instead
+        # re-materializes the ENTIRE [L, B, max_len, H, D] stack every
+        # decode step (measured: the stacked-ys copy dominated the decode
+        # step at long context, ~8x over the bandwidth floor)
+        x, ck_all, cv_all = carry
+        layer, li = xs
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
         k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
         v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
-        ck = jax.lax.dynamic_update_slice(ck, k, (0, pos, 0, 0))
-        cv = jax.lax.dynamic_update_slice(cv, v, (0, pos, 0, 0))
+        ck_all = jax.lax.dynamic_update_slice(ck_all, k[None], (li, 0, pos, 0, 0))
+        cv_all = jax.lax.dynamic_update_slice(cv_all, v[None], (li, 0, pos, 0, 0))
+        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
         o = cached_attention(q, ck, cv, pos + 1, valid=valid)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         x = _ffn_block(x, layer, cfg)
-        return x, (ck, cv)
+        return (x, ck_all, cv_all), None
 
-    x, (ck_all, cv_all) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+    n_layers = cache["k"].shape[0]
+    (x, ck_all, cv_all), _ = jax.lax.scan(
+        body,
+        (x, cache["k"], cache["v"]),
+        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))

@@ -89,4 +89,22 @@ def attention(
         return flash_attention(q, k, v, causal=causal, scale=scale)
     if flash_supported(q, k, v):
         return flash_attention(q, k, v, causal=causal, scale=scale)
+    # Tile-UNALIGNED causal self-attention: right-pad seq to the 128 tile
+    # and slice back, instead of silently falling to the O(S²) dense path —
+    # at long ragged prompts (e.g. a 30k-token prefill) dense materializes
+    # an S×S f32 score tensor that OOMs HBM outright.  Causality makes the
+    # padding sound: pad keys sit at positions > every real query, so no
+    # real row ever attends one; pad rows compute garbage nothing reads.
+    pad = (-q.shape[1]) % 128
+    if causal and pad and q.shape[1] == k.shape[1]:
+        b, s, hq, d = q.shape
+        padded = jax.ShapeDtypeStruct((b, s + pad, hq, d), q.dtype)
+        padded_kv = jax.ShapeDtypeStruct((b, s + pad, k.shape[2], d), k.dtype)
+        if flash_supported(padded, padded_kv, padded_kv):
+            widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+            out = flash_attention(
+                jnp.pad(q, widths), jnp.pad(k, widths), jnp.pad(v, widths),
+                causal=True, scale=scale,
+            )
+            return out[:, :s]
     return dense_attention(q, k, v, causal=causal, scale=scale)
