@@ -178,3 +178,68 @@ def test_compare_and_set_rejects_unknown_and_merge_only_columns(store):
         store.compare_and_set(cp.algorithm, cp.id, {"nope": 1}, {"tag": "x"})
     with pytest.raises(ValueError):
         store.compare_and_set(cp.algorithm, cp.id, {"tag": "x"}, {"per_chip_steps": {}})
+
+
+def test_compare_and_set_rejects_empty_fields(store):
+    """ADVICE r4: backends used to disagree on the empty-fields edge (CQL/
+    sqlite said True without touching the row; base/in-memory verified row
+    existence).  The contract is now uniform: empty fields is a caller bug."""
+    cp = make_cp()
+    store.upsert_checkpoint(cp)
+    with pytest.raises(ValueError):
+        store.compare_and_set(cp.algorithm, cp.id, {"lifecycle_stage": cp.lifecycle_stage}, {})
+    with pytest.raises(ValueError):
+        store.compare_and_set(cp.algorithm, cp.id, {}, {})
+
+
+def test_max_restarts_round_trip(store):
+    """The launch-time restart budget is nullable: None (plain-Job runs, or
+    pre-upgrade rows) must survive the round trip distinct from 0."""
+    budgeted = make_cp(id="budgeted", max_restarts=3)
+    unbudgeted = make_cp(id="unbudgeted")
+    zero = make_cp(id="zero", max_restarts=0)
+    for cp in (budgeted, unbudgeted, zero):
+        store.upsert_checkpoint(cp)
+    assert store.read_checkpoint(budgeted.algorithm, "budgeted").max_restarts == 3
+    assert store.read_checkpoint(budgeted.algorithm, "unbudgeted").max_restarts is None
+    assert store.read_checkpoint(budgeted.algorithm, "zero").max_restarts == 0
+
+
+def test_sqlite_migrates_pre_upgrade_ledger(tmp_path):
+    """ADVICE r4 (medium): CREATE TABLE IF NOT EXISTS keeps an existing
+    ledger.db's old column set while the upgraded store SELECTs/INSERTs the
+    full current set — every query used to error until a manual ALTER.  The
+    store now ALTERs missing extension columns in on open."""
+    import sqlite3
+
+    from tpu_nexus.checkpoint.store import _COLUMNS
+
+    path = str(tmp_path / "old-ledger.db")
+    old_columns = [
+        c for c in _COLUMNS if c not in ("preempted_generation", "max_restarts")
+    ]
+    conn = sqlite3.connect(path)
+    cols = ", ".join(
+        f"{c} INTEGER" if c == "restart_count" else f"{c} TEXT" for c in old_columns
+    )
+    conn.execute(f"CREATE TABLE checkpoints ({cols}, PRIMARY KEY (algorithm, id))")
+    conn.execute(
+        "INSERT INTO checkpoints (algorithm, id, lifecycle_stage, restart_count) "
+        "VALUES ('alg', 'old-row', 'RUNNING', 1)"
+    )
+    conn.commit()
+    conn.close()
+
+    store = SqliteCheckpointStore(path)
+    # reads of the pre-upgrade row work, with upgrade columns defaulted
+    cp = store.read_checkpoint("alg", "old-row")
+    assert cp.lifecycle_stage == LifecycleStage.RUNNING
+    assert cp.preempted_generation == "" and cp.max_restarts is None
+    # writes of the full current column set work too
+    cp = cp.deep_copy()
+    cp.max_restarts = 3
+    cp.preempted_generation = "gen-1"
+    store.upsert_checkpoint(cp)
+    got = store.read_checkpoint("alg", "old-row")
+    assert got.max_restarts == 3 and got.preempted_generation == "gen-1"
+    store.close()

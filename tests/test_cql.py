@@ -558,3 +558,21 @@ def test_astra_lazy_construction():
     from tpu_nexus.checkpoint.cql import AstraCqlStore
 
     AstraCqlStore(secure_connection_bundle_base64="not-even-base64!!")
+
+
+def test_migrate_schema_tolerates_existing_columns():
+    """migrate_schema ALTERs each extension column in; an "already exists"
+    CQL error means done (CQL has no ADD COLUMN IF NOT EXISTS), while
+    transport errors still propagate."""
+    server = FakeCqlServer()
+    server.start()
+    store = ScyllaCqlStore(hosts=["127.0.0.1"], port=server.port)
+    already = write_int(0x2200) + write_string("Invalid column preempted_generation because it conflicts with an existing column")
+    server.responses = [(OP_ERROR, already)]  # first ALTER refused, second VOID
+    store.migrate_schema()
+    alters = [q for q in server.queries if q.startswith("ALTER TABLE")]
+    assert alters == [
+        "ALTER TABLE nexus.checkpoints ADD preempted_generation text",
+        "ALTER TABLE nexus.checkpoints ADD max_restarts int",
+    ]
+    store.close()

@@ -248,19 +248,9 @@ async def test_child_pod_event_without_ledger_row_deletes_owning_jobset():
 
 
 def _recreate_children(fx, rid):
-    """What the JobSet Recreate policy does after a preemption: the child
-    Job and its pods come back under the SAME names with FRESH uids — the
-    new pod generation that makes the next preemption a distinct incident."""
-    jobs = fx.client._objects["Job"]
-    pods = fx.client._objects["Pod"]
-    for (ns, name), job in list(jobs.items()):
-        if (job["metadata"].get("labels") or {}).get(JOBSET_NAME_LABEL) == rid:
-            fresh = {**job, "metadata": {**job["metadata"], "uid": str(uuid.uuid4())}}
-            fx.client.inject("ADDED", "Job", fresh)
-    for (ns, name), pod in list(pods.items()):
-        if (pod["metadata"].get("labels") or {}).get(JOBSET_NAME_LABEL) == rid:
-            fresh = {**pod, "metadata": {**pod["metadata"], "uid": str(uuid.uuid4())}}
-            fx.client.inject("ADDED", "Pod", fresh)
+    """The JobSet Recreate policy after a preemption: same names, fresh uids
+    (a new generation) — now played by the fake controller itself."""
+    fx.client.recreate_jobset_children(NS, rid)
 
 
 async def test_restart_budget_exhaustion_goes_terminal():

@@ -62,3 +62,9 @@ class SupervisorConfig:
     #: fingerprint after this window (0 disables)
     heartbeat_stale_after: timedelta = timedelta(0)
     watchdog_interval: timedelta = timedelta(seconds=30)
+    #: preempted-run liveness: escalate a PREEMPTED row to terminal when the
+    #: JobSet controller produces no replacement generation within this
+    #: deadline (0 disables; must comfortably exceed node-pool reprovision
+    #: time — the 5-minute capacity storm of BASELINE config #5 needs
+    #: a deadline well past 5m)
+    preempted_restart_deadline: timedelta = timedelta(minutes=15)

@@ -156,6 +156,7 @@ class ApplicationServices:
             failure_lane_workers=config.failure_lane_workers,
             heartbeat_stale_after=config.heartbeat_stale_after,
             watchdog_interval=config.watchdog_interval,
+            preempted_restart_deadline=config.preempted_restart_deadline,
         )
         try:
             self._supervisor.init(processing)

@@ -36,6 +36,9 @@ from tpu_nexus.checkpoint.store import (
     CheckpointStore,
     CheckpointStoreError,
     _COLUMNS,
+    _INT_COLUMNS,
+    _MIGRATED_COLUMNS,
+    _validate_cas_args,
     _validate_field_names,
 )
 from tpu_nexus.core.telemetry import VLogger, get_logger
@@ -369,11 +372,53 @@ class CqlCheckpointStore(CheckpointStore):
             return self._connection().query(cql)
 
     def apply_schema(self, schema_cql: str) -> None:
-        """Apply keyspace/table DDL (idempotent; split on ';')."""
-        for statement in schema_cql.split(";"):
+        """Apply keyspace/table DDL (idempotent).
+
+        Full-line ``--`` comments are stripped BEFORE splitting on ';' — a
+        semicolon inside a comment must not truncate the next real statement
+        or get comment text executed as CQL (the old split-then-skip order
+        did both: schema.cql's own header comment orphaned the CREATE TABLE
+        behind a garbage prefix).  Inline trailing comments are left alone —
+        they are valid CQL and carry no semicolons."""
+        sql = "\n".join(
+            line for line in schema_cql.splitlines()
+            if not line.lstrip().startswith("--")
+        )
+        for statement in sql.split(";"):
             statement = statement.strip()
-            if statement and not statement.startswith("--"):
+            if statement:
                 self._execute(statement)
+
+    def migrate_schema(self) -> None:
+        """Bring an EXISTING nexus.checkpoints table up to the current column
+        set.  ``create table if not exists`` keeps a pre-upgrade table's old
+        columns while this client SELECTs/INSERTs the full current set — so
+        an upgraded store against an old table errors on every query until
+        the table is altered (ADVICE r4).  CQL has no ``ADD COLUMN IF NOT
+        EXISTS``, so each ALTER is attempted and an "already exists" /
+        "Invalid column" error is treated as done; transport errors still
+        propagate.  Run once per upgrade (Helm pre-install hook or by hand —
+        docs/RUNBOOK.md "Upgrading")."""
+        for col in _MIGRATED_COLUMNS:
+            cql_type = "int" if col in _INT_COLUMNS else "text"
+            try:
+                self._execute(f"ALTER TABLE {self.table} ADD {col} {cql_type}")
+            except CqlConnectionError:
+                raise
+            except CqlError as exc:
+                # only the already-exists shape means "done" (Scylla:
+                # "Invalid column name ... conflicts with an existing
+                # column"; Cassandra: "... already exists").  Anything else
+                # (missing keyspace/table, no ALTER permission) is a REAL
+                # failure — swallowing it would report a successful upgrade
+                # and leave every subsequent query erroring on the missing
+                # columns, the exact outage this migration prevents.
+                text = str(exc).lower()
+                if "exist" not in text and "conflict" not in text:
+                    raise
+                self._log.v(1).info(
+                    "migration column already present", column=col, detail=str(exc)
+                )
 
     @staticmethod
     def _row_to_checkpoint(row: Dict[str, Any]) -> CheckpointedRequest:
@@ -385,7 +430,9 @@ class CqlCheckpointStore(CheckpointStore):
             if data.get(key) is None:
                 data[key] = 0
         for key, value in list(data.items()):
-            if value is None and key not in ("received_at", "sent_at", "last_modified", "per_chip_steps"):
+            if value is None and key not in (
+                "received_at", "sent_at", "last_modified", "per_chip_steps", "max_restarts",
+            ):
                 data[key] = ""
         return CheckpointedRequest.from_row(data)
 
@@ -424,6 +471,7 @@ class CqlCheckpointStore(CheckpointStore):
             "tensor_checkpoint_uri": cp.tensor_checkpoint_uri,
             "restart_count": cp.restart_count,
             "preempted_generation": cp.preempted_generation,
+            "max_restarts": cp.max_restarts,
         }
         cols = ", ".join(values)
         literals = ", ".join(to_literal(v) for v in values.values())
@@ -467,10 +515,7 @@ class CqlCheckpointStore(CheckpointStore):
         with a result set whose first column is the ``[applied]`` boolean
         (plus the current values when not applied) — the real
         multi-replica-safe primitive the in-memory/sqlite stores emulate."""
-        _validate_field_names(fields)
-        _validate_field_names(expected)
-        if not fields:
-            return True
+        _validate_cas_args(expected, fields)
         sets = ", ".join(f"{k} = {to_literal(v)}" for k, v in fields.items())
         # empty `expected` still rides the LWT as IF EXISTS: a plain UPDATE
         # would blind-UPSERT a phantom row on a missing id and "succeed",

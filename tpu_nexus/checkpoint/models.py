@@ -141,6 +141,13 @@ class CheckpointedRequest:
     #: SUPERVISOR REPLICAS without trusting any wall clock: an event whose
     #: pod belongs to an already-recorded generation is the same incident
     preempted_generation: str = ""
+    #: the run's JobSet ``failurePolicy.maxRestarts``, persisted at LAUNCH
+    #: time — the budget is an immutable spec field, so the supervisor's
+    #: budget escalation must not depend on a live informer cache (a
+    #: supervisor restarted mid-incident, or a JobSet already deleted,
+    #: would otherwise let preemptions count forever).  None for plain-Job
+    #: runs (no controller restart budget) and pre-upgrade rows.
+    max_restarts: Optional[int] = None
 
     def is_finished(self) -> bool:
         """True for terminal stages; guards late events on finished runs
@@ -177,8 +184,26 @@ class CheckpointedRequest:
             data["per_chip_steps"] = json.loads(steps) if steps else {}
         elif steps is None:
             data["per_chip_steps"] = {}
+        budget = data.get("max_restarts")
+        # "" (CQL null → text normalization) and None both mean "no budget";
+        # sqlite hands back ints, CQL ints, JSON round-trips may hand strings
+        data["max_restarts"] = int(budget) if budget not in (None, "") else None
+        count = data.get("restart_count")
+        # same string-tolerance for the counter: a TEXT-affinity sqlite
+        # column (hand-built ledgers) or JSON round-trip must not leave a
+        # str here — restart_count rides CAS `expected` comparisons
+        data["restart_count"] = int(count) if count not in (None, "") else 0
         known = {f.name for f in dataclasses.fields(cls)}
-        return cls(**{k: v for k, v in data.items() if k in known})
+        data = {k: v for k, v in data.items() if k in known}
+        # SQL NULL (pre-upgrade rows read through a migrated schema) means
+        # "column never written": take the field default, except for the
+        # genuinely Optional fields where None IS the value
+        for key in list(data):
+            if data[key] is None and key not in (
+                "received_at", "sent_at", "last_modified", "max_restarts",
+            ):
+                del data[key]
+        return cls(**data)
 
     def touch(self) -> None:
         self.last_modified = _utcnow()

@@ -57,7 +57,16 @@ _COLUMNS = [
     "tensor_checkpoint_uri",
     "restart_count",
     "preempted_generation",
+    "max_restarts",
 ]
+
+#: extension columns added after the first shipped schema, in the order they
+#: shipped — upgraded stores migrate existing tables by ALTERing these in
+#: (sqlite does it automatically on open; CQL via ``migrate_schema``, see
+#: cql.CqlCheckpointStore.migrate_schema and docs/RUNBOOK.md)
+_MIGRATED_COLUMNS = ["preempted_generation", "max_restarts"]
+
+_INT_COLUMNS = {"restart_count", "max_restarts"}
 
 
 class CheckpointStoreError(Exception):
@@ -88,6 +97,18 @@ def _validate_field_names(fields: Dict[str, object]) -> None:
     for key in fields:
         if key not in _COLUMNS:
             raise ValueError(f"unknown column {key!r}")
+
+
+def _validate_cas_args(expected: Dict[str, object], fields: Dict[str, object]) -> None:
+    """Shared compare_and_set guard.  Empty ``fields`` is rejected in EVERY
+    backend: the backends used to disagree on it (CQL/sqlite said True
+    without touching the row, in-memory verified existence), so a caller
+    probing existence via an empty CAS got backend-dependent answers — the
+    contract is now uniform and explicit (use read_checkpoint to probe)."""
+    _validate_field_names(fields)
+    _validate_field_names(expected)  # per_chip_steps is merge-only: not comparable
+    if not fields:
+        raise ValueError("compare_and_set requires at least one field to write")
 
 
 class CheckpointStore:
@@ -153,8 +174,7 @@ class CheckpointStore:
         atomic primitive (CQL lightweight transaction ``UPDATE … IF``,
         sqlite conditioned UPDATE); this default check-then-write is only
         safe single-writer."""
-        _validate_field_names(fields)
-        _validate_field_names(expected)  # per_chip_steps is merge-only: not comparable
+        _validate_cas_args(expected, fields)
         cp = self.read_checkpoint(algorithm, id)
         if cp is None:
             return False
@@ -218,8 +238,7 @@ class InMemoryCheckpointStore(CheckpointStore):
         expected: Dict[str, object],
         fields: Dict[str, object],
     ) -> bool:
-        _validate_field_names(fields)
-        _validate_field_names(expected)
+        _validate_cas_args(expected, fields)
         with self._lock:
             cp = self._rows.get((algorithm, id))
             if cp is None:
@@ -253,10 +272,22 @@ class SqliteCheckpointStore(CheckpointStore):
             # is Scylla/CQL; sqlite is the single-node/CI stand-in)
             conn.execute("PRAGMA journal_mode=WAL")
             conn.execute("PRAGMA synchronous=NORMAL")
-            cols = ", ".join(f"{c} TEXT" if c != "restart_count" else f"{c} INTEGER" for c in _COLUMNS)
+            cols = ", ".join(
+                f"{c} INTEGER" if c in _INT_COLUMNS else f"{c} TEXT" for c in _COLUMNS
+            )
             conn.execute(
                 f"CREATE TABLE IF NOT EXISTS checkpoints ({cols}, PRIMARY KEY (algorithm, id))"
             )
+            # migrate a pre-upgrade ledger.db in place: CREATE IF NOT EXISTS
+            # keeps an existing table's old column set, while every SELECT /
+            # INSERT here names the full current set — without this, all
+            # reads and writes error out after an upgrade until the table is
+            # manually altered (ADVICE r4)
+            have = {row[1] for row in conn.execute("PRAGMA table_info(checkpoints)")}
+            for col in _MIGRATED_COLUMNS:
+                if col not in have:
+                    col_type = "INTEGER" if col in _INT_COLUMNS else "TEXT"
+                    conn.execute(f"ALTER TABLE checkpoints ADD COLUMN {col} {col_type}")
             for idx_col in ("tag", "received_by_host", "lifecycle_stage"):
                 conn.execute(
                     f"CREATE INDEX IF NOT EXISTS idx_{idx_col} ON checkpoints ({idx_col})"
@@ -352,10 +383,7 @@ class SqliteCheckpointStore(CheckpointStore):
     ) -> bool:
         """One conditioned UPDATE: sqlite serializes writers, so rowcount
         tells atomically whether every expected column still matched."""
-        _validate_field_names(fields)
-        _validate_field_names(expected)
-        if not fields:
-            return True
+        _validate_cas_args(expected, fields)
         sets = ", ".join(f"{k}=?" for k in fields)
         conds = " AND ".join(f"{k}=?" for k in expected) or "1=1"
         with self._lock:

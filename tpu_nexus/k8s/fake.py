@@ -103,6 +103,7 @@ class FakeKubeClient(KubeClient):
                 job_labels = dict(((template.get("metadata") or {}).get("labels")) or {})
                 job_labels[JOBSET_NAME_LABEL] = js_name
                 job_labels[JOBSET_REPLICATEDJOB_LABEL] = rj_name
+                job_uid = self._next_uid()
                 self.inject(
                     "ADDED",
                     "Job",
@@ -112,8 +113,17 @@ class FakeKubeClient(KubeClient):
                         "metadata": {
                             "name": job_name,
                             "namespace": ns,
-                            "uid": self._next_uid(),
+                            "uid": job_uid,
                             "labels": job_labels,
+                            "ownerReferences": [
+                                {
+                                    "apiVersion": "jobset.x-k8s.io/v1alpha2",
+                                    "kind": "JobSet",
+                                    "name": js_name,
+                                    "uid": meta.get("uid", ""),
+                                    "controller": True,
+                                }
+                            ],
                         },
                         "spec": job_spec,
                         "status": {},
@@ -139,11 +149,33 @@ class FakeKubeClient(KubeClient):
                                 "annotations": {
                                     "batch.kubernetes.io/job-completion-index": str(i)
                                 },
+                                "ownerReferences": [
+                                    {
+                                        "apiVersion": "batch/v1",
+                                        "kind": "Job",
+                                        "name": job_name,
+                                        "uid": job_uid,
+                                        "controller": True,
+                                    }
+                                ],
                             },
                             "spec": copy.deepcopy(pod_template.get("spec") or {}),
                             "status": {"phase": "Pending"},
                         },
                     )
+
+    def recreate_jobset_children(self, namespace: str, name: str) -> None:
+        """What the JobSet ``Recreate`` failure policy does after a slice
+        failure/preemption: delete the child Jobs and their pods, then create
+        replacements under the SAME names with FRESH uids — a new generation
+        (and consistent ownerReferences), which is exactly what makes the
+        next preemption a distinct incident for the generation fence."""
+        jobset = self._objects.get("JobSet", {}).get((namespace, name))
+        if jobset is None:
+            raise NotFoundError(f"JobSet {namespace}/{name} not found")
+        for kind, obj in self._dependents_of("JobSet", name):
+            self.inject("DELETED", kind, obj)
+        self._materialize_jobset_children(jobset)
 
     # -- KubeClient ----------------------------------------------------------
 

@@ -154,6 +154,19 @@ class PodObj:
         semantics (services/supervisor.go:231,241,251)."""
         return self.jobset_name() or self.job_name()
 
+    def owner_job_uid(self) -> str:
+        """Uid of the pod's owning Job straight from its ownerReferences —
+        the Job controller stamps them on every pod it creates, so the
+        preemption generation fence does not depend on the Job informer
+        cache being warm (ADVICE r4: with a cold cache, a replica whose
+        first row read landed after another replica's commit saw none of
+        the duplicate-incident signals)."""
+        refs = (self.raw.get("metadata") or {}).get("ownerReferences") or []
+        for ref in refs:
+            if ref.get("kind") == "Job":
+                return ref.get("uid", "")
+        return ""
+
 
 @dataclass
 class Condition:

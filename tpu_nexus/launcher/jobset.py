@@ -52,6 +52,10 @@ class LaunchSpec:
     backoff_limit: int = 0
     deadline_seconds: Optional[int] = None
     namespace: str = "default"
+    #: JobSet failurePolicy.maxRestarts — how many times the controller
+    #: recreates the workers after a slice failure/preemption (restart-from-
+    #: step, SURVEY §7.4).  Ignored for plain-Job runs.
+    max_restarts: int = 3
 
 
 def run_labels(spec: LaunchSpec) -> Dict[str, str]:
@@ -198,7 +202,7 @@ def compose_jobset(spec: LaunchSpec) -> Dict[str, Any]:
             "annotations": {TPU_TOPOLOGY_ANNOTATION: "cloud.google.com/gke-nodepool"},
         },
         "spec": {
-            "failurePolicy": {"maxRestarts": 3},
+            "failurePolicy": {"maxRestarts": spec.max_restarts},
             "replicatedJobs": [
                 {
                     "name": "workers",

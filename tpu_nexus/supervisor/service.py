@@ -82,9 +82,16 @@ class ProcessingConfig:
     failure_lane_workers: int = 4
     #: TPU extension: flag RUNNING rows whose ledger progress fingerprint
     #: (per_chip_steps / last_modified) stalls past this window as hung
-    #: (ToFailStuckInRunning).  None/0 disables the watchdog.
+    #: (ToFailStuckInRunning).  None/0 disables the RUNNING sweep.
     heartbeat_stale_after: Optional[timedelta] = None
     watchdog_interval: timedelta = timedelta(seconds=30)
+    #: TPU extension: a PREEMPTED row with no replacement generation (no
+    #: restart_count/generation change, no RUNNING transition) within this
+    #: deadline escalates to terminal DEADLINE_EXCEEDED and the wedged
+    #: JobSet is deleted (ToFailRestartStalled) — the restart axis must not
+    #: be able to wedge a run forever when the JobSet controller never
+    #: recreates the children.  None/0 disables the PREEMPTED sweep.
+    preempted_restart_deadline: Optional[timedelta] = None
     #: leash for runs that have never heartbeated (long first XLA compile);
     #: None = 3x the stale window
     watchdog_first_progress_grace: Optional[timedelta] = None
@@ -178,15 +185,22 @@ class Supervisor:
         # handler on the Event informer only; pods/jobs/jobsets informers are
         # lookup caches (reference services/supervisor.go:124-128)
         self._factory.informer_for("Event").add_event_handler(self._on_event)
-        if config.heartbeat_stale_after and config.heartbeat_stale_after.total_seconds() > 0:
+        stale = config.heartbeat_stale_after
+        if stale is not None and stale.total_seconds() <= 0:
+            stale = None
+        deadline = config.preempted_restart_deadline
+        if deadline is not None and deadline.total_seconds() <= 0:
+            deadline = None
+        if stale is not None or deadline is not None:
             from tpu_nexus.supervisor.watchdog import HeartbeatWatchdog
 
             self.watchdog = HeartbeatWatchdog(
                 self._store,
                 enqueue=self._fail_actor.receive,
-                stale_after=config.heartbeat_stale_after,
+                stale_after=stale,
                 interval=config.watchdog_interval,
                 first_progress_grace=config.watchdog_first_progress_grace,
+                restart_deadline=deadline,
                 kind_resolver=self._resolve_run_kind,
                 logger=self._log,
                 metrics=self._metrics,
@@ -412,7 +426,14 @@ class Supervisor:
                 # reference's retry-exhausted terminal stage instead.
                 # Same-incident duplicates are exempt: the Nth host's event
                 # for restart N must not escalate.
-                budget = self._jobset_max_restarts(result.request_id)
+                # Budget source of truth: the ledger row (persisted at launch
+                # — survives supervisor restarts and JobSet deletion); the
+                # informer-cache lookup only covers pre-upgrade rows.
+                budget = (
+                    observed.max_restarts
+                    if observed.max_restarts is not None
+                    else self._jobset_max_restarts(result.request_id)
+                )
                 if budget is not None and observed.restart_count >= budget:
                     self._log.info(
                         "restart budget exhausted; escalating preemption to terminal",

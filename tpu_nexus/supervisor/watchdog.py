@@ -1,23 +1,38 @@
-"""Heartbeat watchdog: detects runs that are RUNNING but no longer alive.
+"""Ledger liveness watchdog: detects runs that are alive in the ledger but
+dead in the cluster.
 
-The taxonomy (tpu_nexus.supervisor.taxonomy) covers every failure class that
-*emits a k8s event* — but a hung workload (deadlocked collective, stuck data
-loader, the ``hang`` fault mode in tpu_nexus.workload.faults) emits nothing:
-its pod stays Running and its ledger row stays RUNNING forever.  The
-reference has no analogue (its nearest is stuck-in-pending,
-services/supervisor.go:172-182); the TPU-native ledger makes the detector
-cheap: workloads heartbeat ``per_chip_steps`` (and column writes bump
-``last_modified``), so a RUNNING row whose progress fingerprint is frozen
-beyond a window is hung.
+Two sweeps, both driven by *absence* of signals — the taxonomy
+(tpu_nexus.supervisor.taxonomy) covers every failure class that emits a k8s
+event, but "nothing happened" never emits one:
+
+* RUNNING sweep — a hung workload (deadlocked collective, stuck data
+  loader, the ``hang`` fault mode in tpu_nexus.workload.faults) keeps its
+  pod Running and its ledger row RUNNING forever.  Workloads heartbeat
+  ``per_chip_steps`` (and column writes bump ``last_modified``), so a
+  RUNNING row whose progress fingerprint is frozen beyond a window is hung
+  → ``ToFailStuckInRunning``.  The reference's nearest analogue is
+  stuck-in-pending (services/supervisor.go:172-182).
+* PREEMPTED sweep — the restart policy axis deliberately does NOT delete a
+  preempted JobSet (restart-from-step, SURVEY §7.4), betting the JobSet
+  controller recreates the children.  Nothing watches the other side of
+  that bet: if the controller never comes back (CRD controller down, quota
+  gone, node pool deleted) the row would sit PREEMPTED forever.  A
+  PREEMPTED row whose fingerprint (stage/restart_count/generation) is
+  frozen beyond the restart deadline escalates → ``ToFailRestartStalled``
+  (terminal, deletes the wedged JobSet).  The reference cannot wedge —
+  every failure decision deletes and writes a terminal stage
+  (services/supervisor.go:283-360) — and the restart axis must not regress
+  that guarantee (VERDICT r4 Missing #1).
 
 Staleness is judged by *fingerprint change observed by this process*
 (monotonic clock), not by comparing wall-clock columns — workload hosts and
 the supervisor need not share a clock, and ``merge_chip_steps`` deliberately
-does not touch ``last_modified``.
+does not touch ``last_modified``.  A supervisor restarted mid-incident
+starts its deadline over (first observation at first sweep), which delays
+but never loses the escalation.
 
-A stale run becomes a ``ToFailStuckInRunning`` decision on the supervisor's
-failure lane and flows through the exact same commit path as every other
-decision (stage partial order, job delete, trace, latency metric).
+Flagged runs flow through the supervisor's normal commit path (stage
+partial order, CAS, job delete, trace, latency metric) on the failure lane.
 """
 
 from __future__ import annotations
@@ -34,6 +49,7 @@ from tpu_nexus.core.signals import LifecycleContext
 from tpu_nexus.core.telemetry import Metrics, NullMetrics, VLogger, get_logger
 from tpu_nexus.supervisor.taxonomy import (
     DecisionAction,
+    MSG_RESTART_STALLED,
     MSG_STUCK_IN_RUNNING,
     RunStatusAnalysisResult,
 )
@@ -46,34 +62,48 @@ class _Observation:
 
 
 class HeartbeatWatchdog:
-    """Periodic sweep over RUNNING ledger rows; emits stuck-in-running
-    decisions for rows whose progress fingerprint stalls past the window."""
+    """Periodic sweep over RUNNING and PREEMPTED ledger rows; emits
+    stuck-in-running / restart-stalled decisions for rows whose fingerprint
+    stalls past the respective window."""
 
     def __init__(
         self,
         store: CheckpointStore,
         enqueue: Callable[[RunStatusAnalysisResult], None],
-        stale_after: timedelta,
+        stale_after: Optional[timedelta] = None,
         interval: timedelta = timedelta(seconds=30),
         first_progress_grace: Optional[timedelta] = None,
+        restart_deadline: Optional[timedelta] = None,
         kind_resolver: Optional[Callable[[str], str]] = None,
         logger: Optional[VLogger] = None,
         metrics: Optional[Metrics] = None,
     ) -> None:
-        if stale_after.total_seconds() <= 0:
-            raise ValueError("stale_after must be positive (omit the watchdog to disable)")
+        if stale_after is None and restart_deadline is None:
+            raise ValueError(
+                "watchdog needs stale_after (RUNNING sweep) and/or "
+                "restart_deadline (PREEMPTED sweep); omit the watchdog to disable"
+            )
+        if stale_after is not None and stale_after.total_seconds() <= 0:
+            raise ValueError("stale_after must be positive (None disables the RUNNING sweep)")
+        if restart_deadline is not None and restart_deadline.total_seconds() <= 0:
+            raise ValueError(
+                "restart_deadline must be positive (None disables the PREEMPTED sweep)"
+            )
         if interval.total_seconds() <= 0:
             raise ValueError("watchdog interval must be positive")
         self._store = store
         self._enqueue = enqueue
-        self._stale_after = stale_after.total_seconds()
+        self._stale_after = stale_after.total_seconds() if stale_after is not None else None
         # a run that has never heartbeated may legitimately sit in RUNNING
         # through a long first XLA compile — give it a longer leash before
         # calling it hung (default 3x the steady-state window)
         self._first_progress_grace = (
             first_progress_grace.total_seconds()
             if first_progress_grace is not None
-            else 3 * self._stale_after
+            else 3 * (self._stale_after or 0)
+        )
+        self._restart_deadline = (
+            restart_deadline.total_seconds() if restart_deadline is not None else None
         )
         self._interval = interval.total_seconds()
         self._kind_resolver = kind_resolver or (lambda request_id: "Job")
@@ -85,56 +115,118 @@ class HeartbeatWatchdog:
     @staticmethod
     def _fingerprint(cp) -> Tuple:
         steps = tuple(sorted(cp.per_chip_steps.items()))
-        return (steps, cp.last_modified, cp.tensor_checkpoint_uri)
+        return (
+            cp.lifecycle_stage,
+            steps,
+            cp.last_modified,
+            cp.tensor_checkpoint_uri,
+            cp.restart_count,
+            cp.preempted_generation,
+        )
+
+    def _flag(self, cp, action: str, message: str, trace: str, counter: str) -> None:
+        self._metrics.count(counter)
+        self.flagged += 1
+        self._enqueue(
+            RunStatusAnalysisResult(
+                action=action,
+                algorithm_name=cp.algorithm,
+                request_id=cp.id,
+                run_status_message=message,
+                run_status_trace=trace,
+                object_kind=self._kind_resolver(cp.id),
+                object_name=cp.id,
+                detected_at=time.perf_counter(),
+            )
+        )
 
     async def sweep(self, now: Optional[float] = None) -> None:
         """One pass; test-callable without the loop."""
         now = time.monotonic() if now is None else now
-        rows = await asyncio.to_thread(self._store.query_by_stage, LifecycleStage.RUNNING)
         live_keys = set()
-        for cp in rows:
-            key = (cp.algorithm, cp.id)
-            live_keys.add(key)
-            fp = self._fingerprint(cp)
-            obs = self._observations.get(key)
-            if obs is None or obs.fingerprint != fp:
-                self._observations[key] = _Observation(fingerprint=fp, since=now)
-                continue
-            stalled_for = now - obs.since
-            window = self._stale_after if cp.per_chip_steps else self._first_progress_grace
-            if stalled_for < window:
-                continue
-            self._log.info(
-                "run heartbeat stale; flagging stuck-in-running",
-                algorithm=cp.algorithm,
-                request_id=cp.id,
-                stalled_seconds=round(stalled_for, 1),
-            )
-            self._metrics.count("watchdog_stale_runs")
-            self.flagged += 1
-            self._enqueue(
-                RunStatusAnalysisResult(
-                    action=DecisionAction.TO_FAIL_STUCK_IN_RUNNING,
-                    algorithm_name=cp.algorithm,
+
+        if self._stale_after is not None:
+            rows = await asyncio.to_thread(self._store.query_by_stage, LifecycleStage.RUNNING)
+            for cp in rows:
+                key = (cp.algorithm, cp.id)
+                live_keys.add(key)
+                obs = self._observe(key, cp, now)
+                if obs is None:
+                    continue
+                stalled_for = now - obs.since
+                window = self._stale_after if cp.per_chip_steps else self._first_progress_grace
+                if stalled_for < window:
+                    continue
+                self._log.info(
+                    "run heartbeat stale; flagging stuck-in-running",
+                    algorithm=cp.algorithm,
                     request_id=cp.id,
-                    run_status_message=MSG_STUCK_IN_RUNNING,
-                    run_status_trace=(
+                    stalled_seconds=round(stalled_for, 1),
+                )
+                self._flag(
+                    cp,
+                    DecisionAction.TO_FAIL_STUCK_IN_RUNNING,
+                    MSG_STUCK_IN_RUNNING,
+                    (
                         f"no ledger progress for {stalled_for:.1f}s "
                         f"(window {window:.1f}s); "
                         f"per_chip_steps={dict(cp.per_chip_steps)!r}"
                     ),
-                    object_kind=self._kind_resolver(cp.id),
-                    object_name=cp.id,
-                    detected_at=time.perf_counter(),
+                    "watchdog_stale_runs",
                 )
-            )
-            # the decision owns the run now; if its commit fails the actor
-            # retries — re-observing from scratch would double-flag
-            del self._observations[key]
-        # forget rows that left RUNNING (completed/failed/cancelled)
+                # the decision owns the run now; if its commit fails the actor
+                # retries — re-observing from scratch would double-flag
+                del self._observations[key]
+
+        if self._restart_deadline is not None:
+            rows = await asyncio.to_thread(self._store.query_by_stage, LifecycleStage.PREEMPTED)
+            for cp in rows:
+                key = (cp.algorithm, cp.id)
+                live_keys.add(key)
+                obs = self._observe(key, cp, now)
+                if obs is None:
+                    continue
+                stalled_for = now - obs.since
+                if stalled_for < self._restart_deadline:
+                    continue
+                self._log.info(
+                    "preempted run never restarted; escalating to terminal",
+                    algorithm=cp.algorithm,
+                    request_id=cp.id,
+                    stalled_seconds=round(stalled_for, 1),
+                    restart_count=cp.restart_count,
+                )
+                self._flag(
+                    cp,
+                    DecisionAction.TO_FAIL_RESTART_STALLED,
+                    MSG_RESTART_STALLED,
+                    (
+                        f"run preempted (restart_count={cp.restart_count}, "
+                        f"generation={cp.preempted_generation or 'unknown'}) but the "
+                        f"JobSet controller produced no replacement generation and no "
+                        f"RUNNING transition for {stalled_for:.1f}s "
+                        f"(restart deadline {self._restart_deadline:.1f}s) — the "
+                        "controller never restarted the run"
+                    ),
+                    "watchdog_restart_stalled_runs",
+                )
+                del self._observations[key]
+
+        # forget rows that left the swept stages (completed/failed/cancelled,
+        # or resumed RUNNING while the RUNNING sweep is disabled)
         for key in list(self._observations):
             if key not in live_keys:
                 del self._observations[key]
+
+    def _observe(self, key, cp, now: float) -> Optional[_Observation]:
+        """Record/update the fingerprint observation; returns None when the
+        fingerprint just changed (timer restarted)."""
+        fp = self._fingerprint(cp)
+        obs = self._observations.get(key)
+        if obs is None or obs.fingerprint != fp:
+            self._observations[key] = _Observation(fingerprint=fp, since=now)
+            return None
+        return obs
 
     async def run(self, ctx: LifecycleContext) -> None:
         """Sweep every interval until the lifecycle context cancels."""
