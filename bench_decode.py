@@ -1,0 +1,158 @@
+"""Serving-path decode benchmark with an explicit HBM roofline.
+
+Decode is memory-bound: every step re-reads the weights and the KV cache.
+VERDICT r4 asked for the floor to be PRICED, not invoked — so every row
+this script prints carries:
+
+  * ``ms_step``   — decode-only ms/token, measured by the long-minus-short
+                    subtraction (whole-``generate`` calls at 288 vs 32 new
+                    tokens; identical prompt and max_len, so prefill +
+                    dispatch overheads cancel);
+  * ``floor_ms``  — (weight bytes + KV-cache bytes touched per step) / HBM
+                    bandwidth.  Weight bytes = every param leaf the step
+                    reads (the tied embedding IS the head matmul operand;
+                    the token-embedding *gather* of B rows is negligible
+                    and not counted separately).  KV bytes = the full
+                    [L, B, max_len, Hkv, D] K+V buffers — the masked
+                    attention einsum is static over max_len, so the whole
+                    buffer crosses HBM each step (+ scale planes when the
+                    cache is int8);
+  * ``x_floor``   — ms_step / floor_ms, the honest "how done is this" number.
+
+Variants: bf16 | int8 weights | int8 KV cache | int8 weights + int8 KV
+(``NEXUS_DECODE_VARIANTS`` to restrict, comma-separated).
+
+One JSON line per (shape, variant) to stdout; v5e HBM defaults to 819 GB/s
+(``NEXUS_BENCH_HBM_GBPS`` to override).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+_HBM_GBPS = (
+    ("v5 lite", 819.0),
+    ("v5e", 819.0),
+    ("v5p", 2765.0),
+    ("v6", 1640.0),
+    ("v4", 1228.0),
+)
+
+
+def _chip_hbm_gbps(device) -> float:
+    env = os.environ.get("NEXUS_BENCH_HBM_GBPS")
+    if env:
+        return float(env)
+    kind = getattr(device, "device_kind", "").lower()
+    for sub, bw in _HBM_GBPS:
+        if sub in kind:
+            return bw
+    return 0.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.generate import generate
+    from tpu_nexus.models.llama import llama_init
+    from tpu_nexus.models.quant import quantize_params, quantized_bytes
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    cfg = LlamaConfig.nexus_1b_long() if on_tpu else LlamaConfig.tiny()
+    # (batch, prompt_len, max_len): the r4 serving table shapes plus the
+    # long-context rows the KV-carry fix was measured on
+    if on_tpu:
+        shapes = [
+            (64, 128, 416),
+            (8, 2048, 2048 + 288),
+            (1, 8192, 8192 + 288),
+        ]
+    else:
+        shapes = [(2, 16, 16 + 40)]
+    env_shapes = os.environ.get("NEXUS_DECODE_SHAPES")
+    if env_shapes:
+        shapes = [tuple(int(x) for x in s.split("x")) for s in env_shapes.split(",")]
+
+    known_variants = ("bf16", "int8w", "int8kv", "int8w+int8kv")
+    variants = list(known_variants)
+    env_variants = os.environ.get("NEXUS_DECODE_VARIANTS")
+    if env_variants:
+        variants = env_variants.split(",")
+        bad = [v for v in variants if v not in known_variants]
+        if bad:
+            raise SystemExit(
+                f"unknown NEXUS_DECODE_VARIANTS {bad}; use {', '.join(known_variants)}"
+            )
+
+    long_n, short_n = (288, 32) if on_tpu else (40, 8)
+    if os.environ.get("NEXUS_DECODE_WINDOW"):
+        long_n, short_n = (int(x) for x in os.environ["NEXUS_DECODE_WINDOW"].split(","))
+    bw = _chip_hbm_gbps(jax.devices()[0]) * 1e9
+
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    qparams = quantize_params(params)
+    w_bytes_full = quantized_bytes(params)
+    w_bytes_int8 = quantized_bytes(qparams)
+
+    l, hkv, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
+
+    def kv_bytes(batch: int, max_len: int, quant: bool) -> int:
+        per_elem = 1 if quant else jnp.dtype(cfg.dtype).itemsize
+        values = 2 * l * batch * max_len * hkv * d * per_elem  # K + V
+        scales = 2 * l * batch * max_len * hkv * 4 if quant else 0
+        return values + scales
+
+    for batch, prompt_len, max_len in shapes:
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
+        )
+        for variant in variants:
+            p = qparams if "int8w" in variant else params
+            kv_quant = "int8" if "int8kv" in variant else ""
+
+            def run(n_tokens, p=p, kv_quant=kv_quant):
+                fn = jax.jit(
+                    functools.partial(
+                        generate, cfg=cfg, max_new_tokens=n_tokens,
+                        max_len=max_len, kv_quant=kv_quant,
+                    ),
+                    static_argnames=(),
+                )
+                out = fn(p, prompt)
+                # warmup must ALSO sync via a device->host pull: plain
+                # block_until_ready under-syncs on remote-relay backends
+                # (bench.py), leaking warmup execution into the timed window
+                int(out[0, -1])
+                best = float("inf")
+                for _ in range(3):
+                    t0 = time.perf_counter()
+                    out = fn(p, prompt)
+                    int(out[0, -1])
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            t_long, t_short = run(long_n), run(short_n)
+            ms_step = (t_long - t_short) * 1000.0 / (long_n - short_n)
+            w_bytes = w_bytes_int8 if "int8w" in variant else w_bytes_full
+            total_bytes = w_bytes + kv_bytes(batch, max_len, bool(kv_quant))
+            floor_ms = total_bytes / bw * 1000.0 if bw else 0.0
+            print(json.dumps({
+                "metric": "decode_ms_per_step",
+                "batch": batch, "prompt": prompt_len, "max_len": max_len,
+                "variant": variant,
+                "ms_step": round(ms_step, 3),
+                "floor_ms": round(floor_ms, 3),
+                "x_floor": round(ms_step / floor_ms, 2) if floor_ms else 0.0,
+                "tok_s": round(batch * 1000.0 / ms_step, 1) if ms_step > 0 else 0.0,
+                "weight_gb": round(w_bytes / 1e9, 3),
+                "kv_gb": round(kv_bytes(batch, max_len, bool(kv_quant)) / 1e9, 3),
+            }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,138 @@
+"""Deployment-scale int8 quality gate (VERDICT r4 weak #4 / next #5).
+
+The tiny-model perplexity gate (tests/test_quant.py) showed +0.002%; this
+script runs the SAME gate at nexus_1b scale on the real chip: train ~200
+corpus steps (minutes at ~18k tok/s), then measure held-out perplexity
+
+  * through ``make_eval_step`` (teacher-forced forward): bf16 vs int8
+    weight-only — the number the 1.47x serving speedup needs;
+  * through the DECODE path (prefill + decode_step scan, the code serving
+    actually runs): bf16 cache vs int8 KV cache vs int8 weights + int8 KV.
+
+Prints one JSON line per measurement; run on the chip:
+
+    python tools/int8_gate_1b.py          # ~10 min end to end
+    NEXUS_GATE_STEPS=500 python tools/int8_gate_1b.py
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.generate import teacher_forced_decode_ce
+    from tpu_nexus.models.quant import quantize_params
+    from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+    from tpu_nexus.workload.data import token_file_batches, write_token_npy
+    from tpu_nexus.workload.train import (
+        TrainConfig,
+        init_train_state,
+        make_eval_step,
+        make_train_step,
+    )
+
+    steps = int(os.environ.get("NEXUS_GATE_STEPS", "300"))
+    batch, seq = 16, 2048
+    vocab = 32768
+
+    # noisy affine bigram corpus over a 512-token SUPPORT of the 32k vocab:
+    # a full-vocab chain is a 32768-entry random map a 1B model cannot
+    # memorize in 200 steps (measured: loss stuck at the ln(32768)=10.40
+    # uniform floor; a 4096-support chain still sat at its ln(4096) support
+    # floor at step 200), while the 512-support chain gives the weights
+    # real, quickly-learnable structure — which is all the quantization
+    # delta needs to be meaningful
+    rng = np.random.default_rng(0)
+    n = 8 * 1024 * 1024
+    support = 512
+    toks = np.empty(n, np.int32)
+    toks[0] = 1
+    noise = rng.integers(0, 16, size=n)
+    for i in range(1, n):
+        toks[i] = (toks[i - 1] * 31 + 7 + noise[i]) % support
+    path = write_token_npy(os.path.join(tempfile.gettempdir(), "gate1b_corpus.npy"), toks)
+
+    cfg = LlamaConfig.nexus_1b()
+    tcfg = TrainConfig(warmup_steps=20, total_steps=max(steps, 2), learning_rate=1e-3)
+    mesh = build_mesh(MeshSpec(fsdp=-1))
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    split = int(n * 0.98)
+    train_data = token_file_batches(path, batch=batch, seq_len=seq, seed=1, end=split)
+
+    t0 = time.perf_counter()
+    with mesh:
+        for i in range(steps):
+            state, m = step_fn(state, jnp.asarray(next(train_data)))
+            if (i + 1) % 50 == 0:
+                print(json.dumps({
+                    "phase": "train", "step": i + 1, "loss": round(float(m["loss"]), 4),
+                    "elapsed_s": round(time.perf_counter() - t0, 1),
+                }), flush=True)
+
+    eval_fn = make_eval_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    heldout = token_file_batches(path, batch=batch, seq_len=seq, seed=99, start=split)
+    eval_batches = [jnp.asarray(next(heldout)) for _ in range(8)]
+
+    def forward_ppl(params):
+        with mesh:
+            ces = [float(eval_fn({"params": params}, b)["ce_loss"]) for b in eval_batches]
+        return float(np.exp(np.mean(ces)))
+
+    params = state["params"]
+    qparams = quantize_params(params)
+    ppl_full = forward_ppl(params)
+    ppl_int8 = forward_ppl(qparams)
+    assert ppl_full < 256, f"model did not train (ppl {ppl_full} vs 512-support uniform 512)"
+    print(json.dumps({
+        "phase": "gate_forward", "model": "nexus_1b", "steps": steps,
+        "ppl_bf16": round(ppl_full, 4), "ppl_int8w": round(ppl_int8, 4), "support": 512,
+        "rel_delta": round((ppl_int8 - ppl_full) / ppl_full, 6),
+        "gate_lt": 0.01, "pass": bool(abs(ppl_int8 - ppl_full) / ppl_full < 0.01),
+    }), flush=True)
+
+    # -- decode-path gate (the exact serving code; shared scorer) ----------
+    dec_seq, dec_batch = 1024, 8
+
+    @functools.partial(jax.jit, static_argnames=("kv_quant",))
+    def decode_ce(p, batch_toks, kv_quant=""):
+        return teacher_forced_decode_ce(p, batch_toks, cfg, kv_quant=kv_quant)
+
+    dec_stream = token_file_batches(path, batch=dec_batch, seq_len=dec_seq, seed=7, start=split)
+    dec_batches = [jnp.asarray(next(dec_stream)) for _ in range(2)]
+
+    def decode_ppl(p, kv_quant=""):
+        return float(np.exp(np.mean([
+            float(decode_ce(p, b, kv_quant=kv_quant)) for b in dec_batches
+        ])))
+
+    d_full = decode_ppl(params)
+    d_kv8 = decode_ppl(params, kv_quant="int8")
+    d_both = decode_ppl(qparams, kv_quant="int8")
+    print(json.dumps({
+        "phase": "gate_decode", "model": "nexus_1b", "seq": dec_seq,
+        "ppl_bf16": round(d_full, 4), "ppl_int8kv": round(d_kv8, 4),
+        "ppl_int8w_int8kv": round(d_both, 4),
+        "rel_delta_kv": round((d_kv8 - d_full) / d_full, 6),
+        "rel_delta_both": round((d_both - d_full) / d_full, 6),
+        "gate_kv_lt": 0.01, "gate_both_lt": 0.02,
+        "pass": bool(abs(d_kv8 - d_full) / d_full < 0.01
+                     and abs(d_both - d_full) / d_full < 0.02),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -97,7 +97,33 @@ def _ffn_block(x, layer, cfg):
 
 _NEG_INF = -1e30
 
-Cache = Dict[str, jax.Array]  # {"k": [L,B,max_len,Hkv,D], "v": same}
+#: {"k": [L,B,max_len,Hkv,D], "v": same}; int8 KV mode adds per-slot scales
+#: {"k_s": [L,B,max_len,Hkv,1] f32, "v_s": same}
+Cache = Dict[str, jax.Array]
+
+
+def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-slot int8: amax over the head_dim of each (layer,
+    batch, position, kv-head) cell / 127 — the SAME recipe as the int8
+    weight path (models/quant.py), shared so the two quantizations can
+    never drift.  KV rows are written once and read every later step, so
+    quantizing at WRITE time halves the cache's HBM traffic (int8 values +
+    a per-slot f32 scale, <1% of the row) and doubles the max-context
+    budget for the same memory."""
+    from tpu_nexus.models.quant import quantize_tensor
+
+    t = quantize_tensor(x, (-1,))
+    return t.q, t.s
+
+
+def _dequantize_kv(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
+    # QTensor.astype's dequant, on the cache's raw (q, s) pair: the
+    # convert+scale fuses into the attention dot's operand read (the same
+    # XLA pattern the int8 weight path rides), so the cache crosses HBM
+    # as int8
+    from tpu_nexus.models.quant import QTensor
+
+    return QTensor(q, s).astype(dtype)
 
 
 def cached_attention(
@@ -133,6 +159,7 @@ def prefill(
     cfg: ModelConfig,
     max_len: int,
     prompt_lengths: Optional[jax.Array] = None,
+    kv_quant: str = "",
 ) -> Tuple[Cache, jax.Array]:
     """Run the prompt through the training forward once; return the padded
     KV cache and each row's last REAL position's logits ``[B, vocab]``.
@@ -146,9 +173,19 @@ def prefill(
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f"prompt length {s} exceeds cache max_len {max_len}")
+    if kv_quant not in ("", "int8"):
+        raise ValueError(f"unknown kv_quant mode {kv_quant!r}; use 'int8' or ''")
     hidden, (k, v) = _prefill_hidden_kv(params, tokens, cfg)
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
-    cache = {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
+    if kv_quant == "int8":
+        kq, ks = _quantize_kv(k)
+        vq, vs = _quantize_kv(v)
+        cache = {
+            "k": jnp.pad(kq, pad), "v": jnp.pad(vq, pad),
+            "k_s": jnp.pad(ks, pad), "v_s": jnp.pad(vs, pad),
+        }
+    else:
+        cache = {"k": jnp.pad(k, pad), "v": jnp.pad(v, pad)}
     if prompt_lengths is None:
         last = hidden[:, -1]
     else:
@@ -192,6 +229,7 @@ def decode_step(
             (slot[None, :] >= prompt_width) & (slot[None, :] <= pos)
         )  # [B, max_len]
     cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
+    kv_quant = "k_s" in cache  # int8 KV mode travels with the cache itself
 
     def body(carry, xs):
         # The stacked caches ride the CARRY, written in place with
@@ -199,7 +237,7 @@ def decode_step(
         # re-materializes the ENTIRE [L, B, max_len, H, D] stack every
         # decode step (measured: the stacked-ys copy dominated the decode
         # step at long context, ~8x over the bandwidth floor)
-        x, ck_all, cv_all = carry
+        x, c = carry
         layer, li = xs
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
@@ -207,24 +245,74 @@ def decode_step(
         v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
-        ck_all = jax.lax.dynamic_update_slice(ck_all, k[None], (li, 0, pos, 0, 0))
-        cv_all = jax.lax.dynamic_update_slice(cv_all, v[None], (li, 0, pos, 0, 0))
-        ck = jax.lax.dynamic_index_in_dim(ck_all, li, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(cv_all, li, 0, keepdims=False)
+        if kv_quant:
+            # quantize at write: the row is written once and re-read every
+            # later step as int8, halving steady-state cache traffic
+            (k, k_s), (v, v_s) = _quantize_kv(k), _quantize_kv(v)
+            c = dict(
+                c,
+                k_s=jax.lax.dynamic_update_slice(c["k_s"], k_s[None], (li, 0, pos, 0, 0)),
+                v_s=jax.lax.dynamic_update_slice(c["v_s"], v_s[None], (li, 0, pos, 0, 0)),
+            )
+        c = dict(
+            c,
+            k=jax.lax.dynamic_update_slice(c["k"], k[None], (li, 0, pos, 0, 0)),
+            v=jax.lax.dynamic_update_slice(c["v"], v[None], (li, 0, pos, 0, 0)),
+        )
+        ck = jax.lax.dynamic_index_in_dim(c["k"], li, 0, keepdims=False)
+        cv = jax.lax.dynamic_index_in_dim(c["v"], li, 0, keepdims=False)
+        if kv_quant:
+            ck = _dequantize_kv(
+                ck, jax.lax.dynamic_index_in_dim(c["k_s"], li, 0, keepdims=False), ct
+            )
+            cv = _dequantize_kv(
+                cv, jax.lax.dynamic_index_in_dim(c["v_s"], li, 0, keepdims=False), ct
+            )
         o = cached_attention(q, ck, cv, pos + 1, valid=valid)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         x = _ffn_block(x, layer, cfg)
-        return (x, ck_all, cv_all), None
+        return (x, c), None
 
     n_layers = cache["k"].shape[0]
-    (x, ck_all, cv_all), _ = jax.lax.scan(
+    (x, cache), _ = jax.lax.scan(
         body,
-        (x, cache["k"], cache["v"]),
+        (x, cache),
         (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
     )
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))
-    return logits, {"k": ck_all, "v": cv_all}
+    return logits, cache
+
+
+def teacher_forced_decode_ce(
+    params: Dict[str, Any],
+    tokens: jax.Array,
+    cfg: ModelConfig,
+    kv_quant: str = "",
+) -> jax.Array:
+    """Mean next-token cross-entropy of ``tokens`` [B, S] scored THROUGH
+    the decode path — prefill one token, then a ``decode_step`` scan with
+    teacher forcing.  This is the quality probe for serving-side levers
+    (int8 weights / int8 KV): it exercises exactly the code `generate`
+    runs, unlike the teacher-forced training forward.  Jit-compatible; the
+    tiny-model CI gate (tests/test_quant.py) and the nexus_1b chip gate
+    (tools/int8_gate_1b.py) both score with THIS function, so the two
+    gates cannot drift."""
+    cache, logits = prefill(
+        params, tokens[:, :1], cfg, max_len=tokens.shape[1], kv_quant=kv_quant
+    )
+
+    def body(carry, tok_next):
+        cache, logits, pos = carry
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        ce = -jnp.take_along_axis(lp, tok_next[:, None], axis=-1)[:, 0]
+        logits, cache = decode_step(params, cache, tok_next, pos, cfg)
+        return (cache, logits, pos + 1), ce
+
+    (_, _, _), ces = jax.lax.scan(
+        body, (cache, logits, jnp.asarray(1, jnp.int32)), tokens[:, 1:].T
+    )
+    return ces.mean()
 
 
 def generate(
@@ -239,6 +327,7 @@ def generate(
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
     prompt_lengths: Optional[jax.Array] = None,
+    kv_quant: str = "",
 ) -> jax.Array:
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, S] →
     [B, max_new_tokens].  ``temperature=0`` is greedy; otherwise categorical
@@ -249,7 +338,12 @@ def generate(
 
     Ragged batches: RIGHT-pad prompts to a common width and pass
     ``prompt_lengths`` [B] — each row continues from its own last real
-    token with per-row RoPE positions and pad-slot masking."""
+    token with per-row RoPE positions and pad-slot masking.
+
+    ``kv_quant="int8"``: the KV cache is stored int8 with per-slot scales
+    (quantized at write, dequant fused into the attention reads) — halves
+    cache HBM traffic and doubles the context budget per byte; gate its
+    held-out perplexity like the int8 weight path (tests/test_quant.py)."""
     b, s = prompt.shape
     if (top_k or top_p < 1.0) and temperature == 0.0:
         raise ValueError("top_k/top_p truncation requires temperature > 0")
@@ -269,7 +363,7 @@ def generate(
     if key is None:
         key = jax.random.PRNGKey(0)  # unused by greedy; scan carry needs an array
 
-    cache, logits = prefill(params, prompt, cfg, max_len, prompt_lengths)
+    cache, logits = prefill(params, prompt, cfg, max_len, prompt_lengths, kv_quant=kv_quant)
 
     def sample(logits, k):
         if temperature == 0.0:

@@ -668,7 +668,14 @@ def moe_ffn(
     if cfg.dispatch == "sort":
         return _moe_ffn_sorted(x, layer, cfg)
     if cfg.dispatch == "gmm":
-        if mesh is not None and mesh.shape.get(ep_axis, 1) > 1:
+        import os
+
+        # NEXUS_MOE_FORCE_EP_PATH: run the shard_map ep path even at ep=1 —
+        # a bench/debug knob that bounds the shard_map + budget-dispatch
+        # overhead against the plain gmm path on the same hardware.  Strict
+        # value parse: "0"/"false" must NOT force the path.
+        force_ep = os.environ.get("NEXUS_MOE_FORCE_EP_PATH", "").lower() in ("1", "true", "yes")
+        if mesh is not None and (mesh.shape.get(ep_axis, 1) > 1 or force_ep):
             return _moe_ffn_gmm_ep(x, layer, cfg, mesh, ep_axis)
         return _moe_ffn_gmm(x, layer, cfg)
     if cfg.dispatch != "scatter":

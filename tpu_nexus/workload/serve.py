@@ -52,9 +52,14 @@ class ServeConfig:
     checkpoint_dir: str = ""
     seed: int = 0
     #: "int8" = weight-only quantized decoding (models/quant.py): ~1.9x
-    #: less weight traffic per decode step, measured 1.47x decode speedup
-    #: on v5e at batch 64 (PERF.md); "" = full precision
+    #: less weight traffic per decode step; composed with the KV-carry fix
+    #: it measures 1.15-1.43x alone (batch 64 -> 1), and 1.6x together
+    #: with quantize_kv (PERF.md r5 roofline table); "" = full precision
     quantize: str = ""
+    #: "int8" = int8 KV cache (models/generate.py): halves cache traffic
+    #: and doubles the context budget per byte; perplexity-gated like the
+    #: weight path (tests/test_quant.py); "" = cache in model dtype
+    quantize_kv: str = ""
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
@@ -74,6 +79,7 @@ class ServeConfig:
             checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
             seed=int(e.get("NEXUS_SEED", "0")),
             quantize=e.get("NEXUS_QUANTIZE", ""),
+            quantize_kv=e.get("NEXUS_QUANTIZE_KV", ""),
         )
 
 
@@ -118,6 +124,8 @@ def run_serving(
 
         params = quantize_params(params)
         logger.info("serving with int8 weight-only quantization")
+    if cfg.quantize_kv and cfg.quantize_kv != "int8":
+        raise ValueError(f"unknown quantize_kv mode {cfg.quantize_kv!r}; use 'int8'")
 
     if prompts is None:
         prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
@@ -132,6 +140,7 @@ def run_serving(
             temperature=cfg.temperature,
             top_k=cfg.top_k,
             top_p=cfg.top_p,
+            kv_quant=cfg.quantize_kv,
         )
     )
     key = jax.random.PRNGKey(cfg.seed)
