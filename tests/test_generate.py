@@ -48,9 +48,12 @@ class TestDecodeParity:
             seq = jnp.concatenate([seq, tok[:, None]], axis=1)
             full = llama_forward(params, seq, cfg)[:, -1]
             logits, cache = decode_step(params, cache, tok, jnp.asarray(pos), cfg)
+            # bf16 path: ulp at |logit|~3 is ~0.023, and the decode
+            # default (unrolled layers, fused cache reads) reassociates
+            # differently from the full forward — 3e-2 keeps one-ulp slack
             np.testing.assert_allclose(
                 np.asarray(logits, np.float32), np.asarray(full, np.float32),
-                rtol=2e-2, atol=2e-2,
+                rtol=3e-2, atol=3e-2,
             )
             pos += 1
 
@@ -244,3 +247,43 @@ class TestGenerateApi:
             generate(params, prompt, cfg, max_new_tokens=4, max_len=8)
         with pytest.raises(ValueError, match="context window"):
             generate(params, prompt, cfg, max_new_tokens=4, max_len=10_000)
+
+
+def test_decode_unrolled_matches_scan_exactly():
+    """The unrolled layer loop (static cache indices; the serving default)
+    must be bit-equivalent to the lax.scan layer loop — same math, only
+    the cache-read lowering differs.  Covers plain, int8-KV, and ragged."""
+    import dataclasses
+
+    import numpy as np
+
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.generate import decode_step, prefill
+    from tpu_nexus.models.llama import llama_init
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(vocab_size=64), dtype=jnp.float32)
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0, cfg.vocab_size)
+    lengths = jnp.asarray([9, 12], jnp.int32)
+    for kv_quant in ("", "int8"):
+        for ragged in (False, True):
+            cache, logits = prefill(
+                params, tokens, cfg, max_len=20,
+                prompt_lengths=lengths if ragged else None, kv_quant=kv_quant,
+            )
+            nxt = jnp.argmax(logits, axis=-1).astype(tokens.dtype)
+            pos = jnp.asarray(12, jnp.int32)
+            kwargs = dict(prompt_lengths=lengths, prompt_width=12) if ragged else {}
+            l_un, c_un = decode_step(params, cache, nxt, pos, cfg, unroll_layers=True, **kwargs)
+            l_sc, c_sc = decode_step(params, cache, nxt, pos, cfg, unroll_layers=False, **kwargs)
+            # identical math; the lowering differs (fused static slice vs
+            # materialized dynamic slice), so only last-ulp reassociation
+            # noise is allowed
+            np.testing.assert_allclose(
+                np.asarray(l_un), np.asarray(l_sc), rtol=1e-5, atol=1e-5
+            )
+            for key in c_un:
+                np.testing.assert_allclose(
+                    np.asarray(c_un[key]), np.asarray(c_sc[key]),
+                    rtol=1e-5, atol=1e-5, err_msg=str((key, kv_quant, ragged)),
+                )

@@ -129,18 +129,34 @@ def _dequantize_kv(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
 def cached_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array,
     valid: Optional[jax.Array] = None,
+    k_scale: Optional[jax.Array] = None,
+    v_scale: Optional[jax.Array] = None,
 ) -> jax.Array:
     """GQA attention of a length-1 query against a fixed-size cache.
 
     ``q`` [B, 1, Hq, D]; ``k``/``v`` [B, max_len, Hkv, D]; ``kv_len`` scalar —
     cache slots >= kv_len are masked out (they hold zeros/stale writes).
     ``valid`` [B, max_len] bool overrides the uniform mask for ragged
-    prompts (per-row real-slot maps)."""
+    prompts (per-row real-slot maps).
+
+    Int8 cache mode (``k_scale``/``v_scale`` [B, max_len, Hkv, 1]): the
+    dequantization is DEFERRED past the dots — exact, because the scale is
+    constant along the contracted head_dim: ``(q·k8)·s == q·(k8·s)``, and
+    folding ``v_scale`` into the softmax weights likewise.  The int8
+    buffer stays the dot's memory operand (the int8→bf16 convert fuses
+    into the read, like the int8 weight path); an operand-side
+    ``k8*s`` multiply instead re-materializes a bf16 slab, measured
+    SLOWER than the bf16 cache on the unrolled decode path."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
     qg = q.reshape(b, sq, hkv, g, d)
-    scores = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k, preferred_element_type=jnp.float32)
+    scores = jnp.einsum(
+        "bqhgd,bkhd->bhgqk", qg, k.astype(q.dtype), preferred_element_type=jnp.float32
+    )
+    if k_scale is not None:
+        # [B, max_len, Hkv, 1] -> [B, Hkv, 1, 1, max_len]
+        scores = scores * k_scale[:, :, :, 0].transpose(0, 2, 1)[:, :, None, None, :]
     scores = scores * (d**-0.5)
     if valid is None:
         k_pos = jnp.arange(k.shape[1])
@@ -148,8 +164,13 @@ def cached_attention(
     else:
         mask = valid[:, None, None, None, :]  # [B, 1, 1, 1, max_len]
     scores = jnp.where(mask, scores, _NEG_INF)
-    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
-    out = jnp.einsum("bhgqk,bkhd->bqhgd", w, v, preferred_element_type=jnp.float32)
+    w = jax.nn.softmax(scores, axis=-1)
+    if v_scale is not None:
+        w = w * v_scale[:, :, :, 0].transpose(0, 2, 1)[:, :, None, None, :]
+    w = w.astype(q.dtype)
+    out = jnp.einsum(
+        "bhgqk,bkhd->bqhgd", w, v.astype(q.dtype), preferred_element_type=jnp.float32
+    )
     return out.reshape(b, sq, hq, d).astype(q.dtype)
 
 
@@ -204,6 +225,7 @@ def decode_step(
     cfg: ModelConfig,
     prompt_lengths: Optional[jax.Array] = None,
     prompt_width: Optional[int] = None,
+    unroll_layers: Optional[bool] = None,
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step: ``token`` [B] at scalar WRITE position
     ``pos`` → (logits [B, vocab], updated cache).  Mirrors the training
@@ -213,7 +235,17 @@ def decode_step(
     S): rows still decode in lockstep at shared cache slots, but each
     row's RoPE position is its own ``len + (pos - S)`` and attention masks
     out the row's pad slots ``[len, S)`` — the same trusted lockstep loop,
-    made per-row correct by index arithmetic instead of per-row scatters."""
+    made per-row correct by index arithmetic instead of per-row scatters.
+
+    ``unroll_layers`` (default: auto — unroll up to 32 layers): with the
+    layer loop as a ``lax.scan``, the per-layer cache read is a DYNAMIC
+    slice, which XLA materializes as a [B, max_len, Hkv, D] slab copy
+    before attention reads it again — profiled at ~23% of the decode step
+    at serving shapes (plus a second read of the slab).  Unrolling makes
+    the layer index STATIC, the slab read fuses into the attention dots,
+    and the measured step drops 1.6x at batch 64/8 (PERF.md r5).  The
+    scan stays available for very deep models where an unrolled decode
+    body would blow up compile time."""
     cfg = _decode_cfg(cfg)
     ct = cfg.dtype
     b = token.shape[0]
@@ -230,15 +262,25 @@ def decode_step(
         )  # [B, max_len]
     cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
     kv_quant = "k_s" in cache  # int8 KV mode travels with the cache itself
+    n_layers = cache["k"].shape[0]
+    if unroll_layers is None:
+        unroll_layers = n_layers <= 32
 
-    def body(carry, xs):
-        # The stacked caches ride the CARRY, written in place with
-        # one-position dynamic updates — passing them as scan xs/ys instead
-        # re-materializes the ENTIRE [L, B, max_len, H, D] stack every
-        # decode step (measured: the stacked-ys copy dominated the decode
-        # step at long context, ~8x over the bandwidth floor)
-        x, c = carry
-        layer, li = xs
+    def _cache_read(arr, li):
+        # static index (unrolled): a plain slice XLA fuses into the
+        # attention dots; traced index (scan): a dynamic slice that
+        # MATERIALIZES the [B, max_len, Hkv, D] slab before attention
+        # reads it again — the 1.6x the unrolled path buys back
+        if isinstance(li, int):
+            return arr[li]
+        return jax.lax.dynamic_index_in_dim(arr, li, 0, keepdims=False)
+
+    def layer_body(x, c, layer, li):
+        # The stacked caches ride the CARRY (or the unrolled dataflow),
+        # written in place with one-position dynamic updates — passing
+        # them as scan xs/ys instead re-materializes the ENTIRE
+        # [L, B, max_len, H, D] stack every decode step (measured: the
+        # stacked-ys copy dominated at long context, ~8x over the floor)
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
         q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
         k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
@@ -259,26 +301,37 @@ def decode_step(
             k=jax.lax.dynamic_update_slice(c["k"], k[None], (li, 0, pos, 0, 0)),
             v=jax.lax.dynamic_update_slice(c["v"], v[None], (li, 0, pos, 0, 0)),
         )
-        ck = jax.lax.dynamic_index_in_dim(c["k"], li, 0, keepdims=False)
-        cv = jax.lax.dynamic_index_in_dim(c["v"], li, 0, keepdims=False)
-        if kv_quant:
-            ck = _dequantize_kv(
-                ck, jax.lax.dynamic_index_in_dim(c["k_s"], li, 0, keepdims=False), ct
-            )
-            cv = _dequantize_kv(
-                cv, jax.lax.dynamic_index_in_dim(c["v_s"], li, 0, keepdims=False), ct
-            )
-        o = cached_attention(q, ck, cv, pos + 1, valid=valid)
+        ck = _cache_read(c["k"], li)
+        cv = _cache_read(c["v"], li)
+        scales = (
+            dict(k_scale=_cache_read(c["k_s"], li), v_scale=_cache_read(c["v_s"], li))
+            if kv_quant
+            else {}
+        )
+        o = cached_attention(q, ck, cv, pos + 1, valid=valid, **scales)
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         x = _ffn_block(x, layer, cfg)
-        return (x, c), None
+        return x, c
 
-    n_layers = cache["k"].shape[0]
-    (x, cache), _ = jax.lax.scan(
-        body,
-        (x, cache),
-        (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
-    )
+    if unroll_layers:
+        c = cache
+        for li in range(n_layers):
+            layer = jax.tree.map(lambda a, _li=li: a[_li], params["layers"])
+            x, c = layer_body(x, c, layer, li)
+        cache = c
+    else:
+
+        def body(carry, xs):
+            x, c = carry
+            layer, li = xs
+            x, c = layer_body(x, c, layer, li)
+            return (x, c), None
+
+        (x, cache), _ = jax.lax.scan(
+            body,
+            (x, cache),
+            (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+        )
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))
     return logits, cache

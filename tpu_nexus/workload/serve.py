@@ -57,8 +57,11 @@ class ServeConfig:
     #: with quantize_kv (PERF.md r5 roofline table); "" = full precision
     quantize: str = ""
     #: "int8" = int8 KV cache (models/generate.py): halves cache traffic
-    #: and doubles the context budget per byte; perplexity-gated like the
-    #: weight path (tests/test_quant.py); "" = cache in model dtype
+    #: and doubles the context budget per byte; dequant deferred past the
+    #: attention dots, so composed with quantize="int8" it is the fastest
+    #: configuration at every measured shape (PERF.md r5b roofline table);
+    #: perplexity-gated like the weight path (tests/test_quant.py);
+    #: "" = cache in model dtype
     quantize_kv: str = ""
 
     @staticmethod
