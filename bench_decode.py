@@ -63,7 +63,17 @@ def main() -> None:
     from tpu_nexus.models.quant import quantize_params, quantized_bytes
 
     on_tpu = jax.default_backend() in ("tpu", "axon")
-    cfg = LlamaConfig.nexus_1b_long() if on_tpu else LlamaConfig.tiny()
+    model = os.environ.get("NEXUS_DECODE_MODEL", "nexus_1b")
+    if model == "nexus_moe":
+        import dataclasses
+
+        from tpu_nexus.models import MoeConfig
+
+        base = MoeConfig.nexus_moe() if on_tpu else MoeConfig.tiny()
+        # decode normalizes to dropless scatter dispatch (generate._decode_cfg)
+        cfg = dataclasses.replace(base, max_seq_len=max(base.max_seq_len, 32768))
+    else:
+        cfg = LlamaConfig.nexus_1b_long() if on_tpu else LlamaConfig.tiny()
     # (batch, prompt_len, max_len): the r4 serving table shapes plus the
     # long-context rows the KV-carry fix was measured on
     if on_tpu:
@@ -94,7 +104,11 @@ def main() -> None:
         long_n, short_n = (int(x) for x in os.environ["NEXUS_DECODE_WINDOW"].split(","))
     bw = _chip_hbm_gbps(jax.devices()[0]) * 1e9
 
-    params = llama_init(jax.random.PRNGKey(0), cfg)
+    if model == "nexus_moe":
+        from tpu_nexus.models.moe import moe_init as _init
+    else:
+        _init = llama_init
+    params = _init(jax.random.PRNGKey(0), cfg)
     qparams = quantize_params(params)
     w_bytes_full = quantized_bytes(params)
     w_bytes_int8 = quantized_bytes(qparams)
@@ -143,6 +157,7 @@ def main() -> None:
             floor_ms = total_bytes / bw * 1000.0 if bw else 0.0
             print(json.dumps({
                 "metric": "decode_ms_per_step",
+                "model": model,
                 "batch": batch, "prompt": prompt_len, "max_len": max_len,
                 "variant": variant,
                 "ms_step": round(ms_step, 3),
