@@ -152,6 +152,9 @@ def main() -> None:
 
             t_long, t_short = run(long_n), run(short_n)
             ms_step = (t_long - t_short) * 1000.0 / (long_n - short_n)
+            # time-to-first-token estimate: the short call minus its decode
+            # share — includes prefill, sampling setup, and dispatch
+            ttft_ms = max(t_short * 1000.0 - short_n * ms_step, 0.0)
             w_bytes = w_bytes_int8 if "int8w" in variant else w_bytes_full
             total_bytes = w_bytes + kv_bytes(batch, max_len, bool(kv_quant))
             floor_ms = total_bytes / bw * 1000.0 if bw else 0.0
@@ -164,6 +167,7 @@ def main() -> None:
                 "floor_ms": round(floor_ms, 3),
                 "x_floor": round(ms_step / floor_ms, 2) if floor_ms else 0.0,
                 "tok_s": round(batch * 1000.0 / ms_step, 1) if ms_step > 0 else 0.0,
+                "ttft_ms_est": round(ttft_ms, 1),
                 "weight_gb": round(w_bytes / 1e9, 3),
                 "kv_gb": round(kv_bytes(batch, max_len, bool(kv_quant)) / 1e9, 3),
             }), flush=True)

@@ -251,3 +251,22 @@ class TestInt8KvCache:
         rel_both = (ppl_both - ppl_full) / ppl_full
         # the two quantizations must COMPOSE without compounding blowup
         assert abs(rel_both) < 0.02, (ppl_full, ppl_both, rel_both)
+
+    def test_moe_decode_path_with_int8_kv(self):
+        """The MoE family through the quantized decode path: generate works
+        with int8 weights + int8 KV, and the decode-path CE stays close to
+        full precision (random-weight probe; deployment-scale gating is the
+        same machinery as the Llama gate)."""
+        from tpu_nexus.models.generate import teacher_forced_decode_ce
+
+        cfg = dataclasses.replace(MoeConfig.tiny(vocab_size=64), dtype=jnp.float32)
+        params = moe_init(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0, cfg.vocab_size)
+        toks = generate(
+            quantize_params(params), prompt, cfg, max_new_tokens=4, kv_quant="int8"
+        )
+        assert toks.shape == (2, 4)
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (2, 24), 0, cfg.vocab_size)
+        ce_full = float(teacher_forced_decode_ce(params, tokens, cfg))
+        ce_kv8 = float(teacher_forced_decode_ce(params, tokens, cfg, kv_quant="int8"))
+        assert abs(ce_kv8 - ce_full) / ce_full < 0.02, (ce_full, ce_kv8)
