@@ -10,23 +10,17 @@
 
 import asyncio
 import inspect
-import os
 
 # Force, don't setdefault: TPU tunnel environments pin JAX_PLATFORMS to the
 # hardware plugin (and sitecustomize may import jax before conftest runs),
 # but unit tests always run on the virtual CPU mesh — the real chip is
-# reserved for bench.py.  Env vars cover fresh subprocesses; the
-# jax.config.update calls below cover this process even though jax may
-# already be imported (backends initialize lazily, config wins over env).
-os.environ["JAX_PLATFORMS"] = "cpu"
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (xla_flags + " --xla_force_host_platform_device_count=8").strip()
+# reserved for bench.py.  The helper sets env vars (fresh subprocesses
+# inherit) AND jax.config (covers this process even though jax may already
+# be imported: backends initialize lazily, config wins over env), with the
+# jax<0.5 compat handled in one place.
+from tpu_nexus.parallel.smap import force_virtual_cpu_devices
 
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+force_virtual_cpu_devices(8)
 
 import logging  # noqa: E402
 

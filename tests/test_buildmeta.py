@@ -43,8 +43,11 @@ def test_apply_platform_env_applies_cpu_mesh():
 
     from tpu_nexus.workload.__main__ import _apply_platform_env
 
+    # jax < 0.5 has no jax_num_cpu_devices option; there the device count
+    # rides the XLA_FLAGS env var and only the platform pin is asserted
+    has_num_cpu = hasattr(jax.config, "jax_num_cpu_devices")
     before_platforms = jax.config.jax_platforms
-    before_n = jax.config.jax_num_cpu_devices
+    before_n = jax.config.jax_num_cpu_devices if has_num_cpu else None
     try:
         with mock.patch.dict(os.environ, {
             "JAX_PLATFORMS": "cpu",
@@ -52,7 +55,9 @@ def test_apply_platform_env_applies_cpu_mesh():
         }):
             _apply_platform_env()
             assert jax.config.jax_platforms == "cpu"
-            assert jax.config.jax_num_cpu_devices == 8
+            if has_num_cpu:
+                assert jax.config.jax_num_cpu_devices == 8
     finally:
         jax.config.update("jax_platforms", before_platforms)
-        jax.config.update("jax_num_cpu_devices", before_n)
+        if has_num_cpu:
+            jax.config.update("jax_num_cpu_devices", before_n)

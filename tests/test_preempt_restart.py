@@ -52,13 +52,10 @@ FAULT_STEP = 5  # steps 0-4 run; checkpoints commit at steps 2 and 4
 # because the ``preempt`` fault SIGTERMs its own process (faults.py).
 _WORKLOAD_SCRIPT = """
 import os, sys
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
 os.environ["NEXUS_FAULT_MODE"] = "preempt"
 os.environ["NEXUS_FAULT_STEP"] = "{fault_step}"
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+from tpu_nexus.parallel.smap import force_virtual_cpu_devices
+force_virtual_cpu_devices(8)
 from tpu_nexus.checkpoint.store import SqliteCheckpointStore
 from tpu_nexus.models import LlamaConfig
 from tpu_nexus.parallel import MeshSpec

@@ -1,0 +1,638 @@
+"""nxlint: engine mechanics (suppressions, baselines, CLI contract), one
+positive + one negative per rule, and the tier-1 gate — the analyzer must
+run CLEAN over the shipped tree (ISSUE: static_analysis acceptance)."""
+
+import json
+import os
+import textwrap
+
+from tools.nxlint import (
+    Module,
+    Project,
+    all_rules,
+    lint_paths,
+    lint_project,
+)
+from tools.nxlint.__main__ import main as nxlint_main
+from tools.nxlint.engine import load_baseline, write_baseline
+from tools.nxlint.rules_control import parse_schema_columns
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint_source(source, rule_id, rel_path="pkg/mod.py", extra=()):
+    """Lint in-memory sources with a single rule; ``extra`` is (rel_path,
+    source) pairs for cross-file rules."""
+    modules = [Module("/virtual/" + rel_path, rel_path, textwrap.dedent(source))]
+    for other_rel, other_src in extra:
+        modules.append(
+            Module("/virtual/" + other_rel, other_rel, textwrap.dedent(other_src))
+        )
+    rules = [r for r in all_rules() if r.rule_id == rule_id]
+    assert rules, f"unknown rule {rule_id}"
+    return lint_project(Project("/virtual", modules), rules=rules)
+
+
+MESH_SRC = """
+AXIS_ORDER = ("pp", "dp", "fsdp", "ep", "sp", "tp")
+"""
+
+
+# -- engine mechanics ----------------------------------------------------------
+
+
+def test_per_line_suppression_silences_the_rule():
+    src = """
+    try:
+        pass
+    except Exception:  # nxlint: disable=NX003
+        pass
+    """
+    assert lint_source(src, "NX003") == []
+
+
+def test_suppression_with_trailing_rationale():
+    src = """
+    try:
+        pass
+    except Exception:  # nxlint: disable=NX003 justified: teardown guard
+        pass
+    """
+    assert lint_source(src, "NX003") == []
+
+
+def test_overlapping_paths_do_not_double_lint(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    findings = lint_paths([str(dirty), str(tmp_path)], root=str(tmp_path))
+    assert len(findings) == 1
+
+
+def test_unreadable_file_is_an_nx000_finding(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_bytes(b"x = 1\n\xff\xfe not utf8\n")
+    findings = lint_paths([str(bad)], root=str(tmp_path))
+    assert [f.rule_id for f in findings] == ["NX000"]
+    assert "unreadable file" in findings[0].message
+
+
+def test_suppression_is_rule_specific():
+    src = """
+    try:
+        pass
+    except Exception:  # nxlint: disable=NX010
+        pass
+    """
+    findings = lint_source(src, "NX003")
+    assert [f.rule_id for f in findings] == ["NX003"]
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = "try:\n    pass\nexcept Exception:\n    pass\n"
+    module = Module("/virtual/m.py", "m.py", src)
+    rules = [r for r in all_rules() if r.rule_id == "NX003"]
+    findings = lint_project(Project("/virtual", [module]), rules=rules)
+    assert findings
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(str(baseline_file), findings)
+    baseline = load_baseline(str(baseline_file))
+    assert (
+        lint_project(Project("/virtual", [module]), rules=rules, baseline=baseline)
+        == []
+    )
+
+
+def test_baseline_is_occurrence_counted(tmp_path):
+    """Baselining ONE broad except must not grandfather a second identical
+    one added to the same file later (fingerprints repeat by design)."""
+    one = "try:\n    pass\nexcept Exception:\n    pass\n"
+    two = one + "try:\n    pass\nexcept Exception:\n    pass\n"
+    rules = [r for r in all_rules() if r.rule_id == "NX003"]
+
+    def lint(src, baseline=None):
+        return lint_project(
+            Project("/virtual", [Module("/virtual/m.py", "m.py", src)]),
+            rules=rules,
+            baseline=baseline,
+        )
+
+    baseline_file = tmp_path / "baseline.json"
+    write_baseline(str(baseline_file), lint(one))
+    baseline = load_baseline(str(baseline_file))
+    assert lint(one, baseline) == []
+    leftover = lint(two, baseline)
+    assert len(leftover) == 1 and leftover[0].line == 7
+
+
+def test_finding_json_shape():
+    findings = lint_source("try:\n    pass\nexcept Exception:\n    pass\n", "NX003")
+    payload = findings[0].to_json()
+    assert {"file", "line", "col", "rule_id", "severity", "message", "fingerprint"} <= set(payload)
+
+
+def test_syntax_error_is_reported_not_raised():
+    findings = lint_project(
+        Project("/virtual", [Module("/virtual/bad.py", "bad.py", "def f(:\n")])
+    )
+    assert [f.rule_id for f in findings] == ["NX000"]
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    assert nxlint_main([str(clean), "--root", str(tmp_path)]) == 0
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert nxlint_main([str(dirty), "--root", str(tmp_path)]) == 1
+    assert nxlint_main([str(tmp_path / "missing.py")]) == 2
+    assert nxlint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    assert "NX001" in out and "NX012" in out
+
+
+def test_cli_json_output(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    assert nxlint_main([str(dirty), "--root", str(tmp_path), "--json"]) == 1
+    findings = json.loads(capsys.readouterr().out)
+    assert findings and findings[0]["rule_id"] == "NX003"
+
+
+# -- NX001 taxonomy totality ---------------------------------------------------
+
+TAXONOMY_OK = """
+class DecisionAction:
+    TO_RUNNING = "ToRunning"
+    TO_FAIL = "ToFail"
+
+DECISION_STAGE = {
+    DecisionAction.TO_RUNNING: "RUNNING",
+    DecisionAction.TO_FAIL: "FAILED",
+}
+ACTION_MESSAGES = {
+    DecisionAction.TO_RUNNING: "",
+    DecisionAction.TO_FAIL: "boom",
+}
+DELETES_JOB = frozenset({DecisionAction.TO_FAIL})
+NON_DELETING_ACTIONS = frozenset({DecisionAction.TO_RUNNING})
+"""
+
+
+def test_nx001_clean_taxonomy_passes():
+    assert lint_source(TAXONOMY_OK, "NX001", rel_path="supervisor/taxonomy.py") == []
+
+
+def test_nx001_untracked_constant_is_flagged():
+    src = TAXONOMY_OK.replace(
+        'TO_FAIL = "ToFail"', 'TO_FAIL = "ToFail"\n    TO_NEW = "ToNew"'
+    )
+    messages = [f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")]
+    assert any("TO_NEW has no DECISION_STAGE row" in m for m in messages)
+    assert any("TO_NEW has no human message" in m for m in messages)
+    assert any("neither DELETES_JOB nor" in m for m in messages)
+
+
+def test_nx001_annotated_constant_is_tracked():
+    src = TAXONOMY_OK.replace(
+        'TO_FAIL = "ToFail"', 'TO_FAIL = "ToFail"\n    TO_NEW: str = "ToNew"'
+    )
+    messages = [f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")]
+    assert any("TO_NEW has no DECISION_STAGE row" in m for m in messages)
+
+
+def test_nx001_conflicting_delete_membership():
+    src = TAXONOMY_OK.replace(
+        "NON_DELETING_ACTIONS = frozenset({DecisionAction.TO_RUNNING})",
+        "NON_DELETING_ACTIONS = frozenset({DecisionAction.TO_RUNNING, DecisionAction.TO_FAIL})",
+    )
+    messages = [f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")]
+    assert any("both DELETES_JOB and" in m for m in messages)
+
+
+def test_nx001_stale_table_entry():
+    src = TAXONOMY_OK + "\nDECISION_STAGE[DecisionAction.TO_RUNNING] = 'X'\n"
+    src = src.replace('    TO_RUNNING = "ToRunning"\n', "")
+    messages = [f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")]
+    assert any("references unknown DecisionAction.TO_RUNNING" in m for m in messages)
+
+
+def test_nx001_ignores_modules_elsewhere():
+    src = "class DecisionAction:\n    ORPHAN = 'x'\n"
+    assert lint_source(src, "NX001", rel_path="pkg/other.py") == []
+
+
+# -- NX002 schema drift --------------------------------------------------------
+
+SCHEMA_OK = """\
+-- comment with a semicolon; should not matter
+create table if not exists nexus.checkpoints
+(
+    algorithm  text,
+    id         text,
+    tag        text,
+    PRIMARY KEY ((algorithm, id))
+);
+create index if not exists t ON nexus.checkpoints (tag);
+"""
+
+MODELS_OK = """
+from dataclasses import dataclass
+
+@dataclass
+class CheckpointedRequest:
+    algorithm: str
+    id: str
+    tag: str = ""
+"""
+
+STORE_OK = """
+_COLUMNS = ["algorithm", "id", "tag"]
+"""
+
+CQL_OK = """
+class Store:
+    def upsert_checkpoint(self, cp):
+        values = {"algorithm": cp.algorithm, "id": cp.id, "tag": cp.tag}
+        return values
+"""
+
+
+def _schema_project(tmp_path, schema=SCHEMA_OK, models=MODELS_OK, store=STORE_OK, cql=CQL_OK):
+    pkg = tmp_path / "checkpoint"
+    pkg.mkdir()
+    (pkg / "schema.cql").write_text(schema)
+    (pkg / "models.py").write_text(textwrap.dedent(models))
+    (pkg / "store.py").write_text(textwrap.dedent(store))
+    (pkg / "cql.py").write_text(textwrap.dedent(cql))
+    rules = [r for r in all_rules() if r.rule_id == "NX002"]
+    return lint_paths([str(pkg)], root=str(tmp_path), rules=rules)
+
+
+def test_parse_schema_columns():
+    assert parse_schema_columns(SCHEMA_OK) == ["algorithm", "id", "tag"]
+
+
+def test_nx002_aligned_schema_passes(tmp_path):
+    assert _schema_project(tmp_path) == []
+
+
+def test_nx002_model_field_missing(tmp_path):
+    models = MODELS_OK.replace('    tag: str = ""\n', "")
+    messages = [f.message for f in _schema_project(tmp_path, models=models)]
+    assert any("schema column 'tag' has no CheckpointedRequest field" in m for m in messages)
+
+
+def test_nx002_upsert_and_columns_drift(tmp_path):
+    store = '_COLUMNS = ["algorithm", "id", "tag", "ghost"]'
+    cql = CQL_OK.replace('"tag": cp.tag', '"renamed": cp.tag')
+    messages = [f.message for f in _schema_project(tmp_path, store=store, cql=cql)]
+    assert any("'ghost' has no schema.cql column" in m for m in messages)
+    assert any("schema column 'tag' not written by upsert_checkpoint" in m for m in messages)
+    assert any("writes 'renamed' which is not a schema.cql column" in m for m in messages)
+
+
+def test_nx002_missing_upsert_dict_fails_closed(tmp_path):
+    cql = """
+    class Store:
+        def upsert_checkpoint(self, cp):
+            row = {"algorithm": cp.algorithm, "id": cp.id, "tag": cp.tag}
+            return row
+    """
+    messages = [f.message for f in _schema_project(tmp_path, cql=cql)]
+    assert any("statement parity unverifiable" in m for m in messages)
+
+
+# -- NX003 broad except --------------------------------------------------------
+
+
+def test_nx003_unjustified_broad_except():
+    src = """
+    try:
+        pass
+    except Exception as exc:
+        raise
+    """
+    findings = lint_source(src, "NX003")
+    assert len(findings) == 1 and "BLE001" in findings[0].message
+
+
+def test_nx003_bare_except_flagged():
+    src = """
+    try:
+        pass
+    except:
+        pass
+    """
+    assert len(lint_source(src, "NX003")) == 1
+
+
+def test_nx003_justified_and_narrow_pass():
+    src = """
+    try:
+        pass
+    except Exception:  # noqa: BLE001 - teardown must not block re-init
+        pass
+    try:
+        pass
+    except ValueError:
+        pass
+    """
+    assert lint_source(src, "NX003") == []
+
+
+def test_nx003_justification_on_wrapped_clause_line():
+    src = """
+    try:
+        pass
+    except (RuntimeError,
+            Exception):  # noqa: BLE001 - wrapped by the formatter
+        pass
+    """
+    assert lint_source(src, "NX003") == []
+
+
+# -- NX010 host sync in traced code --------------------------------------------
+
+
+def test_nx010_item_in_jit_flagged():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        return x.item()
+    """
+    findings = lint_source(src, "NX010")
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+
+def test_nx010_float_cast_of_traced_value():
+    src = """
+    import jax
+
+    def step(state, batch):
+        loss = compute(state, batch)
+        log(float(loss))
+        return loss
+
+    step_fn = jax.jit(step, donate_argnums=(0,))
+    """
+    findings = lint_source(src, "NX010")
+    assert len(findings) == 1 and "float()" in findings[0].message
+
+
+def test_nx010_print_and_np_array_in_shard_map_body():
+    src = """
+    from tpu_nexus.parallel.smap import shard_map_compat
+    import numpy as np
+
+    def body(x):
+        print(x)
+        return np.array(x)
+
+    fn = shard_map_compat(body, mesh=None, in_specs=(), out_specs=())
+    """
+    messages = [f.message for f in lint_source(src, "NX010")]
+    assert any("print under trace" in m for m in messages)
+    assert any("np.array()" in m for m in messages)
+
+
+def test_nx010_static_shape_math_and_host_code_pass():
+    src = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def f(x, cfg):
+        b = int(x.shape[0])
+        scale = float(cfg.lr) * b
+        return x * scale
+
+    def host_loop(x):
+        # untraced: host syncs are fine here
+        print(x.item(), np.array(x))
+    """
+    assert lint_source(src, "NX010") == []
+
+
+def test_nx010_scalar_annotated_param_is_static():
+    src = """
+    import jax
+    from typing import Optional
+
+    @jax.jit
+    def f(x, scale: Optional[float] = None):
+        s = float(scale or 1.0)
+        return x * s
+    """
+    assert lint_source(src, "NX010") == []
+
+
+def test_nx010_transitively_called_helper_is_traced():
+    src = """
+    import jax
+
+    def helper(x):
+        return x.item()
+
+    def outer(x):
+        return helper(x)
+
+    fn = jax.jit(outer)
+    """
+    assert len(lint_source(src, "NX010")) == 1
+
+
+def test_nx010_same_named_nested_helpers_resolve_lexically():
+    """`def step` inside every builder is the dominant JAX pattern: the
+    traced one must be flagged, the host-only one must not."""
+    src = """
+    import jax
+
+    def outer_host(x):
+        def step(v):
+            return float(v)
+        return step(x)
+
+    def outer_traced(xs):
+        def step(c, x):
+            bad = x.item()
+            return c + bad, bad
+        return jax.lax.scan(step, 0.0, xs)
+    """
+    findings = lint_source(src, "NX010")
+    assert len(findings) == 1 and ".item()" in findings[0].message
+
+
+def test_nx010_augassign_keeps_taint():
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        acc = x
+        acc += 1
+        return float(acc)
+    """
+    findings = lint_source(src, "NX010")
+    assert len(findings) == 1 and "float()" in findings[0].message
+
+
+def test_cli_write_baseline_ignores_old_baseline(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    old = tmp_path / "old.json"
+    new = tmp_path / "new.json"
+    assert nxlint_main([str(dirty), "--root", str(tmp_path), "--write-baseline", str(old)]) == 0
+    # rewriting a baseline while one is loaded must still snapshot ALL
+    # current findings, not just the residual ones
+    assert nxlint_main(
+        [str(dirty), "--root", str(tmp_path), "--baseline", str(old), "--write-baseline", str(new)]
+    ) == 0
+    capsys.readouterr()
+    assert load_baseline(str(new)) == load_baseline(str(old))
+    assert nxlint_main([str(dirty), "--root", str(tmp_path), "--baseline", str(new)]) == 0
+
+
+# -- NX011 PRNG key reuse ------------------------------------------------------
+
+
+def test_nx011_double_consumption_flagged():
+    src = """
+    import jax
+
+    def f(key):
+        a = jax.random.normal(key, (2,))
+        b = jax.random.uniform(key, (2,))
+        return a + b
+    """
+    findings = lint_source(src, "NX011")
+    assert len(findings) == 1 and "'key' already consumed" in findings[0].message
+
+
+def test_nx011_split_rebind_passes():
+    src = """
+    import jax
+
+    def f(key):
+        key, sub = jax.random.split(key)
+        a = jax.random.normal(sub, (2,))
+        key, sub = jax.random.split(key)
+        b = jax.random.uniform(sub, (2,))
+        return a + b
+    """
+    assert lint_source(src, "NX011") == []
+
+
+def test_nx011_branches_are_alternatives():
+    src = """
+    import jax
+
+    def f(key, flag):
+        if flag:
+            return jax.random.normal(key, (2,))
+        else:
+            return jax.random.uniform(key, (2,))
+    """
+    assert lint_source(src, "NX011") == []
+
+
+def test_nx011_loop_reuse_flagged():
+    src = """
+    import jax
+
+    def f(key, n):
+        out = []
+        for _ in range(n):
+            out.append(jax.random.normal(key, (2,)))
+        return out
+    """
+    assert len(lint_source(src, "NX011")) == 1
+
+
+def test_nx011_fold_in_base_key_is_reusable():
+    src = """
+    import jax
+
+    def f(key, steps):
+        outs = []
+        for i in range(steps):
+            k = jax.random.fold_in(key, i)
+            outs.append(jax.random.normal(k, (2,)))
+        return outs
+    """
+    assert lint_source(src, "NX011") == []
+
+
+# -- NX012 mesh axis literals --------------------------------------------------
+
+
+def test_nx012_unknown_axis_literal_flagged():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("dp", "bogus")
+    """
+    findings = lint_source(
+        src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)]
+    )
+    assert len(findings) == 1 and "'bogus'" in findings[0].message
+
+
+def test_nx012_axis_name_kwarg_checked():
+    src = """
+    import jax
+
+    def body(x):
+        return jax.lax.psum(x, axis_name="spp")
+    """
+    findings = lint_source(src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)])
+    assert len(findings) == 1 and "'spp'" in findings[0].message
+
+
+def test_nx012_canonical_axes_pass():
+    src = """
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(("dp", "fsdp"), "sp", None)
+    other = P()
+    """
+    assert lint_source(src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)]) == []
+
+
+def test_nx012_silent_without_mesh_module():
+    assert lint_source('spec = P("bogus")', "NX012") == []
+
+
+# -- the tier-1 gate -----------------------------------------------------------
+
+
+def test_collect_modules_raises_on_missing_path(tmp_path):
+    import pytest as _pytest
+
+    with _pytest.raises(FileNotFoundError):
+        lint_paths([str(tmp_path / "nope")], root=str(tmp_path))
+
+
+def test_repo_tree_is_clean():
+    """`python -m tools.nxlint tpu_nexus/` must exit 0 on the shipped tree
+    — and must actually have scanned it (a vacuous zero-file pass would
+    also report zero findings)."""
+    from tools.nxlint.engine import collect_modules
+
+    modules = collect_modules([os.path.join(REPO_ROOT, "tpu_nexus")], REPO_ROOT)
+    assert len(modules) > 40, "gate scanned suspiciously few files"
+    findings = lint_project(Project(REPO_ROOT, modules))
+    rendered = "\n".join(f.render() for f in findings)
+    assert findings == [], f"nxlint found unsuppressed issues:\n{rendered}"
+
+
+def test_tools_tree_is_clean():
+    """The analyzer holds itself and the repo tooling to the same bar."""
+    from tools.nxlint.engine import collect_modules
+
+    modules = collect_modules([os.path.join(REPO_ROOT, "tools")], REPO_ROOT)
+    assert len(modules) >= 6, "gate scanned suspiciously few files"
+    findings = lint_project(Project(REPO_ROOT, modules))
+    rendered = "\n".join(f.render() for f in findings)
+    assert findings == [], f"nxlint found unsuppressed issues:\n{rendered}"

@@ -53,3 +53,74 @@ def test_failure_writes_trace_and_ledger_ref(tmp_path, monkeypatch):
     cp = store.read_checkpoint(CTX.algorithm, CTX.run_id)
     assert cp.hlo_trace_ref == ref
     assert cp.lifecycle_stage == LifecycleStage.RUNNING
+
+
+# -- classification precedence + message totality (pure taxonomy units) --------
+
+def test_classify_precedence_on_combined_traces():
+    """preempt > ICI > HBM OOM > compile abort: infrastructure causes win
+    over program causes when one trace carries several signatures."""
+    from tpu_nexus.supervisor.taxonomy import DecisionAction
+
+    preempt = "node shutdown: spot reclaim"
+    ici = "ICI link down on chip 3"
+    oom = "RESOURCE_EXHAUSTED: HBM OOM while allocating"
+    compile_ = "XLA compilation error: Mosaic lowering failed"
+
+    everything = "\n".join([compile_, oom, ici, preempt])
+    assert classify_tpu_failure(everything) == DecisionAction.TO_PREEMPT_RESTARTABLE
+    assert (
+        classify_tpu_failure("\n".join([compile_, oom, ici]))
+        == DecisionAction.TO_FAIL_ICI_LINK_DOWN
+    )
+    assert (
+        classify_tpu_failure("\n".join([compile_, oom]))
+        == DecisionAction.TO_FAIL_HBM_OOM
+    )
+    assert classify_tpu_failure(compile_) == DecisionAction.TO_FAIL_COMPILE_ABORT
+    assert classify_tpu_failure("") is None
+    assert classify_tpu_failure("container exited 1: assertion failed") is None
+
+
+@pytest.mark.parametrize(
+    "text,expected",
+    [
+        ("dump at gs://bucket/run/module_0001.hlo end", "gs://bucket/run/module_0001.hlo"),
+        ("see s3://b/trace.pbtxt for details", "s3://b/trace.pbtxt"),
+        ("profiler wrote file:///tmp/t/plugins/profile/run.xplane.pb", "file:///tmp/t/plugins/profile/run.xplane.pb"),
+        ("proto at gs://bucket/mod.pb trailing", "gs://bucket/mod.pb"),
+        ("no refs in this message", ""),
+        ("http://bucket/mod.hlo is not an object-store scheme", ""),
+    ],
+)
+def test_extract_hlo_trace_ref_uris(text, expected):
+    assert extract_hlo_trace_ref(text) == expected
+
+
+def test_tpu_message_total_over_all_decisions():
+    """Regression for the `_tpu_message` totality hazard: every decision has
+    a reachable human message, and an unknown action raises a descriptive
+    error instead of a bare KeyError (nxlint NX001 guards this thereafter)."""
+    from tpu_nexus.supervisor.taxonomy import (
+        ACTION_MESSAGES,
+        DECISION_STAGE,
+        DELETES_JOB,
+        NON_DELETING_ACTIONS,
+        DecisionAction,
+        _tpu_message,
+    )
+
+    actions = {
+        value
+        for name, value in vars(DecisionAction).items()
+        if name.isupper() and isinstance(value, str)
+    }
+    assert actions == set(ACTION_MESSAGES)
+    assert actions == set(DECISION_STAGE)
+    assert actions == (DELETES_JOB | NON_DELETING_ACTIONS)
+    assert not (DELETES_JOB & NON_DELETING_ACTIONS)
+    for action in actions:
+        assert _tpu_message(action) == ACTION_MESSAGES[action]
+
+    with pytest.raises(ValueError, match="ToBrandNew.*ACTION_MESSAGES"):
+        _tpu_message("ToBrandNew")

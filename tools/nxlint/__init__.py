@@ -1,0 +1,40 @@
+"""nxlint — repo-native static analysis for tpu-nexus.
+
+The reference supervisor leans on Go's compiler to keep its control plane
+honest; this reproduction is dynamic Python, so the equivalent invariants
+(decision-taxonomy totality, CQL schema <-> model parity, tracing-safe JAX
+hot paths) are enforced here instead.  Rule catalog and suppression syntax:
+docs/STATIC_ANALYSIS.md.
+
+Usage:  python -m tools.nxlint tpu_nexus/
+"""
+
+from tools.nxlint.engine import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    RuleVisitor,
+    all_rules,
+    lint_paths,
+    lint_project,
+    load_baseline,
+    register,
+)
+
+# importing the rule modules populates the registry
+from tools.nxlint import rules_control  # noqa: F401
+from tools.nxlint import rules_tracing  # noqa: F401
+
+__all__ = [
+    "Finding",
+    "Module",
+    "Project",
+    "Rule",
+    "RuleVisitor",
+    "all_rules",
+    "lint_paths",
+    "lint_project",
+    "load_baseline",
+    "register",
+]

@@ -1,0 +1,105 @@
+"""CLI driver.
+
+    python -m tools.nxlint tpu_nexus/            # human output, exit 0/1
+    python -m tools.nxlint --json tpu_nexus/     # machine output
+    python -m tools.nxlint --write-baseline nxlint-baseline.json tpu_nexus/
+    python -m tools.nxlint --baseline nxlint-baseline.json tpu_nexus/
+
+Exit-code contract (same as tools/check_coverage.py): 0 clean, 1 findings,
+2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tools.nxlint.engine import (
+    all_rules,
+    collect_modules,
+    lint_project,
+    load_baseline,
+    write_baseline,
+    Project,
+)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.nxlint",
+        description="repo-native static analysis for tpu-nexus",
+    )
+    parser.add_argument("paths", nargs="*", default=["tpu_nexus"], help="files/dirs to lint")
+    parser.add_argument("--root", default=".", help="repo root findings are relative to")
+    parser.add_argument("--json", action="store_true", dest="as_json", help="JSON output")
+    parser.add_argument("--baseline", help="ignore findings fingerprinted in this file")
+    parser.add_argument(
+        "--write-baseline",
+        metavar="FILE",
+        help="write current findings as the new baseline and exit 0",
+    )
+    parser.add_argument(
+        "--select",
+        help="comma-separated rule ids to run (default: all)",
+    )
+    parser.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+
+    rules = all_rules()
+    if args.list_rules:
+        for rule in rules:
+            print(f"{rule.rule_id}  {rule.description}")
+        return 0
+    if args.select:
+        wanted = {r.strip() for r in args.select.split(",") if r.strip()}
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.rule_id in wanted]
+
+    paths = args.paths or ["tpu_nexus"]
+    for path in paths:
+        if not os.path.exists(path):
+            print(f"no such path: {path}", file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline:
+        if not os.path.isfile(args.baseline):
+            print(f"baseline file not found: {args.baseline}", file=sys.stderr)
+            return 2
+        baseline = load_baseline(args.baseline)
+
+    project = Project(args.root, collect_modules(paths, args.root))
+
+    if args.write_baseline:
+        # a baseline snapshot must cover ALL current findings — applying an
+        # old baseline here would drop still-present grandfathered findings
+        # and resurface them on the next run
+        full = lint_project(project, rules=rules)
+        write_baseline(args.write_baseline, full)
+        print(f"wrote {len(full)} finding(s) to baseline {args.write_baseline}")
+        return 0
+
+    findings = lint_project(project, rules=rules, baseline=baseline)
+
+    if args.as_json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for finding in findings:
+            print(finding.render())
+        suffix = " (baseline applied)" if baseline else ""
+        print(
+            f"nxlint: {len(findings)} finding(s) in {len(project.modules)} file(s){suffix}"
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""nxlint core: findings, suppressions, baselines, the rule registry and
+the project/module model rules run against.
+
+Design mirrors the shape of a go/analysis pass: a ``Rule`` sees either one
+parsed module at a time (``check_module``) or the whole scanned project
+(``check_project``) for cross-file invariants, and yields ``Finding``s.
+The driver handles everything else — per-line ``# nxlint: disable=RULE``
+suppressions, baseline files (adopt-a-legacy-tree workflow), output
+formatting and the exit-code contract (0 clean / 1 findings / 2 usage
+error, same contract as tools/check_coverage.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from collections import Counter
+from dataclasses import asdict, dataclass
+from typing import Dict, Iterable, Iterator, List, Mapping, Optional, Sequence, Type
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+#: per-line suppression:  ``x = 1  # nxlint: disable=NX001`` (comma-separated
+#: rule ids, or ``all``), optionally followed by a rationale.  The id list
+#: ends at the first non-id word so ``disable=NX010 static by construction``
+#: still suppresses NX010.
+_SUPPRESS_RE = re.compile(
+    r"#\s*nxlint:\s*disable=([A-Za-z0-9_]+(?:\s*,\s*[A-Za-z0-9_]+)*)"
+)
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic: where, which rule, and a message precise enough that
+    (file, rule, message) identifies the problem across line renumbering —
+    that triple is the baseline fingerprint."""
+
+    file: str
+    line: int
+    col: int
+    rule_id: str
+    severity: str
+    message: str
+
+    def fingerprint(self) -> str:
+        raw = f"{self.file}::{self.rule_id}::{self.message}"
+        return hashlib.sha256(raw.encode("utf-8")).hexdigest()[:16]
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule_id} [{self.severity}] {self.message}"
+
+    def to_json(self) -> Dict[str, object]:
+        out = asdict(self)
+        out["fingerprint"] = self.fingerprint()
+        return out
+
+
+class Module:
+    """One parsed python file."""
+
+    def __init__(self, path: str, rel_path: str, source: str) -> None:
+        self.path = path
+        #: repo-relative posix path — what findings and baselines carry
+        self.rel_path = rel_path.replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree: Optional[ast.Module] = None
+        self.parse_error: Optional[SyntaxError] = None
+        try:
+            self.tree = ast.parse(source, filename=rel_path)
+        except SyntaxError as exc:
+            self.parse_error = exc
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed_rules(self, lineno: int) -> frozenset:
+        m = _SUPPRESS_RE.search(self.line_text(lineno))
+        if not m:
+            return frozenset()
+        return frozenset(part.strip() for part in m.group(1).split(",") if part.strip())
+
+    def is_suppressed(self, finding: Finding) -> bool:
+        rules = self.suppressed_rules(finding.line)
+        return finding.rule_id in rules or "all" in rules
+
+
+class Project:
+    """All modules of one lint run plus the root they were collected under
+    (cross-file rules locate their targets by path suffix)."""
+
+    def __init__(self, root: str, modules: Sequence[Module]) -> None:
+        self.root = root
+        self.modules = list(modules)
+        self._by_rel = {m.rel_path: m for m in self.modules}
+
+    def find_module(self, path_suffix: str) -> Optional[Module]:
+        suffix = path_suffix.replace(os.sep, "/")
+        exact = self._by_rel.get(suffix)
+        if exact is not None:
+            return exact
+        for module in self.modules:
+            if module.rel_path.endswith("/" + suffix):
+                return module
+        return None
+
+    def read_sibling(self, module: Module, filename: str) -> Optional[str]:
+        """Non-python artifact (schema.cql) next to a scanned module."""
+        candidate = os.path.join(os.path.dirname(module.path), filename)
+        if not os.path.isfile(candidate):
+            return None
+        with open(candidate, "r", encoding="utf-8") as fh:
+            return fh.read()
+
+
+class Rule:
+    """Base class: subclass, set the class attributes, override one of the
+    two hooks, and ``@register`` it."""
+
+    rule_id: str = "NX000"
+    severity: str = SEVERITY_ERROR
+    description: str = ""
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        return iter(())
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        return iter(())
+
+    def finding(self, module: Module, node: ast.AST, message: str) -> Finding:
+        return Finding(
+            file=module.rel_path,
+            line=getattr(node, "lineno", 1),
+            col=getattr(node, "col_offset", 0),
+            rule_id=self.rule_id,
+            severity=self.severity,
+            message=message,
+        )
+
+
+class RuleVisitor(ast.NodeVisitor):
+    """Visitor base for module rules: carries the module and collects
+    findings via ``report``."""
+
+    def __init__(self, rule: Rule, module: Module) -> None:
+        self.rule = rule
+        self.module = module
+        self.findings: List[Finding] = []
+
+    def report(self, node: ast.AST, message: str) -> None:
+        self.findings.append(self.rule.finding(self.module, node, message))
+
+
+_REGISTRY: Dict[str, Rule] = {}
+
+
+def register(rule_cls: Type[Rule]) -> Type[Rule]:
+    rule = rule_cls()
+    if rule.rule_id in _REGISTRY:
+        raise ValueError(f"duplicate rule id {rule.rule_id}")
+    _REGISTRY[rule.rule_id] = rule
+    return rule_cls
+
+
+def all_rules() -> List[Rule]:
+    return [_REGISTRY[k] for k in sorted(_REGISTRY)]
+
+
+# -- driver --------------------------------------------------------------------
+
+
+def collect_modules(paths: Sequence[str], root: str) -> List[Module]:
+    files: List[str] = []
+    for path in paths:
+        if not os.path.exists(path):
+            # fail loud: a typo'd path must not make a gate pass vacuously
+            # with zero files scanned
+            raise FileNotFoundError(f"nxlint: no such path: {path}")
+        if os.path.isfile(path):
+            files.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(
+                d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+            )
+            files.extend(
+                os.path.join(dirpath, f) for f in sorted(filenames) if f.endswith(".py")
+            )
+    modules = []
+    seen = set()
+    for path in files:
+        abs_path = os.path.abspath(path)
+        if abs_path in seen:  # overlapping path args must not double-lint
+            continue
+        seen.add(abs_path)
+        rel = os.path.relpath(abs_path, os.path.abspath(root))
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            # keep the 0/1/2 exit contract: surface as an NX000 finding
+            # instead of a traceback
+            module = Module(path, rel, "")
+            module.parse_error = SyntaxError(f"unreadable file: {exc}")
+            modules.append(module)
+            continue
+        modules.append(Module(path, rel, source))
+    return modules
+
+
+def lint_project(
+    project: Project,
+    rules: Optional[Iterable[Rule]] = None,
+    baseline: Optional[Mapping] = None,
+) -> List[Finding]:
+    """Run rules over the project; suppressed and baselined findings are
+    dropped here so callers only ever see actionable ones."""
+    rules = list(rules) if rules is not None else all_rules()
+    findings: List[Finding] = []
+    for module in project.modules:
+        if module.parse_error is not None:
+            findings.append(
+                Finding(
+                    file=module.rel_path,
+                    line=module.parse_error.lineno or 1,
+                    col=module.parse_error.offset or 0,
+                    rule_id="NX000",
+                    severity=SEVERITY_ERROR,
+                    message=f"syntax error: {module.parse_error.msg}",
+                )
+            )
+            continue
+        for rule in rules:
+            for finding in rule.check_module(module):
+                if not module.is_suppressed(finding):
+                    findings.append(finding)
+    for rule in rules:
+        for finding in rule.check_project(project):
+            module = project.find_module(finding.file)
+            if module is not None and module.is_suppressed(finding):
+                continue
+            findings.append(finding)
+    findings = sorted(findings, key=lambda f: (f.file, f.line, f.col, f.rule_id))
+    if baseline:
+        # occurrence-counted: baselining ONE `except Exception` in a file
+        # must not grandfather a second identical one added later (the
+        # fingerprint is (file, rule, message), which repeats)
+        allowance = Counter(
+            dict(baseline) if isinstance(baseline, Mapping) else list(baseline)
+        )
+        kept = []
+        for finding in findings:
+            fp = finding.fingerprint()
+            if allowance.get(fp, 0) > 0:
+                allowance[fp] -= 1
+            else:
+                kept.append(finding)
+        findings = kept
+    return findings
+
+
+def lint_paths(
+    paths: Sequence[str],
+    root: str = ".",
+    rules: Optional[Iterable[Rule]] = None,
+    baseline: Optional[Mapping] = None,
+) -> List[Finding]:
+    project = Project(root, collect_modules(paths, root))
+    return lint_project(project, rules=rules, baseline=baseline)
+
+
+# -- baseline files ------------------------------------------------------------
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Fingerprint -> how many occurrences the baseline grandfathers."""
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    return Counter(entry["fingerprint"] for entry in data.get("findings", []))
+
+
+def write_baseline(path: str, findings: Sequence[Finding]) -> None:
+    data = {"findings": [f.to_json() for f in findings]}
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")

@@ -1,0 +1,357 @@
+"""Control-plane rules: the invariants Go's compiler enforced for the
+reference supervisor, re-stated over this repo's Python control plane.
+
+NX001  decision-taxonomy totality (supervisor/taxonomy.py)
+NX002  CQL schema <-> model <-> statement parity (checkpoint/*)
+NX003  broad except without a ``# noqa: BLE001 - <reason>`` justification
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set
+
+from tools.nxlint.engine import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    RuleVisitor,
+    register,
+)
+
+TAXONOMY_PATH = "supervisor/taxonomy.py"
+MODELS_PATH = "checkpoint/models.py"
+CQL_PATH = "checkpoint/cql.py"
+STORE_PATH = "checkpoint/store.py"
+SCHEMA_FILE = "schema.cql"
+
+
+def _attr_names(node: ast.AST, owner: str) -> Set[str]:
+    """``{owner}.X`` attribute references directly inside a container node."""
+    names = set()
+    for child in ast.walk(node):
+        if (
+            isinstance(child, ast.Attribute)
+            and isinstance(child.value, ast.Name)
+            and child.value.id == owner
+        ):
+            names.add(child.attr)
+    return names
+
+
+def _module_assign(tree: ast.Module, name: str) -> Optional[ast.AST]:
+    """Module-level ``name = value`` / ``name: T = value`` -> the value node."""
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    return stmt.value
+        elif isinstance(stmt, ast.AnnAssign):
+            if isinstance(stmt.target, ast.Name) and stmt.target.id == name:
+                return stmt.value
+    return None
+
+
+@register
+class TaxonomyTotalityRule(Rule):
+    """NX001: every ``DecisionAction`` constant must have a ``DECISION_STAGE``
+    row, an ``ACTION_MESSAGES`` human message, and belong to exactly one of
+    ``DELETES_JOB`` / ``NON_DELETING_ACTIONS``.  An unmapped action is the
+    bug class where event classification raises KeyError mid-incident."""
+
+    rule_id = "NX001"
+    description = "decision taxonomy must be total over DecisionAction constants"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        module = project.find_module(TAXONOMY_PATH)
+        if module is None or module.tree is None:
+            return
+        class_node = next(
+            (
+                n
+                for n in module.tree.body
+                if isinstance(n, ast.ClassDef) and n.name == "DecisionAction"
+            ),
+            None,
+        )
+        if class_node is None:
+            yield self.finding(
+                module, module.tree, "DecisionAction class not found in taxonomy module"
+            )
+            return
+        constants: Dict[str, ast.AST] = {}
+        for stmt in class_node.body:
+            if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Constant):
+                targets = stmt.targets
+            elif (
+                isinstance(stmt, ast.AnnAssign)  # TO_NEW: str = "ToNew"
+                and isinstance(stmt.value, ast.Constant)
+            ):
+                targets = [stmt.target]
+            else:
+                continue
+            for target in targets:
+                if isinstance(target, ast.Name) and target.id.isupper():
+                    constants[target.id] = stmt
+
+        tables = {}
+        for table in ("DECISION_STAGE", "ACTION_MESSAGES", "DELETES_JOB", "NON_DELETING_ACTIONS"):
+            value = _module_assign(module.tree, table)
+            if value is None:
+                yield self.finding(
+                    module, module.tree, f"required taxonomy table {table} not found"
+                )
+                continue
+            if table in ("DECISION_STAGE", "ACTION_MESSAGES"):
+                members: Set[str] = set()
+                if isinstance(value, ast.Dict):
+                    for key in value.keys:
+                        if key is not None:
+                            members |= _attr_names(key, "DecisionAction")
+            else:
+                members = _attr_names(value, "DecisionAction")
+            tables[table] = (value, members)
+
+        for name, node in sorted(constants.items()):
+            if "DECISION_STAGE" in tables and name not in tables["DECISION_STAGE"][1]:
+                yield self.finding(
+                    module, node, f"DecisionAction.{name} has no DECISION_STAGE row"
+                )
+            if "ACTION_MESSAGES" in tables and name not in tables["ACTION_MESSAGES"][1]:
+                yield self.finding(
+                    module,
+                    node,
+                    f"DecisionAction.{name} has no human message in ACTION_MESSAGES",
+                )
+            if "DELETES_JOB" in tables and "NON_DELETING_ACTIONS" in tables:
+                deleting = name in tables["DELETES_JOB"][1]
+                non_deleting = name in tables["NON_DELETING_ACTIONS"][1]
+                if not deleting and not non_deleting:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"DecisionAction.{name} is in neither DELETES_JOB nor "
+                        "NON_DELETING_ACTIONS (delete behavior undeclared)",
+                    )
+                elif deleting and non_deleting:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"DecisionAction.{name} is in both DELETES_JOB and "
+                        "NON_DELETING_ACTIONS",
+                    )
+
+        # stale rows: table members that no longer name a constant
+        for table, payload in tables.items():
+            value, members = payload
+            for member in sorted(members - set(constants)):
+                yield self.finding(
+                    module,
+                    value,
+                    f"{table} references unknown DecisionAction.{member}",
+                )
+
+
+_CQL_COLUMN_RE = re.compile(r"^\s*([a-z_][a-z0-9_]*)\s+[a-z<]")
+
+
+def parse_schema_columns(schema_cql: str) -> List[str]:
+    """Column names of the ``create table`` block: lines between the opening
+    paren and PRIMARY KEY, comments stripped."""
+    columns: List[str] = []
+    in_table = False
+    for raw in schema_cql.splitlines():
+        line = raw.split("--", 1)[0].rstrip()
+        lowered = line.strip().lower()
+        if not in_table:
+            if lowered.startswith("create table"):
+                in_table = True
+            continue
+        if lowered.startswith("primary key") or lowered.startswith(")"):
+            in_table = False
+            continue
+        m = _CQL_COLUMN_RE.match(line)
+        if m:
+            columns.append(m.group(1))
+    return columns
+
+
+@register
+class SchemaDriftRule(Rule):
+    """NX002: schema.cql columns == CheckpointedRequest fields ==
+    store._COLUMNS == the upsert statement's column dict.  CQL upserts write
+    the full row, so one stray field name means every write fails (or worse:
+    silently drops a column) at runtime against a real cluster."""
+
+    rule_id = "NX002"
+    description = "CQL schema, dataclass model and statements must agree column-for-column"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        models = project.find_module(MODELS_PATH)
+        if models is None or models.tree is None:
+            return
+        schema_text = project.read_sibling(models, SCHEMA_FILE)
+        if schema_text is None:
+            yield self.finding(
+                models, models.tree, f"{SCHEMA_FILE} not found next to {models.rel_path}"
+            )
+            return
+        schema_cols = set(parse_schema_columns(schema_text))
+        if not schema_cols:
+            yield self.finding(
+                models, models.tree, f"no columns parsed from {SCHEMA_FILE}"
+            )
+            return
+
+        class_node = next(
+            (
+                n
+                for n in models.tree.body
+                if isinstance(n, ast.ClassDef) and n.name == "CheckpointedRequest"
+            ),
+            None,
+        )
+        if class_node is None:
+            yield self.finding(models, models.tree, "CheckpointedRequest class not found")
+            return
+        fields = {
+            stmt.target.id
+            for stmt in class_node.body
+            if isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name)
+        }
+        for name in sorted(schema_cols - fields):
+            yield self.finding(
+                models,
+                class_node,
+                f"schema column '{name}' has no CheckpointedRequest field",
+            )
+        for name in sorted(fields - schema_cols):
+            yield self.finding(
+                models,
+                class_node,
+                f"CheckpointedRequest field '{name}' has no schema.cql column",
+            )
+
+        store = project.find_module(STORE_PATH)
+        if store is not None and store.tree is not None:
+            value = _module_assign(store.tree, "_COLUMNS")
+            if isinstance(value, (ast.List, ast.Tuple)):
+                cols = {
+                    e.value
+                    for e in value.elts
+                    if isinstance(e, ast.Constant) and isinstance(e.value, str)
+                }
+                for name in sorted(schema_cols - cols):
+                    yield self.finding(
+                        store, value, f"schema column '{name}' missing from _COLUMNS"
+                    )
+                for name in sorted(cols - schema_cols):
+                    yield self.finding(
+                        store, value, f"_COLUMNS entry '{name}' has no schema.cql column"
+                    )
+
+        cql = project.find_module(CQL_PATH)
+        if cql is not None and cql.tree is not None:
+            upsert_keys = self._upsert_keys(cql.tree)
+            if upsert_keys is None:
+                # fail CLOSED: a renamed `values` dict must not silently
+                # skip the statement-parity comparison
+                yield self.finding(
+                    cql,
+                    cql.tree,
+                    "could not locate the `values = {...}` column dict in "
+                    "upsert_checkpoint (statement parity unverifiable)",
+                )
+            else:
+                node, keys = upsert_keys
+                for name in sorted(schema_cols - keys):
+                    yield self.finding(
+                        cql,
+                        node,
+                        f"schema column '{name}' not written by upsert_checkpoint",
+                    )
+                for name in sorted(keys - schema_cols):
+                    yield self.finding(
+                        cql,
+                        node,
+                        f"upsert_checkpoint writes '{name}' which is not a schema.cql column",
+                    )
+
+    @staticmethod
+    def _upsert_keys(tree: ast.Module):
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) and node.name == "upsert_checkpoint":
+                for stmt in ast.walk(node):
+                    if (
+                        isinstance(stmt, ast.Assign)
+                        and any(
+                            isinstance(t, ast.Name) and t.id == "values"
+                            for t in stmt.targets
+                        )
+                        and isinstance(stmt.value, ast.Dict)
+                    ):
+                        keys = {
+                            k.value
+                            for k in stmt.value.keys
+                            if isinstance(k, ast.Constant) and isinstance(k.value, str)
+                        }
+                        return stmt, keys
+        return None
+
+
+_BLE_JUSTIFICATION_RE = re.compile(r"#\s*noqa:\s*BLE001\s*-\s*\S")
+
+
+class _BroadExceptVisitor(RuleVisitor):
+    _BROAD = ("Exception", "BaseException")
+
+    def _clause_text(self, node: ast.ExceptHandler) -> str:
+        """All source lines of the except clause itself (a wrapped tuple of
+        exception types spans several lines; the justification may sit on
+        any of them)."""
+        last = node.lineno
+        if node.type is not None:
+            last = getattr(node.type, "end_lineno", None) or node.lineno
+        return "\n".join(
+            self.module.line_text(line) for line in range(node.lineno, last + 1)
+        )
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if self._is_broad(node.type) and not _BLE_JUSTIFICATION_RE.search(
+            self._clause_text(node)
+        ):
+            what = "bare except" if node.type is None else f"except {ast.unparse(node.type)}"
+            self.report(
+                node,
+                f"{what} without a '# noqa: BLE001 - <reason>' justification",
+            )
+        self.generic_visit(node)
+
+    def _is_broad(self, type_node: Optional[ast.expr]) -> bool:
+        if type_node is None:
+            return True
+        if isinstance(type_node, ast.Name):
+            return type_node.id in self._BROAD
+        if isinstance(type_node, ast.Tuple):
+            return any(self._is_broad(e) for e in type_node.elts)
+        return False
+
+
+@register
+class BroadExceptRule(Rule):
+    """NX003: ``except Exception`` / bare ``except`` swallow the control
+    plane's own bugs; each one must carry the repo's documented
+    ``# noqa: BLE001 - <reason>`` annotation (convention: core/telemetry.py)
+    on the except line."""
+
+    rule_id = "NX003"
+    description = "broad except handlers must be justified inline"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        visitor = _BroadExceptVisitor(self, module)
+        visitor.visit(module.tree)
+        yield from visitor.findings

@@ -1,0 +1,620 @@
+"""JAX tracing-safety rules for the workload hot paths.
+
+NX010  host-sync ops inside traced (jit / shard_map / lax control-flow) code
+NX011  PRNG key consumed twice without an intervening split/rebind
+NX012  mesh-axis string literals that are not axes of parallel/mesh.py
+
+All three are syntactic approximations of dynamic properties; each carries
+a per-line ``# nxlint: disable=RULE`` escape hatch for the justified cases.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.nxlint.engine import (
+    Finding,
+    Module,
+    Project,
+    Rule,
+    RuleVisitor,
+    register,
+)
+
+MESH_PATH = "parallel/mesh.py"
+
+#: callables whose function-valued arguments run under a JAX trace.  Matched
+#: by terminal attribute/name, so ``jax.jit``, ``jit`` and ``jax.lax.scan``
+#: all resolve.
+_TRACING_ENTRY_POINTS = frozenset(
+    {
+        "jit",
+        "pjit",
+        "pmap",
+        "vmap",
+        "grad",
+        "value_and_grad",
+        "shard_map",
+        "shard_map_compat",
+        "scan",
+        "while_loop",
+        "fori_loop",
+        "cond",
+        "switch",
+        "checkpoint",
+        "remat",
+    }
+)
+
+_PARTIAL_NAMES = frozenset({"partial"})
+
+
+def _terminal_name(func: ast.expr) -> Optional[str]:
+    """``jax.lax.scan`` -> ``scan``; ``jit`` -> ``jit``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _is_tracing_decorator(dec: ast.expr) -> bool:
+    name = _terminal_name(dec)
+    if name in _TRACING_ENTRY_POINTS:
+        return True
+    if isinstance(dec, ast.Call):
+        inner = _terminal_name(dec.func)
+        if inner in _TRACING_ENTRY_POINTS:
+            return True
+        if inner in _PARTIAL_NAMES and dec.args:
+            return _terminal_name(dec.args[0]) in _TRACING_ENTRY_POINTS
+    return False
+
+
+class _FunctionIndex:
+    """Lexically-scoped function resolution for one module.
+
+    Names resolve from a reference site outward through the enclosing
+    function scopes to module level — two same-named nested helpers in
+    different functions (``def step`` inside every jitted builder, the
+    dominant JAX pattern) must not be conflated."""
+
+    def __init__(self, tree: ast.Module) -> None:
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        #: alias name -> (target function name, the assign node it was made at)
+        self.partial_aliases: Dict[str, Tuple[str, ast.AST]] = {}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)
+                and _terminal_name(node.value.func) in _PARTIAL_NAMES
+                and node.value.args
+                and isinstance(node.value.args[0], ast.Name)
+            ):
+                self.partial_aliases[node.targets[0].id] = (
+                    node.value.args[0].id,
+                    node,
+                )
+        self._local_defs_cache: Dict[int, Dict[str, ast.AST]] = {}
+
+    def all_functions(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield node
+
+    def _local_defs(self, scope: ast.AST) -> Dict[str, ast.AST]:
+        cached = self._local_defs_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        defs: Dict[str, ast.AST] = {}
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    defs.setdefault(child.name, child)
+                    continue  # don't descend into nested scopes
+                if isinstance(child, ast.ClassDef):
+                    continue
+                walk(child)
+
+        walk(scope)
+        self._local_defs_cache[id(scope)] = defs
+        return defs
+
+    def resolve(self, name: str, site: ast.AST) -> Optional[ast.AST]:
+        """The def node ``name`` refers to at ``site``, through at most one
+        ``partial`` alias; None for imports/builtins."""
+        alias = self.partial_aliases.get(name)
+        if alias is not None:
+            name, site = alias
+        node: Optional[ast.AST] = site
+        while node is not None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                found = self._local_defs(node).get(name)
+                if found is not None:
+                    return found
+            node = self.parents.get(node)
+        return None
+
+
+def traced_functions(tree: ast.Module) -> Set[ast.AST]:
+    """Function defs that run under a JAX trace: tracing decorators, or the
+    function (possibly through one ``partial`` alias) passed by name to a
+    tracing entry point — resolved lexically from the call site."""
+    index = _FunctionIndex(tree)
+    traced: Set[ast.AST] = set()
+    for node in index.all_functions():
+        if any(_is_tracing_decorator(d) for d in node.decorator_list):
+            traced.add(node)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _terminal_name(node.func) not in _TRACING_ENTRY_POINTS:
+            continue
+        for arg in node.args:
+            if isinstance(arg, ast.Name):
+                fn = index.resolve(arg.id, node)
+                if fn is not None:
+                    traced.add(fn)
+    # transitive closure: a function called by name from a traced body is
+    # itself traced (helpers like a sampler called inside a scanned body)
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(traced):
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+                    callee = index.resolve(node.func.id, node)
+                    if callee is not None and callee not in traced:
+                        traced.add(callee)
+                        changed = True
+    return traced
+
+
+#: attribute reads that yield static (trace-time) python values even when
+#: their base is a traced array — the taint sanitizers
+_STATIC_ATTRS = frozenset({"shape", "ndim", "size", "dtype"})
+#: attribute reads that yield another array view of a traced base
+_ARRAY_ATTRS = frozenset({"T", "mT", "real", "imag", "at"})
+#: annotations naming plain python scalars: such parameters are static
+#: arguments at trace time, not traced arrays
+_SCALAR_ANNOTATIONS = frozenset({"int", "float", "bool", "str", "bytes"})
+
+
+def _annotation_is_scalar(annotation: Optional[ast.expr]) -> bool:
+    if annotation is None:
+        return False
+    names = {
+        n.id if isinstance(n, ast.Name) else n.attr
+        for n in ast.walk(annotation)
+        if isinstance(n, (ast.Name, ast.Attribute))
+    }
+    if {"Array", "ArrayLike", "ndarray", "jax", "jnp"} & names:
+        return False
+    return bool(_SCALAR_ANNOTATIONS & {str(c.value) for c in ast.walk(annotation) if isinstance(c, ast.Constant)} | (_SCALAR_ANNOTATIONS & names))
+
+
+class _TaintTracker:
+    """Forward taint pass over a traced function: names flowing from
+    array-typed parameters are tainted; ``.shape``-style reads and ``len()``
+    sanitize.  ``float()``/``int()`` on a tainted expression is a host sync;
+    on clean (shape/config arithmetic) values it is trace-time constant
+    folding and fine."""
+
+    def __init__(self, fn: ast.AST) -> None:
+        self.tainted: Set[str] = set()
+        args = fn.args
+        for arg in list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs):
+            if not _annotation_is_scalar(arg.annotation):
+                self.tainted.add(arg.arg)
+        if args.vararg is not None:
+            self.tainted.add(args.vararg.arg)
+        if args.kwarg is not None:
+            self.tainted.add(args.kwarg.arg)
+
+    def expr_tainted(self, expr: ast.expr) -> bool:
+        if isinstance(expr, ast.Name):
+            return expr.id in self.tainted
+        if isinstance(expr, ast.Attribute):
+            # .shape/.ndim/... are static; .T/.at/... stay arrays; any other
+            # plain attribute read is a config/scalar access (cfg.n_experts)
+            if expr.attr in _STATIC_ATTRS:
+                return False
+            if expr.attr in _ARRAY_ATTRS:
+                return self.expr_tainted(expr.value)
+            return False
+        if isinstance(expr, ast.Call):
+            if _terminal_name(expr.func) == "len":
+                return False
+            parts: List[ast.expr] = list(expr.args) + [
+                kw.value for kw in expr.keywords
+            ]
+            if isinstance(expr.func, ast.Attribute):
+                # method call: x.reshape(...) carries x's taint
+                parts.append(expr.func.value)
+            return any(self.expr_tainted(p) for p in parts)
+        return any(
+            self.expr_tainted(c)
+            for c in ast.iter_child_nodes(expr)
+            if isinstance(c, ast.expr)
+        )
+
+    def bind(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            is_tainted = self.expr_tainted(stmt.iter)
+            names = _assigned_names_of_target(stmt.target)
+        else:
+            value = getattr(stmt, "value", None)
+            if not isinstance(value, ast.expr):
+                return
+            is_tainted = self.expr_tainted(value)
+            names = _assigned_names(stmt)
+            if isinstance(stmt, ast.AugAssign):
+                # `acc += 1` keeps acc's existing taint — the target is an
+                # operand, not a fresh binding
+                is_tainted = is_tainted or any(n in self.tainted for n in names)
+        for name in names:
+            if is_tainted:
+                self.tainted.add(name)
+            else:
+                self.tainted.discard(name)
+
+
+def _own_exprs(stmt: ast.stmt):
+    """The expressions belonging to this statement itself (not to statements
+    nested in its body/orelse/... blocks)."""
+    for _, value in ast.iter_fields(stmt):
+        if isinstance(value, ast.expr):
+            yield value
+        elif isinstance(value, list):
+            for item in value:
+                if isinstance(item, ast.expr):
+                    yield item
+                elif isinstance(item, (ast.withitem, ast.keyword)):
+                    yield from (
+                        v for _, v in ast.iter_fields(item) if isinstance(v, ast.expr)
+                    )
+
+
+def _child_blocks(stmt: ast.stmt):
+    for field in ("body", "orelse", "finalbody"):
+        block = getattr(stmt, field, None)
+        if isinstance(block, list) and block and isinstance(block[0], ast.stmt):
+            yield block
+    for handler in getattr(stmt, "handlers", []) or []:
+        yield handler.body
+
+
+class _HostSyncVisitor(RuleVisitor):
+    def __init__(self, rule: Rule, module: Module, taint: _TaintTracker) -> None:
+        super().__init__(rule, module)
+        self.taint = taint
+
+    def check_call(self, node: ast.Call) -> None:
+        func = node.func
+        if isinstance(func, ast.Attribute) and func.attr == "item" and not node.args:
+            self.report(node, "host sync under trace: .item() forces device->host transfer")
+        elif isinstance(func, ast.Name) and func.id in ("float", "int") and node.args:
+            if any(self.taint.expr_tainted(a) for a in node.args):
+                self.report(
+                    node,
+                    f"host sync under trace: {func.id}() cast concretizes a traced value",
+                )
+        elif isinstance(func, ast.Attribute) and func.attr in ("array", "asarray"):
+            base = func.value
+            # same taint gate as the casts: np.array([1.0]) over static
+            # values is trace-time constant construction, not a host sync
+            if (
+                isinstance(base, ast.Name)
+                and base.id in ("np", "numpy")
+                and any(self.taint.expr_tainted(a) for a in node.args)
+            ):
+                self.report(
+                    node,
+                    f"host sync under trace: {base.id}.{func.attr}() materializes on host",
+                )
+        elif _terminal_name(func) == "device_get":
+            self.report(node, "host sync under trace: jax.device_get()")
+        elif isinstance(func, ast.Name) and func.id == "print":
+            self.report(
+                node,
+                "print under trace runs once at trace time (use jax.debug.print)",
+            )
+
+
+@register
+class HostSyncInJitRule(Rule):
+    """NX010: ``.item()`` / ``float()``/``int()`` casts / ``np.array`` /
+    ``jax.device_get`` / ``print`` inside functions that run under
+    ``jax.jit`` / ``shard_map`` / ``lax`` control flow.  On TPU these either
+    fail at trace time or silently freeze a trace-time constant."""
+
+    rule_id = "NX010"
+    description = "no host-synchronizing ops inside traced functions"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        seen: Set[Tuple[int, int, str]] = set()
+        for fn in traced_functions(module.tree):
+            taint = _TaintTracker(fn)
+            visitor = _HostSyncVisitor(self, module, taint)
+            self._scan(fn.body, visitor, taint)
+            for finding in visitor.findings:
+                key = (finding.line, finding.col, finding.message)
+                if key not in seen:
+                    seen.add(key)
+                    yield finding
+
+    def _scan(self, stmts, visitor: _HostSyncVisitor, taint: _TaintTracker) -> None:
+        """Statement-ordered scan so taint bindings apply before later uses;
+        nested defs are skipped (they get their own pass when traced)."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                continue
+            for expr in _own_exprs(stmt):
+                for node in ast.walk(expr):
+                    if isinstance(node, ast.Call):
+                        visitor.check_call(node)
+            taint.bind(stmt)
+            for block in _child_blocks(stmt):
+                self._scan(block, visitor, taint)
+
+
+# -- NX011 ---------------------------------------------------------------------
+
+#: jax.random functions that CONSUME their key argument.  ``PRNGKey``/``key``
+#: mint keys; ``fold_in`` derives per-step keys from a reusable base —
+#: reusing the base with different fold data is the intended pattern.
+_NON_CONSUMING = frozenset({"PRNGKey", "key", "fold_in", "wrap_key_data", "key_data"})
+
+
+def _random_key_arg(node: ast.Call) -> Optional[str]:
+    """If ``node`` is a key-consuming ``jax.random.*`` call, the plain-Name
+    key argument (first positional or ``key=``), else None."""
+    func = node.func
+    if not (
+        isinstance(func, ast.Attribute)
+        and isinstance(func.value, ast.Attribute)
+        and func.value.attr == "random"
+        and isinstance(func.value.value, ast.Name)
+        and func.value.value.id == "jax"
+    ):
+        return None
+    if func.attr in _NON_CONSUMING:
+        return None
+    key_expr: Optional[ast.expr] = node.args[0] if node.args else None
+    for kw in node.keywords:
+        if kw.arg == "key":
+            key_expr = kw.value
+    if isinstance(key_expr, ast.Name):
+        return key_expr.id
+    return None
+
+
+def _assigned_names(stmt: ast.stmt) -> Set[str]:
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = list(stmt.targets)
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        targets = [stmt.target]
+    names: Set[str] = set()
+    for target in targets:
+        for child in ast.walk(target):
+            if isinstance(child, ast.Name):
+                names.add(child.id)
+    for child in ast.walk(stmt):
+        if isinstance(child, ast.NamedExpr) and isinstance(child.target, ast.Name):
+            names.add(child.target.id)
+    return names
+
+
+class _KeyFlow:
+    """Linear-ish scan of one function scope: track, per key name, whether it
+    has already been consumed by a ``jax.random.*`` call.  If/try branches
+    fork the state and merge conservatively (consumed only if consumed in
+    every branch); loop bodies run twice to catch cross-iteration reuse."""
+
+    def __init__(self, rule: Rule, module: Module) -> None:
+        self.rule = rule
+        self.module = module
+        self.findings: List[Finding] = []
+        self._reported: Set[Tuple[int, int, str]] = set()
+
+    def run(self, fn: ast.AST) -> None:
+        self._process_block(fn.body, {})
+
+    def _process_block(self, stmts: List[ast.stmt], state: Dict[str, bool]) -> None:
+        for stmt in stmts:
+            self._process_stmt(stmt, state)
+
+    def _process_stmt(self, stmt: ast.stmt, state: Dict[str, bool]) -> None:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            return  # separate scope, analyzed on its own
+        if isinstance(stmt, ast.If):
+            branches = [stmt.body, stmt.orelse]
+            forks = []
+            for branch in branches:
+                fork = dict(state)
+                self._consume_in_expr(stmt.test, fork)
+                self._process_block(branch, fork)
+                forks.append(fork)
+            self._merge(state, forks)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._consume_in_expr(stmt.iter, state)
+            for _ in range(2):  # second pass models the loop back-edge
+                for name in _assigned_names_of_target(stmt.target):
+                    state[name] = False
+                self._process_block(stmt.body, state)
+            self._process_block(stmt.orelse, state)
+            return
+        if isinstance(stmt, ast.While):
+            for _ in range(2):
+                self._consume_in_expr(stmt.test, state)
+                self._process_block(stmt.body, state)
+            self._process_block(stmt.orelse, state)
+            return
+        if isinstance(stmt, (ast.Try,)):
+            self._process_block(stmt.body, state)
+            forks = []
+            for handler in stmt.handlers:
+                fork = dict(state)
+                self._process_block(handler.body, fork)
+                forks.append(fork)
+            if forks:
+                self._merge(state, forks + [dict(state)])
+            self._process_block(stmt.orelse, state)
+            self._process_block(stmt.finalbody, state)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._consume_in_expr(item.context_expr, state)
+            self._process_block(stmt.body, state)
+            return
+        # simple statement: consumptions in the expression tree first, then
+        # (re)bindings take effect
+        self._consume_in_stmt(stmt, state)
+        for name in _assigned_names(stmt):
+            state[name] = False
+
+    def _consume_in_stmt(self, stmt: ast.stmt, state: Dict[str, bool]) -> None:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                self._consume_call(node, state)
+
+    def _consume_in_expr(self, expr: Optional[ast.expr], state: Dict[str, bool]) -> None:
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._consume_call(node, state)
+
+    def _consume_call(self, node: ast.Call, state: Dict[str, bool]) -> None:
+        name = _random_key_arg(node)
+        if name is None:
+            return
+        if state.get(name, False):
+            key = (node.lineno, node.col_offset, name)
+            if key not in self._reported:
+                self._reported.add(key)
+                self.findings.append(
+                    self.rule.finding(
+                        self.module,
+                        node,
+                        f"PRNG key '{name}' already consumed by an earlier "
+                        "jax.random call — split it first",
+                    )
+                )
+        else:
+            state[name] = True
+
+    @staticmethod
+    def _merge(state: Dict[str, bool], forks: List[Dict[str, bool]]) -> None:
+        for name in {n for fork in forks for n in fork}:
+            state[name] = all(fork.get(name, False) for fork in forks)
+
+
+def _assigned_names_of_target(target: ast.expr) -> Set[str]:
+    return {n.id for n in ast.walk(target) if isinstance(n, ast.Name)}
+
+
+@register
+class PrngKeyReuseRule(Rule):
+    """NX011: the same PRNG key fed to two ``jax.random.*`` consumers without
+    an intervening split/rebind — correlated randomness, the classic silent
+    JAX bug."""
+
+    rule_id = "NX011"
+    description = "PRNG keys must not be consumed twice"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        for node in ast.walk(module.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                flow = _KeyFlow(self, module)
+                flow.run(node)
+                yield from flow.findings
+
+
+# -- NX012 ---------------------------------------------------------------------
+
+
+def canonical_axes(project: Project) -> Optional[Set[str]]:
+    mesh = project.find_module(MESH_PATH)
+    if mesh is None or mesh.tree is None:
+        return None
+    for stmt in mesh.tree.body:
+        target = None
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+            target = stmt.targets[0]
+        elif isinstance(stmt, ast.AnnAssign):
+            target = stmt.target
+        if (
+            isinstance(target, ast.Name)
+            and target.id == "AXIS_ORDER"
+            and isinstance(stmt.value, (ast.Tuple, ast.List))
+        ):
+            return {
+                e.value
+                for e in stmt.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            }
+    return None
+
+
+_SPEC_CALL_NAMES = frozenset({"P", "PartitionSpec"})
+_AXIS_KWARGS = frozenset({"axis_name", "axis_names"})
+
+
+@register
+class MeshAxisLiteralRule(Rule):
+    """NX012: every string literal naming a mesh axis (``PartitionSpec``/``P``
+    arguments, ``axis_name=`` kwargs on collectives/shard_map) must be one of
+    the axes declared in ``parallel/mesh.py`` ``AXIS_ORDER``.  A typo'd axis
+    string fails only at trace time on a mesh that doesn't bind it — or binds
+    the wrong one."""
+
+    rule_id = "NX012"
+    description = "mesh-axis string literals must name axes from parallel/mesh.py"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        axes = canonical_axes(project)
+        if not axes:
+            return
+        mesh_module = project.find_module(MESH_PATH)
+        for module in project.modules:
+            if module.tree is None or module is mesh_module:
+                continue
+            for node in ast.walk(module.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _terminal_name(node.func)
+                if name in _SPEC_CALL_NAMES:
+                    for arg in node.args:
+                        yield from self._check_strings(module, arg, axes)
+                for kw in node.keywords:
+                    if kw.arg in _AXIS_KWARGS:
+                        yield from self._check_strings(module, kw.value, axes)
+
+    def _check_strings(self, module: Module, expr: ast.expr, axes: Set[str]) -> Iterator[Finding]:
+        for child in ast.walk(expr):
+            if (
+                isinstance(child, ast.Constant)
+                and isinstance(child.value, str)
+                and child.value not in axes
+            ):
+                yield self.finding(
+                    module,
+                    child,
+                    f"'{child.value}' is not a mesh axis declared in "
+                    f"{MESH_PATH} AXIS_ORDER ({', '.join(sorted(axes))})",
+                )

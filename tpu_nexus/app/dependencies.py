@@ -107,7 +107,7 @@ class ApplicationServices:
                 from tpu_nexus.k8s.rest import RestKubeClient
 
                 self._kube_client = RestKubeClient.from_config(config.kube_config_path)
-            except Exception as exc:
+            except Exception as exc:  # noqa: BLE001 - fatal-exit boundary (reference Fatal(), app_dependencies.go:36-53)
                 self._fatal("failed to build kubernetes client", exc)
         return self
 
@@ -160,6 +160,6 @@ class ApplicationServices:
         )
         try:
             self._supervisor.init(processing)
-        except Exception as exc:
+        except Exception as exc:  # noqa: BLE001 - fatal-exit boundary: any init failure must abort startup
             self._fatal("supervisor init failed", exc)
         await self._supervisor.start(ctx)

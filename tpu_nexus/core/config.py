@@ -164,7 +164,7 @@ def _resolve_type(cls: Type, field: dataclasses.Field) -> Any:
     globalns = getattr(module, "__dict__", {})
     try:
         return eval(field.type, dict(globalns, **vars(typing)), {"timedelta": timedelta})  # noqa: S307
-    except Exception as exc:  # pragma: no cover - developer error
+    except Exception as exc:  # pragma: no cover - developer error  # noqa: BLE001 - eval of an annotation can raise anything; rewrap as ConfigError
         raise ConfigError(f"cannot resolve annotation {field.type!r}: {exc}") from exc
 
 

@@ -195,7 +195,7 @@ class PipelineStageActor(Generic[In, Out]):
             except asyncio.CancelledError:
                 self._inflight -= 1
                 raise
-            except Exception as exc:
+            except Exception as exc:  # noqa: BLE001 - actor isolation: any element failure becomes retry-with-backoff
                 self.failed += 1
                 self._metrics.count(f"{self.name}.failures", tags=self.tags)
                 delay = min(self._base_delay * (2.0 ** attempts), self._max_delay)

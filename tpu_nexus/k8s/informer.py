@@ -107,7 +107,7 @@ class Informer:
                 await self._watch_until_resync(rv)
             except asyncio.CancelledError:
                 raise
-            except Exception as exc:
+            except Exception as exc:  # noqa: BLE001 - list/watch loop must survive any stream failure and re-list
                 self._log.warning(
                     "informer stream failed; re-listing", kind=self.kind, error=repr(exc)
                 )
@@ -157,7 +157,7 @@ class Informer:
         for handler in self._handlers:
             try:
                 handler(event_type, typed)
-            except Exception:
+            except Exception:  # noqa: BLE001 - one handler's bug must not starve the other handlers
                 self._log.exception("informer handler raised", kind=self.kind)
 
 

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from tpu_nexus.ops.attention import checkpoint_name as _checkpoint_name
-from tpu_nexus.parallel.smap import shard_map_compat
+from tpu_nexus.parallel.smap import axis_size_compat, shard_map_compat
 
 _NEG_INF = -1e30
 
@@ -186,7 +186,7 @@ def _combine(acc, big_l, out_b, lse_b):
 
 def _ring_forward(q, k, v, axis_name, causal, scale, use_pallas, interpret):
     """Returns (out [B,S,Hq,D] f32 normalized, lse [B,S,Hq] f32)."""
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i - 1) % n) for i in range(n)]
     block = functools.partial(
@@ -225,7 +225,7 @@ def _ring_forward(q, k, v, axis_name, causal, scale, use_pallas, interpret):
 
 
 def _ring_backward(q, k, v, out, lse, g_out, axis_name, causal, scale, use_pallas, interpret):
-    n = jax.lax.axis_size(axis_name)
+    n = axis_size_compat(axis_name)
     my = jax.lax.axis_index(axis_name)
     perm = [(i, (i - 1) % n) for i in range(n)]
     # global per-row D_i = rowsum(dO ∘ O), computed once

@@ -5,10 +5,57 @@ check from ``check_rep`` to ``check_vma``)."""
 
 from __future__ import annotations
 
+import inspect
+
+import jax
+
 try:  # pragma: no cover - version-dependent import
     from jax import shard_map as _shard_map
 except ImportError:  # pragma: no cover - older jax spelling
     from jax.experimental.shard_map import shard_map as _shard_map
+
+
+def force_virtual_cpu_devices(n: int) -> None:
+    """Pin this process to an ``n``-device virtual CPU platform, across jax
+    versions.  Call before the first device query (backends initialize
+    lazily, so a prior ``import jax`` is fine).
+
+    Sets the env vars too — child processes inherit the same mesh.  Any
+    pre-set ``--xla_force_host_platform_device_count`` is REPLACED, not
+    appended around: on jax < 0.5 (no ``jax_num_cpu_devices`` config) the
+    flag is the only control, and a stale count would silently run every
+    n-device test on the wrong mesh.
+    """
+    import os
+    import re
+
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "", os.environ.get("XLA_FLAGS", "")
+    )
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+    except AttributeError:
+        pass  # jax < 0.5: the XLA_FLAGS env var above is honored instead
+
+
+def axis_size_compat(axis_name: str) -> int:
+    """Static size of a bound mesh axis, across jax spellings: 0.5+ has
+    ``jax.lax.axis_size``; pre-0.5 exposes it via ``jax.core.axis_frame``
+    (which returns the size directly in late 0.4.x, a frame object with
+    ``.size`` before that)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    frame = jax.core.axis_frame(axis_name)
+    return getattr(frame, "size", frame)
+
+
+_HAS_VMA = "check_vma" in inspect.signature(_shard_map).parameters
+_JAX_MINOR = tuple(int(p) for p in jax.__version__.split(".")[:2] if p.isdigit())
 
 
 def shard_map_compat(body, *, check_vma: bool = True, **kwargs):
@@ -18,10 +65,15 @@ def shard_map_compat(body, *, check_vma: bool = True, **kwargs):
     carry no varying-manual-axes annotations) and custom-VJP helpers with
     no vma rules; leave it on elsewhere — it catches collective/sharding
     bugs at trace time.
+
+    On jax builds that still spell the check ``check_rep``, the caller's
+    request is honored on 0.5+ but force-disabled on 0.4.x, whose checker
+    lacks replication rules for primitives these kernels rely on
+    (custom-VJP helpers raise NotImplementedError at trace time even for
+    correct code).
     """
-    if check_vma:
-        return _shard_map(body, **kwargs)
-    try:
+    if _HAS_VMA:
+        if check_vma:
+            return _shard_map(body, **kwargs)
         return _shard_map(body, check_vma=False, **kwargs)
-    except TypeError:  # pragma: no cover - jax < 0.8 spells it check_rep
-        return _shard_map(body, check_rep=False, **kwargs)
+    return _shard_map(body, check_rep=check_vma and _JAX_MINOR >= (0, 5), **kwargs)

@@ -103,6 +103,33 @@ MSG_RESTART_STALLED = (
     "TPU slice was preempted and the controller never restarted it within the deadline - run terminated."
 )
 
+#: decisions that do NOT delete the k8s Job — the explicit complement of
+#: ``DELETES_JOB``.  Every DecisionAction constant must appear in exactly one
+#: of the two sets (nxlint NX001); a new decision that declares neither is a
+#: latent "supervisor never cleans up / deletes a restartable run" bug.
+NON_DELETING_ACTIONS = frozenset(
+    {
+        DecisionAction.TO_RUNNING,
+        DecisionAction.TO_PREEMPT_RESTARTABLE,
+    }
+)
+
+#: decision -> human run-status message, TOTAL over DecisionAction (nxlint
+#: NX001).  TO_RUNNING maps to "" because Running results carry the raw
+#: event reason, not a canned message (reference services/supervisor.go:166).
+ACTION_MESSAGES: Dict[str, str] = {
+    DecisionAction.TO_RUNNING: "",
+    DecisionAction.TO_FAIL_STUCK_IN_PENDING: MSG_STUCK_IN_PENDING,
+    DecisionAction.TO_FAIL_DEADLINE_EXCEEDED: MSG_DEADLINE_EXCEEDED,
+    DecisionAction.TO_FAIL_FATAL_ERROR: MSG_FATAL_ERROR,
+    DecisionAction.TO_FAIL_COMPILE_ABORT: MSG_COMPILE_ABORT,
+    DecisionAction.TO_FAIL_HBM_OOM: MSG_HBM_OOM,
+    DecisionAction.TO_FAIL_ICI_LINK_DOWN: MSG_ICI_LINK_DOWN,
+    DecisionAction.TO_PREEMPT_RESTARTABLE: MSG_PREEMPTED,
+    DecisionAction.TO_FAIL_STUCK_IN_RUNNING: MSG_STUCK_IN_RUNNING,
+    DecisionAction.TO_FAIL_RESTART_STALLED: MSG_RESTART_STALLED,
+}
+
 
 @dataclass
 class RunStatusAnalysisResult:
@@ -157,7 +184,9 @@ _PREEMPT_RE = re.compile(
     re.IGNORECASE,
 )
 
-_HLO_REF_RE = re.compile(r"(?:gs|s3|file)://\S+\.(?:hlo|pb|pbtxt|xplane\.pb)")
+# longest alternatives first: with `pb` before `pbtxt`, a `.pbtxt` ref would
+# truncate to `.pb` (the regex never backtracks to the longer suffix)
+_HLO_REF_RE = re.compile(r"(?:gs|s3|file)://\S+\.(?:xplane\.pb|pbtxt|pb|hlo)")
 
 
 def classify_tpu_failure(text: str) -> Optional[str]:
@@ -186,12 +215,18 @@ def extract_hlo_trace_ref(text: str) -> str:
 
 
 def _tpu_message(action: str) -> str:
-    return {
-        DecisionAction.TO_FAIL_COMPILE_ABORT: MSG_COMPILE_ABORT,
-        DecisionAction.TO_FAIL_HBM_OOM: MSG_HBM_OOM,
-        DecisionAction.TO_FAIL_ICI_LINK_DOWN: MSG_ICI_LINK_DOWN,
-        DecisionAction.TO_PREEMPT_RESTARTABLE: MSG_PREEMPTED,
-    }[action]
+    """Human message for a decision, total over ``ACTION_MESSAGES``.
+
+    An unmapped action used to raise a bare ``KeyError`` deep inside event
+    classification; now it raises a descriptive error naming the fix, and
+    nxlint NX001 keeps the mapping total so it never fires in practice."""
+    try:
+        return ACTION_MESSAGES[action]
+    except KeyError:
+        raise ValueError(
+            f"no human run-status message mapped for decision action {action!r}; "
+            "add it to ACTION_MESSAGES in tpu_nexus/supervisor/taxonomy.py"
+        ) from None
 
 
 def _pod_termination_text(pod: PodObj) -> str:

@@ -33,7 +33,13 @@ def _apply_platform_env() -> None:
         r"xla_force_host_platform_device_count=(\d+)", os.environ.get("XLA_FLAGS", "")
     )
     if m and "cpu" in platforms:
-        jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(m.group(1)))
+        except AttributeError:
+            # jax < 0.5 has no jax_num_cpu_devices; there the XLA_FLAGS env
+            # var itself is honored (this path exists for plugin-pinned
+            # hosts on newer jax, where the flag is ignored)
+            pass
 
 
 def main() -> int:

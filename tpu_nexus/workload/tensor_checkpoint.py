@@ -64,7 +64,11 @@ class TensorCheckpointer:
         step = self._mngr.latest_step() if step is None else step
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {self.directory}")
-        return self._mngr.restore(step)["params"]
+        # template-free StandardRestore: a FRESH manager (serve startup) has
+        # no handler registry primed by a prior save, so a bare restore(step)
+        # raises KeyError on orbax <= 0.7
+        restored = self._mngr.restore(step, args=self._ocp.args.StandardRestore())
+        return restored["params"]
 
     def uri_for(self, step: int) -> str:
         return f"{self.directory}/{step}"

@@ -251,7 +251,7 @@ def state_shardings(init_fn, key, model, mesh, rules) -> Any:
             # mirror the param TREE but hold rank-1 row/col factors whose
             # shapes the param shardings do not fit — those replicate
             return [leaf.shape for leaf in jax.tree.leaves(subtree)] == param_shapes
-        except Exception:  # unhashable/exotic nodes: not a param mirror
+        except Exception:  # noqa: BLE001 - unhashable/exotic pytree nodes: not a param mirror
             return False
 
     def subtree_sharding(subtree):
