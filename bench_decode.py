@@ -22,8 +22,15 @@ this script prints carries:
 Variants: bf16 | int8 weights | int8 KV cache | int8 weights + int8 KV
 (``NEXUS_DECODE_VARIANTS`` to restrict, comma-separated).
 
-One JSON line per (shape, variant) to stdout; v5e HBM defaults to 819 GB/s
-(``NEXUS_BENCH_HBM_GBPS`` to override).
+Every variant is measured per decode-attention implementation — the fused
+split-KV pallas kernel (``ops/decode_attention.py``) AND the masked-einsum
+XLA fallback — so each row's ``x_floor`` carries a ``kernel`` field and
+the kernel's win is read off the same table (``NEXUS_DECODE_KERNELS`` to
+restrict, comma-separated; defaults to ``pallas,xla`` on TPU, ``xla``
+elsewhere).
+
+One JSON line per (shape, variant, kernel) to stdout; v5e HBM defaults to
+819 GB/s (``NEXUS_BENCH_HBM_GBPS`` to override).
 """
 
 from __future__ import annotations
@@ -31,6 +38,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import time
 
 _HBM_GBPS = (
@@ -99,6 +107,24 @@ def main() -> None:
                 f"unknown NEXUS_DECODE_VARIANTS {bad}; use {', '.join(known_variants)}"
             )
 
+    # the per-row decode_kernel argument labels the "kernel" field; the
+    # NEXUS_DECODE_KERNEL escape hatch only replaces the "auto" default
+    # (cached_attention precedence), so rows cannot be silently re-routed
+    # — surface a notice anyway so an operator watching stderr isn't
+    # surprised that their env var doesn't apply here
+    if os.environ.get("NEXUS_DECODE_KERNEL"):
+        print("bench_decode: NEXUS_DECODE_KERNEL ignored (rows pin the kernel per row)",
+              file=sys.stderr)
+    kernels = ("pallas", "xla") if on_tpu else ("xla",)
+    env_kernels = os.environ.get("NEXUS_DECODE_KERNELS")
+    if env_kernels:
+        kernels = tuple(env_kernels.split(","))
+        bad = [kn for kn in kernels if kn not in ("auto", "pallas", "xla")]
+        if bad:
+            raise SystemExit(
+                f"unknown NEXUS_DECODE_KERNELS {bad}; use auto, pallas, xla"
+            )
+
     long_n, short_n = (288, 32) if on_tpu else (40, 8)
     if os.environ.get("NEXUS_DECODE_WINDOW"):
         long_n, short_n = (int(x) for x in os.environ["NEXUS_DECODE_WINDOW"].split(","))
@@ -126,14 +152,16 @@ def main() -> None:
             jax.random.PRNGKey(1), (batch, prompt_len), 0, cfg.vocab_size
         )
         for variant in variants:
+          for kernel in kernels:
             p = qparams if "int8w" in variant else params
             kv_quant = "int8" if "int8kv" in variant else ""
 
-            def run(n_tokens, p=p, kv_quant=kv_quant):
+            def run(n_tokens, p=p, kv_quant=kv_quant, kernel=kernel):
                 fn = jax.jit(
                     functools.partial(
                         generate, cfg=cfg, max_new_tokens=n_tokens,
                         max_len=max_len, kv_quant=kv_quant,
+                        decode_kernel=kernel,
                     ),
                     static_argnames=(),
                 )
@@ -163,6 +191,7 @@ def main() -> None:
                 "model": model,
                 "batch": batch, "prompt": prompt_len, "max_len": max_len,
                 "variant": variant,
+                "kernel": kernel,
                 "ms_step": round(ms_step, 3),
                 "floor_ms": round(floor_ms, 3),
                 "x_floor": round(ms_step / floor_ms, 2) if floor_ms else 0.0,

@@ -576,3 +576,39 @@ def test_migrate_schema_tolerates_existing_columns():
         "ALTER TABLE nexus.checkpoints ADD max_restarts int",
     ]
     store.close()
+
+
+def test_migrate_schema_tolerates_cassandra_already_exists():
+    server = FakeCqlServer()
+    server.start()
+    store = ScyllaCqlStore(hosts=["127.0.0.1"], port=server.port)
+    already = write_int(0x2200) + write_string(
+        "Invalid column name preempted_generation because it already exists"
+    )
+    server.responses = [(OP_ERROR, already)]
+    store.migrate_schema()  # must not raise
+    store.close()
+
+
+@pytest.mark.parametrize(
+    "message",
+    [
+        # the bare-substring-"exist" match swallowed BOTH of these — a
+        # missing table reported as a successful migration (ADVICE r5)
+        "unconfigured table checkpoints",
+        "table nexus.checkpoints does not exist",
+        # and anything merely *mentioning* existence must not pass either
+        "user nexus does not have ALTER permission on existing table",
+    ],
+)
+def test_migrate_schema_reraises_non_positive_errors(message):
+    """Only positive already-exists shapes mean "column done"; a missing
+    keyspace/table or permission failure must abort the migration loudly,
+    not report success over a broken ledger."""
+    server = FakeCqlServer()
+    server.start()
+    store = ScyllaCqlStore(hosts=["127.0.0.1"], port=server.port)
+    server.responses = [(OP_ERROR, write_int(0x2200) + write_string(message))]
+    with pytest.raises(CqlError):
+        store.migrate_schema()
+    store.close()

@@ -119,6 +119,50 @@ async def test_controller_materializes_labeled_children():
         assert pl[JOB_TEMPLATE_NAME_KEY] == ALGORITHM
 
 
+async def test_recreate_scopes_dependents_to_namespace():
+    """Jobset names are only unique per namespace: recreating one
+    namespace's JobSet children must not touch (or uid-cycle) a SAME-NAMED
+    jobset's children in another namespace — label-only dependent matching
+    crossed that boundary."""
+    client = FakeKubeClient({}, jobset_controller=True)
+
+    def _jobset(ns):
+        return {
+            "kind": "JobSet",
+            "metadata": {"name": "run-x", "namespace": ns, "uid": f"js-{ns}"},
+            "spec": {
+                "replicatedJobs": [
+                    {
+                        "name": "workers",
+                        "replicas": 1,
+                        "template": {"spec": {"parallelism": 1, "template": {}}},
+                    }
+                ]
+            },
+        }
+
+    await client.create_object("JobSet", "ns-a", _jobset("ns-a"))
+    await client.create_object("JobSet", "ns-b", _jobset("ns-b"))
+    # both namespaces materialized their own children despite the shared name
+    pods, _ = await client.list_objects("Pod", "")
+    assert sorted((p["metadata"]["namespace"]) for p in pods) == ["ns-a", "ns-b"]
+    uid_b_before = {
+        p["metadata"]["name"]: p["metadata"]["uid"]
+        for p in pods
+        if p["metadata"]["namespace"] == "ns-b"
+    }
+
+    client.recreate_jobset_children("ns-a", "run-x")
+
+    pods, _ = await client.list_objects("Pod", "")
+    by_ns = {p["metadata"]["namespace"]: p for p in pods}
+    assert set(by_ns) == {"ns-a", "ns-b"}  # neither namespace lost its pod
+    # ns-b's generation is untouched; ns-a's was cycled to fresh uids
+    assert by_ns["ns-b"]["metadata"]["uid"] == uid_b_before[by_ns["ns-b"]["metadata"]["name"]]
+    jobs, _ = await client.list_objects("Job", "ns-a")
+    assert jobs and jobs[0]["metadata"]["uid"] != "js-ns-a"
+
+
 async def test_child_pod_preemption_resolves_owning_run():
     """THE r3 bug: a TPUPreempted event on a child pod must increment the
     OWNING run's restart_count — and must not delete anything."""

@@ -118,6 +118,35 @@ async def test_new_preemption_rearms_the_deadline():
     assert [r.request_id for r in flagged] == [rid]
 
 
+async def test_stray_writes_do_not_rearm_the_deadline():
+    """A draining generation keeps writing after the preemption — late
+    heartbeats flushing, a final checkpoint commit, last_modified bumps.
+    None of those are restart signals (lifecycle_stage / restart_count /
+    preempted_generation), so they must NOT restart the restart-deadline
+    clock: a wedged controller would otherwise never be escalated as long
+    as the dying workers stay chatty."""
+    store = InMemoryCheckpointStore()
+    rid = str(uuid.uuid4())
+    store.upsert_checkpoint(_preempted_cp(rid))
+    flagged = []
+    wd = HeartbeatWatchdog(
+        store, enqueue=flagged.append,
+        restart_deadline=timedelta(seconds=60), interval=timedelta(seconds=1),
+    )
+    await wd.sweep(now=0.0)
+    # stray non-restart writes, spread across the deadline window
+    store.merge_chip_steps(ALGORITHM, rid, {"host0/chip0": 101})
+    await wd.sweep(now=20.0)
+    store.update_fields(
+        ALGORITHM, rid,
+        {"tensor_checkpoint_uri": "gs://ckpt/late-flush", "last_modified": "t+40"},
+    )
+    await wd.sweep(now=40.0)
+    assert not flagged  # still inside the deadline
+    await wd.sweep(now=61.0)  # deadline measured from the FIRST observation
+    assert [r.request_id for r in flagged] == [rid]
+
+
 async def test_resumed_run_is_forgotten():
     """PREEMPTED -> RUNNING (the controller came back) clears the
     observation even when the RUNNING sweep is disabled."""

@@ -406,15 +406,18 @@ class CqlCheckpointStore(CheckpointStore):
             except CqlConnectionError:
                 raise
             except CqlError as exc:
-                # only the already-exists shape means "done" (Scylla:
+                # only a POSITIVE already-exists shape means "done" (Scylla:
                 # "Invalid column name ... conflicts with an existing
-                # column"; Cassandra: "... already exists").  Anything else
-                # (missing keyspace/table, no ALTER permission) is a REAL
-                # failure — swallowing it would report a successful upgrade
+                # column"; Cassandra: "... already exists").  Matching the
+                # bare substring "exist" also swallowed "table ... does not
+                # exist" / "unconfigured table" (ADVICE r5) — a missing
+                # keyspace/table or revoked ALTER permission is a REAL
+                # failure: swallowing it would report a successful upgrade
                 # and leave every subsequent query erroring on the missing
                 # columns, the exact outage this migration prevents.
                 text = str(exc).lower()
-                if "exist" not in text and "conflict" not in text:
+                done = "already exist" in text or "conflicts with an existing column" in text
+                if "does not exist" in text or "unconfigured" in text or not done:
                     raise
                 self._log.v(1).info(
                     "migration column already present", column=col, detail=str(exc)

@@ -75,9 +75,12 @@ class FakeKubeClient(KubeClient):
         for queue in self._watchers.get(kind, []):
             queue.put_nowait((event_type, obj))
         if self._jobset_controller and kind == "JobSet" and event_type == "ADDED":
-            name = (obj.get("metadata") or {}).get("name", "")
-            if name and name not in self._materialized_jobsets:
-                self._materialized_jobsets.add(name)
+            # keyed by (namespace, name): jobset names are only unique per
+            # namespace, and a bare-name key would skip materializing a
+            # same-named jobset in a second namespace
+            key = _key(obj)
+            if key[1] and key not in self._materialized_jobsets:
+                self._materialized_jobsets.add(key)
                 self._materialize_jobset_children(obj)
 
     def _next_uid(self) -> str:
@@ -173,7 +176,7 @@ class FakeKubeClient(KubeClient):
         jobset = self._objects.get("JobSet", {}).get((namespace, name))
         if jobset is None:
             raise NotFoundError(f"JobSet {namespace}/{name} not found")
-        for kind, obj in self._dependents_of("JobSet", name):
+        for kind, obj in self._dependents_of("JobSet", name, namespace):
             self.inject("DELETED", kind, obj)
         self._materialize_jobset_children(jobset)
 
@@ -228,31 +231,39 @@ class FakeKubeClient(KubeClient):
             # re-creating a same-named JobSet must re-materialize children
             # even before the deferred GC below runs, so clear synchronously
             if kind == "JobSet":
-                self._materialized_jobsets.discard(name)
+                self._materialized_jobsets.discard((namespace, name))
             # background propagation: dependents are garbage-collected
             # asynchronously (reference relies on DeletePropagationBackground,
             # services/supervisor.go:262).  The victim set is SNAPSHOTTED by
             # uid now — real k8s GC tracks ownerReference uids, so a
             # same-named resource re-created before the GC tick keeps its
             # fresh children
-            victims = self._dependents_of(kind, name)
+            victims = self._dependents_of(kind, name, namespace)
             asyncio.get_running_loop().call_soon(self._gc_victims, victims)
 
-    def _dependents_of(self, kind: str, name: str) -> List[Tuple[str, Dict[str, Any]]]:
-        """(kind, object) snapshot of the dependents a controller would GC."""
+    def _dependents_of(
+        self, kind: str, name: str, namespace: str
+    ) -> List[Tuple[str, Dict[str, Any]]]:
+        """(kind, object) snapshot of the dependents a controller would GC.
+        Filtered by ``metadata.namespace`` as well as the backlink label —
+        jobset/job names are only unique PER NAMESPACE, so a label-only
+        match would cross-GC a same-named resource's children in another
+        namespace (real ownerReference GC is namespace-scoped)."""
         out: List[Tuple[str, Dict[str, Any]]] = []
         if kind == "JobSet":
             for job in self._objects.get("Job", {}).values():
-                labels = (job.get("metadata") or {}).get("labels") or {}
-                if labels.get(JOBSET_NAME_LABEL) == name:
+                meta = job.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                if labels.get(JOBSET_NAME_LABEL) == name and meta.get("namespace", "") == namespace:
                     out.append(("Job", job))
                     out.extend(
-                        self._dependents_of("Job", (job.get("metadata") or {}).get("name", ""))
+                        self._dependents_of("Job", meta.get("name", ""), namespace)
                     )
         else:
             for pod in self._objects.get("Pod", {}).values():
-                labels = (pod.get("metadata") or {}).get("labels") or {}
-                if labels.get(POD_JOB_NAME_LABEL) == name:
+                meta = pod.get("metadata") or {}
+                labels = meta.get("labels") or {}
+                if labels.get(POD_JOB_NAME_LABEL) == name and meta.get("namespace", "") == namespace:
                     out.append(("Pod", pod))
         return out
 

@@ -15,10 +15,13 @@ TPU-first design:
 * **Prefill reuses the training forward** (:func:`llama_hidden` with
   ``return_kv=True``): the flash kernel processes the whole prompt in one
   pass and hands back the per-layer post-RoPE K/V stack.
-* **Decode attention is an O(max_len) masked einsum** — at query length 1
-  the MXU has nothing to tile, so a flash kernel would only add launch
-  overhead; the mask is a positional clamp (``k_pos <= pos``), not a
-  causal triangle.
+* **Decode attention is fused** — on TPU the per-step attention runs the
+  split-KV pallas kernel (ops/decode_attention.py): online softmax over a
+  KV grid axis, DMA clamped to the live cache length, int8 KV read
+  natively with dequant deferred inside the kernel.  Off-TPU (and as the
+  ``NEXUS_DECODE_KERNEL=xla`` escape hatch) the fallback is an
+  O(max_len) masked einsum whose mask is a positional clamp
+  (``k_pos <= pos``), not a causal triangle.
 * Rows decode in lockstep from shared scalar cache slots; ragged batches
   RIGHT-pad to a common width and pass ``prompt_lengths`` — per-row RoPE
   positions and pad-slot masks make each row exactly equal to its solo
@@ -116,28 +119,23 @@ def _quantize_kv(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
     return t.q, t.s
 
 
-def _dequantize_kv(q: jax.Array, s: jax.Array, dtype) -> jax.Array:
-    # QTensor.astype's dequant, on the cache's raw (q, s) pair: the
-    # convert+scale fuses into the attention dot's operand read (the same
-    # XLA pattern the int8 weight path rides), so the cache crosses HBM
-    # as int8
-    from tpu_nexus.models.quant import QTensor
-
-    return QTensor(q, s).astype(dtype)
-
-
 def cached_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, kv_len: jax.Array,
-    valid: Optional[jax.Array] = None,
+    prompt_lengths: Optional[jax.Array] = None,
+    prompt_width: Optional[int] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    impl: str = "auto",
 ) -> jax.Array:
-    """GQA attention of a length-1 query against a fixed-size cache.
+    """GQA attention of a short query block against a fixed-size cache.
 
-    ``q`` [B, 1, Hq, D]; ``k``/``v`` [B, max_len, Hkv, D]; ``kv_len`` scalar —
-    cache slots >= kv_len are masked out (they hold zeros/stale writes).
-    ``valid`` [B, max_len] bool overrides the uniform mask for ragged
-    prompts (per-row real-slot maps).
+    ``q`` [B, q_len<=8, Hq, D]; ``k``/``v`` [B, max_len, Hkv, D];
+    ``kv_len`` scalar — the queries occupy cache slots ``[kv_len - q_len,
+    kv_len)`` and slots >= kv_len are masked out (they hold zeros/stale
+    writes).  At q_len > 1 the query block is causally masked internally
+    (row ``j`` sees slots ``<= kv_len - q_len + j``).  Ragged right-padded
+    batches pass ``prompt_lengths`` [B] + the static pad ``prompt_width``:
+    each row's live slots are its prompt prefix plus the generated tail.
 
     Int8 cache mode (``k_scale``/``v_scale`` [B, max_len, Hkv, 1]): the
     dequantization is DEFERRED past the dots — exact, because the scale is
@@ -146,7 +144,34 @@ def cached_attention(
     buffer stays the dot's memory operand (the int8→bf16 convert fuses
     into the read, like the int8 weight path); an operand-side
     ``k8*s`` multiply instead re-materializes a bf16 slab, measured
-    SLOWER than the bf16 cache on the unrolled decode path."""
+    SLOWER than the bf16 cache on the unrolled decode path.
+
+    Dispatch (``impl``): ``"auto"`` routes supported shapes on TPU to the
+    fused split-KV pallas kernel (ops/decode_attention.py) and everything
+    else to the masked XLA einsum below; ``"pallas"`` forces the kernel
+    (interpret mode off-TPU — the test escape hatch); ``"xla"`` forces
+    the fallback.  The ``NEXUS_DECODE_KERNEL`` env var replaces the
+    ``"auto"`` DEFAULT at trace time (the operator escape hatch, no code
+    change needed — serving also surfaces it as ``ServeConfig
+    .decode_kernel``); an explicit non-auto ``impl`` argument wins over
+    the env, so call sites that measure or pin a specific path (bench
+    kernel-on/off rows, parity tests) cannot be silently re-routed by
+    ambient environment."""
+    import os
+
+    from tpu_nexus.ops.decode_attention import decode_attention, decode_supported
+
+    if impl == "auto":
+        impl = os.environ.get("NEXUS_DECODE_KERNEL", "") or impl
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"unknown decode impl {impl!r}; use auto, pallas, or xla")
+    if impl == "pallas" or (impl == "auto" and decode_supported(q, k, k_scale, v_scale)):
+        return decode_attention(
+            q, k, v, kv_len,
+            prompt_lengths=prompt_lengths, prompt_width=prompt_width,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     g = hq // hkv
@@ -158,11 +183,20 @@ def cached_attention(
         # [B, max_len, Hkv, 1] -> [B, Hkv, 1, 1, max_len]
         scores = scores * k_scale[:, :, :, 0].transpose(0, 2, 1)[:, :, None, None, :]
     scores = scores * (d**-0.5)
-    if valid is None:
-        k_pos = jnp.arange(k.shape[1])
-        mask = k_pos < kv_len  # [max_len]
+    k_pos = jnp.arange(k.shape[1])
+    if prompt_lengths is None:
+        mask = (k_pos < kv_len)[None, None, None, None, :]
     else:
-        mask = valid[:, None, None, None, :]  # [B, 1, 1, 1, max_len]
+        assert prompt_width is not None, "ragged decode needs prompt_width"
+        mask = (
+            (k_pos[None, :] < prompt_lengths[:, None])
+            | ((k_pos[None, :] >= prompt_width) & (k_pos[None, :] < kv_len))
+        )[:, None, None, None, :]  # [B, 1, 1, 1, max_len]
+    if sq > 1:
+        # causal clamp inside the query block: row j's last visible slot
+        # is kv_len - q_len + j (the slot it was just written to)
+        row_last = kv_len - sq + jnp.arange(sq)  # [q_len]
+        mask = mask & (k_pos[None, :] <= row_last[:, None])[None, None, None, :, :]
     scores = jnp.where(mask, scores, _NEG_INF)
     w = jax.nn.softmax(scores, axis=-1)
     if v_scale is not None:
@@ -226,6 +260,7 @@ def decode_step(
     prompt_lengths: Optional[jax.Array] = None,
     prompt_width: Optional[int] = None,
     unroll_layers: Optional[bool] = None,
+    decode_kernel: str = "auto",
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step: ``token`` [B] at scalar WRITE position
     ``pos`` → (logits [B, vocab], updated cache).  Mirrors the training
@@ -236,6 +271,11 @@ def decode_step(
     row's RoPE position is its own ``len + (pos - S)`` and attention masks
     out the row's pad slots ``[len, S)`` — the same trusted lockstep loop,
     made per-row correct by index arithmetic instead of per-row scatters.
+
+    ``decode_kernel``: attention dispatch — ``"auto"`` (fused pallas
+    decode kernel on TPU, XLA fallback elsewhere), ``"pallas"``,
+    ``"xla"``; the ``NEXUS_DECODE_KERNEL`` env var replaces the ``auto``
+    default at trace time (see :func:`cached_attention`).
 
     ``unroll_layers`` (default: auto — unroll up to 32 layers): with the
     layer loop as a ``lax.scan``, the per-layer cache read is a DYNAMIC
@@ -252,14 +292,9 @@ def decode_step(
     x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]
     if prompt_lengths is None:
         positions = jnp.broadcast_to(pos[None, None], (b, 1))
-        valid = None
     else:
         assert prompt_width is not None, "ragged decode needs prompt_width"
         positions = (prompt_lengths + (pos - prompt_width))[:, None]  # [B,1]
-        slot = jnp.arange(cache["k"].shape[2])
-        valid = (slot[None, :] < prompt_lengths[:, None]) | (
-            (slot[None, :] >= prompt_width) & (slot[None, :] <= pos)
-        )  # [B, max_len]
     cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
     kv_quant = "k_s" in cache  # int8 KV mode travels with the cache itself
     n_layers = cache["k"].shape[0]
@@ -308,7 +343,11 @@ def decode_step(
             if kv_quant
             else {}
         )
-        o = cached_attention(q, ck, cv, pos + 1, valid=valid, **scales)
+        o = cached_attention(
+            q, ck, cv, pos + 1,
+            prompt_lengths=prompt_lengths, prompt_width=prompt_width,
+            impl=decode_kernel, **scales,
+        )
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
         x = _ffn_block(x, layer, cfg)
         return x, c
@@ -342,6 +381,7 @@ def teacher_forced_decode_ce(
     tokens: jax.Array,
     cfg: ModelConfig,
     kv_quant: str = "",
+    decode_kernel: str = "auto",
 ) -> jax.Array:
     """Mean next-token cross-entropy of ``tokens`` [B, S] scored THROUGH
     the decode path — prefill one token, then a ``decode_step`` scan with
@@ -359,7 +399,9 @@ def teacher_forced_decode_ce(
         cache, logits, pos = carry
         lp = jax.nn.log_softmax(logits.astype(jnp.float32))
         ce = -jnp.take_along_axis(lp, tok_next[:, None], axis=-1)[:, 0]
-        logits, cache = decode_step(params, cache, tok_next, pos, cfg)
+        logits, cache = decode_step(
+            params, cache, tok_next, pos, cfg, decode_kernel=decode_kernel
+        )
         return (cache, logits, pos + 1), ce
 
     (_, _, _), ces = jax.lax.scan(
@@ -381,6 +423,7 @@ def generate(
     max_len: Optional[int] = None,
     prompt_lengths: Optional[jax.Array] = None,
     kv_quant: str = "",
+    decode_kernel: str = "auto",
 ) -> jax.Array:
     """Decode ``max_new_tokens`` continuations of ``prompt`` [B, S] →
     [B, max_new_tokens].  ``temperature=0`` is greedy; otherwise categorical
@@ -396,7 +439,10 @@ def generate(
     ``kv_quant="int8"``: the KV cache is stored int8 with per-slot scales
     (quantized at write, dequant fused into the attention reads) — halves
     cache HBM traffic and doubles the context budget per byte; gate its
-    held-out perplexity like the int8 weight path (tests/test_quant.py)."""
+    held-out perplexity like the int8 weight path (tests/test_quant.py).
+
+    ``decode_kernel``: per-step attention dispatch (``"auto"`` |
+    ``"pallas"`` | ``"xla"``) — see :func:`cached_attention`."""
     b, s = prompt.shape
     if (top_k or top_p < 1.0) and temperature == 0.0:
         raise ValueError("top_k/top_p truncation requires temperature > 0")
@@ -442,6 +488,7 @@ def generate(
         logits, cache = decode_step(
             params, cache, tok, pos, cfg,
             prompt_lengths=prompt_lengths, prompt_width=s,
+            decode_kernel=decode_kernel,
         )
         return (cache, logits, pos + 1, key), tok
 

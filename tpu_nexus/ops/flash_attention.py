@@ -75,7 +75,8 @@ def _on_tpu() -> bool:
 
 
 def flash_supported(q, k, v) -> bool:
-    """Shapes the kernels handle; callers fall back to XLA otherwise.
+    """Shapes the PREFILL kernels handle; callers fall back to XLA
+    otherwise.
 
     No VMEM-budget clause: K/V stream block-by-block through a KV grid
     axis, so per-program VMEM is O(BLOCK) at any sequence length."""
@@ -87,8 +88,12 @@ def flash_supported(q, k, v) -> bool:
         # _block_for clamps tile edges to a power-of-two divisor >= 128
         and s % 128 == 0
         and sk % 128 == 0
-        # masks anchor q_pos at 0: self-attention only (decode shapes take
-        # the XLA path)
+        # PREFILL-ONLY BY DESIGN, not a silent fallback: the causal masks
+        # anchor q_pos at 0, i.e. self-attention over one contiguous
+        # sequence.  Decode-shaped attention (short q against a longer
+        # positioned cache) is a different kernel with different masking
+        # and carry economics — ops/decode_attention.py owns it, and
+        # models/generate.cached_attention dispatches there.
         and s == sk
         and hq % k.shape[2] == 0
     )

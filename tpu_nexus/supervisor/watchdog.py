@@ -114,12 +114,31 @@ class HeartbeatWatchdog:
 
     @staticmethod
     def _fingerprint(cp) -> Tuple:
+        """RUNNING-sweep progress fingerprint: ANY ledger write counts as
+        liveness (heartbeats, checkpoint commits, column writes)."""
         steps = tuple(sorted(cp.per_chip_steps.items()))
         return (
             cp.lifecycle_stage,
             steps,
             cp.last_modified,
             cp.tensor_checkpoint_uri,
+            cp.restart_count,
+            cp.preempted_generation,
+        )
+
+    @staticmethod
+    def _restart_fingerprint(cp) -> Tuple:
+        """PREEMPTED-sweep fingerprint: RESTART signals only.  A draining
+        generation's workers keep writing for a while after the preemption
+        (late heartbeats flushing, a final checkpoint commit bumping
+        last_modified) — none of that means the JobSet controller is
+        restarting anything, and folding it in re-armed the restart
+        deadline on every stray write, delaying the escalation
+        indefinitely on chatty teardowns.  Only a stage change, a counted
+        incident (restart_count), or a fresh child generation restarts
+        the clock."""
+        return (
+            cp.lifecycle_stage,
             cp.restart_count,
             cp.preempted_generation,
         )
@@ -150,7 +169,7 @@ class HeartbeatWatchdog:
             for cp in rows:
                 key = (cp.algorithm, cp.id)
                 live_keys.add(key)
-                obs = self._observe(key, cp, now)
+                obs = self._observe(key, self._fingerprint(cp), now)
                 if obs is None:
                     continue
                 stalled_for = now - obs.since
@@ -183,7 +202,7 @@ class HeartbeatWatchdog:
             for cp in rows:
                 key = (cp.algorithm, cp.id)
                 live_keys.add(key)
-                obs = self._observe(key, cp, now)
+                obs = self._observe(key, self._restart_fingerprint(cp), now)
                 if obs is None:
                     continue
                 stalled_for = now - obs.since
@@ -218,10 +237,9 @@ class HeartbeatWatchdog:
             if key not in live_keys:
                 del self._observations[key]
 
-    def _observe(self, key, cp, now: float) -> Optional[_Observation]:
+    def _observe(self, key, fp: Tuple, now: float) -> Optional[_Observation]:
         """Record/update the fingerprint observation; returns None when the
         fingerprint just changed (timer restarted)."""
-        fp = self._fingerprint(cp)
         obs = self._observations.get(key)
         if obs is None or obs.fingerprint != fp:
             self._observations[key] = _Observation(fingerprint=fp, since=now)

@@ -13,7 +13,9 @@ container entrypoint; ``NEXUS_PROMPT_LEN`` / ``NEXUS_GEN_TOKENS`` /
 ``NEXUS_TEMPERATURE`` shape the decode; ``NEXUS_STEPS`` counts generate
 rounds; ``NEXUS_CHECKPOINT_DIR`` restores trained weights (the tensor
 checkpoint written by the training harness — params-only, template-free,
-so serve never depends on the training run's optimizer/opt-state layout).
+so serve never depends on the training run's optimizer/opt-state layout);
+``NEXUS_DECODE_KERNEL`` picks the decode attention implementation
+(auto | pallas | xla).
 """
 
 from __future__ import annotations
@@ -63,6 +65,13 @@ class ServeConfig:
     #: perplexity-gated like the weight path (tests/test_quant.py);
     #: "" = cache in model dtype
     quantize_kv: str = ""
+    #: decode-attention dispatch: "auto" (fused split-KV pallas kernel on
+    #: TPU — ops/decode_attention.py — XLA fallback elsewhere) | "pallas"
+    #: | "xla".  from_env reads NEXUS_DECODE_KERNEL, so a deployed
+    #: serving pod flips kernels with one env var and no config rollout
+    #: (a non-auto value set HERE is explicit and wins over ambient env
+    #: downstream — cached_attention precedence)
+    decode_kernel: str = "auto"
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
@@ -83,6 +92,7 @@ class ServeConfig:
             seed=int(e.get("NEXUS_SEED", "0")),
             quantize=e.get("NEXUS_QUANTIZE", ""),
             quantize_kv=e.get("NEXUS_QUANTIZE_KV", ""),
+            decode_kernel=e.get("NEXUS_DECODE_KERNEL", "auto"),
         )
 
 
@@ -129,6 +139,10 @@ def run_serving(
         logger.info("serving with int8 weight-only quantization")
     if cfg.quantize_kv and cfg.quantize_kv != "int8":
         raise ValueError(f"unknown quantize_kv mode {cfg.quantize_kv!r}; use 'int8'")
+    if cfg.decode_kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"unknown decode_kernel mode {cfg.decode_kernel!r}; use auto, pallas, or xla"
+        )
 
     if prompts is None:
         prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
@@ -144,6 +158,7 @@ def run_serving(
             top_k=cfg.top_k,
             top_p=cfg.top_p,
             kv_quant=cfg.quantize_kv,
+            decode_kernel=cfg.decode_kernel,
         )
     )
     key = jax.random.PRNGKey(cfg.seed)
