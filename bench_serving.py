@@ -1,0 +1,204 @@
+"""Serving bench: continuous batching vs the lockstep round loop.
+
+The point of ``tpu_nexus/serving`` in one number: under MIXED generation
+lengths, the lockstep loop (``run_serving``-style rounds — every request
+in a round waits for the round's longest generation) burns decode steps
+on finished rows, while the engine retires and refills slots every
+iteration.  Both schedulers process the SAME request set at the SAME slot
+count on the SAME jitted model functions; the JSON artifact records both
+completed-tokens/s numbers plus the engine's TTFT/TPOT p50/p99 under
+Poisson arrivals.
+
+Usage: ``python bench_serving.py`` — prints one JSON line and writes the
+artifact itself (``NEXUS_SERVING_OUT``, default BENCH_SERVING_r06.json;
+do NOT shell-redirect stdout onto the same file).  Pure CPU, tiny config,
+fixed seeds, finishes in seconds (CI hygiene like bench_latency.py).
+Knobs: ``NEXUS_SERVING_REQUESTS`` / ``NEXUS_SERVING_SLOTS`` /
+``NEXUS_SERVING_ARRIVAL_RPS``.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.serving import ModelExecutor, RequestState, ServingEngine, ServingMetrics
+
+SEED = 0
+N_REQUESTS = int(os.environ.get("NEXUS_SERVING_REQUESTS", "48"))
+NUM_SLOTS = int(os.environ.get("NEXUS_SERVING_SLOTS", "8"))
+#: default arrival rate sits UNDER the CPU engine's measured capacity
+#: (~30 req/s at this config) so the TTFT/TPOT percentiles reflect
+#: scheduling latency, not unbounded queue buildup from overload
+ARRIVAL_RPS = float(os.environ.get("NEXUS_SERVING_ARRIVAL_RPS", "24"))
+PROMPT_RANGE = (4, 16)
+#: mixed-length traffic: the variance is what lockstep rounds pay for —
+#: nearly every lockstep round contains one 64-token generation and runs
+#: its short requests' slots idle to the end of it
+GEN_CHOICES = (2, 8, 64)
+MAX_LEN = PROMPT_RANGE[1] + max(GEN_CHOICES)
+
+
+def bench_model() -> LlamaConfig:
+    """Small enough to finish in seconds on CPU, big enough (~6 ms/decode
+    step at batch 8) that a decode step costs real compute relative to the
+    engine's per-iteration host work — at `LlamaConfig.tiny` scale the
+    bench would measure Python dispatch, not scheduling."""
+    return LlamaConfig(
+        vocab_size=512, hidden=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=32, intermediate=512, max_seq_len=2 * MAX_LEN, remat=False,
+    )
+
+
+def make_requests(rng):
+    reqs = []
+    for _ in range(N_REQUESTS):
+        n = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
+        reqs.append(
+            {
+                "prompt": rng.integers(1, 256, size=n).astype(np.int32),
+                "gen": int(rng.choice(GEN_CHOICES)),
+            }
+        )
+    return reqs
+
+
+def run_engine_offline(params, cfg, requests):
+    """All requests queued at t=0: pure completed-tokens/s."""
+    executor = ModelExecutor(params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED)
+    engine = ServingEngine(executor)
+    # warmup: one request per prefill bucket in play + the decode step
+    for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
+        engine.submit(np.arange(1, width + 1, dtype=np.int32), 2)
+    engine.run_until_drained()
+    engine.metrics = ServingMetrics()
+    n_warm = len(engine.retired)
+
+    t0 = time.perf_counter()
+    for i, r in enumerate(requests):
+        engine.submit(r["prompt"], r["gen"], request_id=f"off-{i}")
+    engine.run_until_drained()
+    elapsed = time.perf_counter() - t0
+    done = engine.retired[n_warm:]
+    tokens = sum(
+        len(r.output_tokens) for r in done if r.state == RequestState.FINISHED
+    )
+    return tokens, elapsed, engine.steps
+
+
+def run_engine_poisson(params, cfg, requests, rng):
+    """Open-loop Poisson arrivals: the latency SLO view (TTFT/TPOT)."""
+    executor = ModelExecutor(params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED)
+    engine = ServingEngine(executor)
+    for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
+        engine.submit(np.arange(1, width + 1, dtype=np.int32), 2)
+    engine.run_until_drained()
+    engine.metrics = metrics = ServingMetrics()
+
+    offsets = np.cumsum(rng.exponential(1.0 / ARRIVAL_RPS, size=len(requests)))
+    t0 = time.perf_counter()
+    idx = 0
+    while idx < len(requests) or engine.has_work:
+        now = time.perf_counter() - t0
+        while idx < len(requests) and offsets[idx] <= now:
+            engine.submit(requests[idx]["prompt"], requests[idx]["gen"], request_id=f"poi-{idx}")
+            idx += 1
+        if engine.has_work:
+            engine.step()
+        elif idx < len(requests):
+            time.sleep(min(0.001, offsets[idx] - now))
+    return metrics.summary()
+
+
+def run_lockstep(params, cfg, requests):
+    """The run_serving discipline: rounds of NUM_SLOTS requests, each
+    round decoding to its LONGEST request's budget (prompts right-padded
+    with per-row prompt_lengths — the ragged ``generate`` contract).
+    Useful tokens = what each request actually asked for; the overshoot
+    is the waste this bench prices."""
+    width = PROMPT_RANGE[1]
+    gen_fns = {}
+    for t in sorted({g for g in GEN_CHOICES}):
+        gen_fns[t] = jax.jit(
+            functools.partial(
+                generate, cfg=cfg, max_new_tokens=t, max_len=width + t
+            )
+        )
+    rounds = [requests[i : i + NUM_SLOTS] for i in range(0, len(requests), NUM_SLOTS)]
+
+    def batch_of(round_reqs):
+        padded = np.zeros((NUM_SLOTS, width), np.int32)
+        lens = np.ones(NUM_SLOTS, np.int32)  # pad rows decode garbage, uncounted
+        for j, r in enumerate(round_reqs):
+            padded[j, : len(r["prompt"])] = r["prompt"]
+            lens[j] = len(r["prompt"])
+        return jnp.asarray(padded), jnp.asarray(lens)
+
+    # warmup every distinct round shape (compile excluded, like run_serving)
+    for t in gen_fns:
+        p, l = batch_of(rounds[0])
+        jax.block_until_ready(gen_fns[t](params, p, prompt_lengths=l))
+
+    t0 = time.perf_counter()
+    useful = 0
+    for round_reqs in rounds:
+        t = max(r["gen"] for r in round_reqs)
+        p, l = batch_of(round_reqs)
+        jax.block_until_ready(gen_fns[t](params, p, prompt_lengths=l))
+        useful += sum(r["gen"] for r in round_reqs)
+    return useful, time.perf_counter() - t0
+
+
+def main():
+    rng = np.random.default_rng(SEED)
+    cfg = bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+    requests = make_requests(rng)
+
+    engine_tokens, engine_s, engine_steps = run_engine_offline(params, cfg, requests)
+    lock_tokens, lock_s = run_lockstep(params, cfg, requests)
+    poisson = run_engine_poisson(params, cfg, requests, rng)
+
+    engine_tps = engine_tokens / engine_s if engine_s > 0 else 0.0
+    lock_tps = lock_tokens / lock_s if lock_s > 0 else 0.0
+    result = {
+        "metric": "serving_completed_tokens_per_second",
+        "value": round(engine_tps, 2),
+        "unit": "tokens/s",
+        "lockstep_tokens_per_second": round(lock_tps, 2),
+        "speedup_vs_lockstep": round(engine_tps / lock_tps, 3) if lock_tps else None,
+        "requests": N_REQUESTS,
+        "slots": NUM_SLOTS,
+        "prompt_len_range": list(PROMPT_RANGE),
+        "gen_tokens_choices": list(GEN_CHOICES),
+        "useful_tokens": engine_tokens,
+        "engine_elapsed_s": round(engine_s, 4),
+        "engine_steps": engine_steps,
+        "lockstep_elapsed_s": round(lock_s, 4),
+        "poisson": {
+            "arrival_rps": ARRIVAL_RPS,
+            "ttft_p50_s": round(poisson["ttft_p50_s"], 5),
+            "ttft_p99_s": round(poisson["ttft_p99_s"], 5),
+            "tpot_p50_s": round(poisson["tpot_p50_s"], 5),
+            "tpot_p99_s": round(poisson["tpot_p99_s"], 5),
+        },
+        "seed": SEED,
+        "model": "llama-bench-4L-h256",
+        "backend": jax.default_backend(),
+    }
+    with open(os.environ.get("NEXUS_SERVING_OUT", "BENCH_SERVING_r06.json"), "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -42,6 +42,26 @@ def test_statsd_unreachable_never_raises():
     client = StatsdClient("ns", address="unix:///nonexistent/path.sock")
     client.count("x")  # must not raise
     client.timing("y", 0.5)
+    client.histogram("z", 1.25)
+
+
+def test_statsd_histogram_datagram_format():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2)
+    port = sock.getsockname()[1]
+    client = StatsdClient("tpu_nexus", address=f"udp://127.0.0.1:{port}")
+    client.histogram("serving.ttft_seconds", 0.125, tags={"mode": "engine"})
+    data, _ = sock.recvfrom(4096)
+    assert data.decode() == "tpu_nexus.serving.ttft_seconds:0.125|h|#mode:engine"
+    sock.close()
+
+
+def test_recording_histogram_accumulates_samples():
+    m = RecordingMetrics()
+    m.histogram("ttft", 0.1)
+    m.histogram("ttft", 0.3)
+    assert m.histograms["ttft"] == [0.1, 0.3]
 
 
 def test_timer_records():

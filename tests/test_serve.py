@@ -9,7 +9,7 @@ from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
 from tpu_nexus.models import LlamaConfig, MnistConfig
 from tpu_nexus.parallel import MeshSpec
 from tpu_nexus.parallel.distributed import ProcessContext
-from tpu_nexus.workload.serve import ServeConfig, run_serving
+from tpu_nexus.workload.serve import ServeConfig, run_serve_engine, run_serving
 
 CTX = ProcessContext(
     run_id="serve-1", algorithm="llama-serve", process_id=0, num_processes=1,
@@ -85,3 +85,95 @@ class TestServe:
         )
         summary = run_serving(cfg, store=store, ctx=CTX)
         assert summary["last_tokens_shape"] == (2, 4)
+
+
+class TestServeConfigValidation:
+    """Value validation happens at ServeConfig CONSTRUCTION — a bad env
+    fails at parse time in both the lockstep loop and the engine, before
+    any model/device work starts."""
+
+    def test_bad_quantize_fails_at_construction(self):
+        with pytest.raises(ValueError, match="unknown quantize mode 'int4'"):
+            ServeConfig(quantize="int4")
+
+    def test_bad_quantize_kv_fails_at_construction(self):
+        with pytest.raises(ValueError, match="unknown quantize_kv mode 'fp8'"):
+            ServeConfig(quantize_kv="fp8")
+
+    def test_bad_decode_kernel_fails_at_construction(self):
+        with pytest.raises(ValueError, match="unknown decode_kernel mode 'triton'"):
+            ServeConfig(decode_kernel="triton")
+
+    def test_truncation_without_temperature_fails(self):
+        with pytest.raises(ValueError, match="requires temperature > 0"):
+            ServeConfig(top_k=50)  # default temperature is 0.0
+        with pytest.raises(ValueError, match="outside"):
+            ServeConfig(temperature=0.7, top_p=1.5)
+        assert ServeConfig(temperature=0.7, top_k=50).top_k == 50
+
+    def test_nonpositive_shape_fields_fail(self):
+        with pytest.raises(ValueError, match="gen_tokens must be >= 1"):
+            ServeConfig(gen_tokens=0)
+        with pytest.raises(ValueError, match="rounds must be >= 1"):
+            ServeConfig(rounds=-1)
+
+    def test_bad_env_fails_at_parse_time(self):
+        env = {"NEXUS_QUANTIZE_KV": "int4", "NEXUS_MODEL_PRESET": "tiny"}
+        with pytest.raises(ValueError, match="unknown quantize_kv"):
+            ServeConfig.from_env(env)
+
+    def test_valid_values_accepted(self):
+        cfg = ServeConfig(quantize="int8", quantize_kv="int8", decode_kernel="xla")
+        assert (cfg.quantize, cfg.quantize_kv, cfg.decode_kernel) == (
+            "int8", "int8", "xla",
+        )
+
+
+class TestServeEngine:
+    """NEXUS_MODE=serve-engine: the continuous-batching loop under the
+    same ledger protocol as the lockstep loop."""
+
+    def test_ledger_protocol_and_summary(self):
+        store = _seeded_store()
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=4, rounds=2, heartbeat_every=2,
+        )
+        summary = run_serve_engine(cfg, store=store, ctx=CTX)
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED
+        assert row.per_chip_steps  # heartbeats landed
+        assert summary["requests"] == 4  # rounds * batch individual requests
+        assert summary["finished"] == 4
+        assert summary["decoded_tokens_per_second"] > 0
+        assert summary["ttft_p50_s"] > 0
+        assert summary["tpot_p50_s"] > 0
+
+    def test_non_lm_adapter_refused(self):
+        with pytest.raises(ValueError, match="LM adapter"):
+            run_serve_engine(
+                ServeConfig(model=MnistConfig()), store=_seeded_store(), ctx=CTX
+            )
+
+    def test_serves_trained_checkpoint(self, tmp_path):
+        from tpu_nexus.parallel import MeshSpec
+        from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+        from tpu_nexus.workload.train import TrainConfig
+
+        train_store = _seeded_store()
+        tcfg = WorkloadConfig(
+            model=LlamaConfig.tiny(), mesh=MeshSpec(fsdp=-1), batch_size=4,
+            seq_len=32, steps=2, heartbeat_every=2, checkpoint_every=2,
+            checkpoint_dir=str(tmp_path),
+            train=TrainConfig(warmup_steps=2, total_steps=50),
+        )
+        run_workload(tcfg, store=train_store, ctx=CTX)
+
+        store = _seeded_store()
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=3, rounds=1, checkpoint_dir=str(tmp_path),
+        )
+        summary = run_serve_engine(cfg, store=store, ctx=CTX)
+        assert summary["restored_from"] == 2
+        assert store.read_checkpoint(CTX.algorithm, CTX.run_id).lifecycle_stage == LifecycleStage.COMPLETED

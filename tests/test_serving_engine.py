@@ -1,0 +1,500 @@
+"""Continuous-batching serving engine (tpu_nexus/serving).
+
+Three layers, cheapest first:
+
+* pure host-side units — request state machine, slot allocator, scheduler;
+* randomized scheduler invariants — hundreds of synthetic arrival/length/
+  cancel scenarios against a fake executor (no device): no slot leak, no
+  double-assignment, FIFO admission order, every request terminal;
+* engine-vs-generate parity — greedy per-request outputs token-identical
+  to one-shot ``generate`` across bf16/int8-KV caches and both decode
+  kernels (pallas via the CPU interpreter where the jax supports it).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.core.telemetry import RecordingMetrics
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.serving import (
+    ACTIVE_STATES,
+    TERMINAL_STATES,
+    TRANSITIONS,
+    FifoScheduler,
+    IllegalTransition,
+    KVSlotManager,
+    ModelExecutor,
+    Request,
+    RequestState,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    SlotError,
+    percentile,
+)
+from tpu_nexus.serving.engine import RETIREMENT_ACTIONS, _prefill_buckets
+
+
+class FakeExecutor:
+    """Deterministic device stand-in: first token = last prompt token + 1,
+    every decode step increments.  Lets the invariant fuzzer run hundreds
+    of scenarios without compiling anything."""
+
+    def __init__(self, num_slots: int, max_len: int) -> None:
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.begins = []  # (slot, prompt_len) audit trail
+
+    def begin(self, slot, prompt):
+        self.begins.append((slot, len(prompt)))
+        return (int(prompt[-1]) + 1) % 1000
+
+    def step(self, tokens, cursors):
+        return np.asarray(tokens) + 1
+
+
+def make_engine(num_slots=2, max_len=64, sched_cfg=None, metrics=None):
+    fake = FakeExecutor(num_slots, max_len)
+    return ServingEngine(
+        fake,
+        scheduler=FifoScheduler(sched_cfg or SchedulerConfig()),
+        metrics=metrics or ServingMetrics(),
+    )
+
+
+# -- request state machine -----------------------------------------------------
+
+
+class TestRequestStateMachine:
+    def test_happy_path_transitions(self):
+        req = Request(request_id="r", prompt=np.array([1, 2]), max_new_tokens=3)
+        assert req.state == RequestState.QUEUED
+        req.transition(RequestState.PREFILLING)
+        req.transition(RequestState.DECODING)
+        req.transition(RequestState.FINISHED)
+        assert req.is_terminal()
+
+    def test_illegal_transition_raises(self):
+        req = Request(request_id="r", prompt=np.array([1]), max_new_tokens=1)
+        with pytest.raises(IllegalTransition, match="Queued -> Decoding"):
+            req.transition(RequestState.DECODING)
+
+    def test_terminal_states_never_transition(self):
+        for terminal in TERMINAL_STATES:
+            req = Request(request_id="r", prompt=np.array([1]), max_new_tokens=1)
+            req.state = terminal
+            for target in (RequestState.QUEUED, RequestState.DECODING):
+                with pytest.raises(IllegalTransition):
+                    req.transition(target)
+
+    def test_tables_are_total_at_runtime(self):
+        """The NX005 invariants, checked dynamically too: TRANSITIONS is
+        total, TERMINAL/ACTIVE partition the states, terminal <=> no
+        outgoing, retirement dispatch covers every terminal state."""
+        members = {
+            v for k, v in vars(RequestState).items() if k.isupper()
+        }
+        assert set(TRANSITIONS) == members
+        assert TERMINAL_STATES | ACTIVE_STATES == members
+        assert not TERMINAL_STATES & ACTIVE_STATES
+        for state, successors in TRANSITIONS.items():
+            assert (not successors) == (state in TERMINAL_STATES)
+        assert set(RETIREMENT_ACTIONS) == TERMINAL_STATES
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="empty prompt"):
+            Request(request_id="r", prompt=np.array([]), max_new_tokens=1)
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            Request(request_id="r", prompt=np.array([1]), max_new_tokens=0)
+
+    def test_emit_tracks_ttft_and_intervals(self):
+        req = Request(
+            request_id="r", prompt=np.array([1]), max_new_tokens=3, submitted_at=1.0
+        )
+        assert req.emit(5, 2.0) is None
+        assert req.first_token_at == 2.0
+        assert req.emit(6, 2.5) == 0.5
+        assert req.output_tokens == [5, 6]
+
+
+# -- slot manager --------------------------------------------------------------
+
+
+class TestKVSlotManager:
+    def test_allocation_is_deterministic_lowest_first(self):
+        mgr = KVSlotManager(3, 16)
+        assert [mgr.allocate(f"r{i}") for i in range(3)] == [0, 1, 2]
+        assert mgr.allocate("r3") is None
+        mgr.free(1)
+        assert mgr.allocate("r4") == 1
+
+    def test_double_free_raises(self):
+        mgr = KVSlotManager(2, 16)
+        slot = mgr.allocate("a")
+        mgr.free(slot)
+        with pytest.raises(SlotError, match="double free"):
+            mgr.free(slot)
+        with pytest.raises(SlotError):
+            mgr.free(1)  # never allocated
+
+    def test_eviction_candidate_is_youngest(self):
+        mgr = KVSlotManager(3, 16)
+        for name in ("old", "mid", "new"):
+            mgr.allocate(name)
+        assert mgr.owner(mgr.eviction_candidate()) == "new"
+        mgr.free(2)
+        assert mgr.owner(mgr.eviction_candidate()) == "mid"
+
+    def test_occupancy_and_fits(self):
+        mgr = KVSlotManager(4, 16)
+        mgr.allocate("a")
+        assert mgr.occupancy() == 0.25
+        assert mgr.fits(16) and not mgr.fits(17)
+
+
+# -- scheduler -----------------------------------------------------------------
+
+
+def _req(rid, prompt_len=4, max_new=4):
+    return Request(
+        request_id=rid, prompt=np.arange(1, prompt_len + 1), max_new_tokens=max_new
+    )
+
+
+class TestFifoScheduler:
+    def test_fifo_order_and_slot_bound(self):
+        sched = FifoScheduler()
+        for i in range(5):
+            sched.submit(_req(f"r{i}"))
+        assert [r.request_id for r in sched.admit(3)] == ["r0", "r1", "r2"]
+        assert [r.request_id for r in sched.admit(3)] == ["r3", "r4"]
+        assert sched.admitted_order == [f"r{i}" for i in range(5)]
+
+    def test_prefill_budget_bounds_admission(self):
+        sched = FifoScheduler(SchedulerConfig(prefill_token_budget=10))
+        for i in range(3):
+            sched.submit(_req(f"r{i}", prompt_len=6))
+        # 6 + 6 > 10: second admission deferred to the next step
+        assert [r.request_id for r in sched.admit(3)] == ["r0"]
+        assert [r.request_id for r in sched.admit(3)] == ["r1"]
+
+    def test_budget_floor_admits_oversized_head(self):
+        sched = FifoScheduler(SchedulerConfig(prefill_token_budget=4))
+        sched.submit(_req("big", prompt_len=16))
+        assert [r.request_id for r in sched.admit(1)] == ["big"]
+
+    def test_starvation_guard_trips_after_bound(self):
+        sched = FifoScheduler(SchedulerConfig(evict_after_steps=3))
+        sched.submit(_req("waiting"))
+        for _ in range(2):
+            sched.tick()
+            assert not sched.head_starving()
+        sched.tick()
+        assert sched.head_starving()
+
+    def test_starvation_guard_disabled_by_default(self):
+        sched = FifoScheduler()
+        sched.submit(_req("waiting"))
+        for _ in range(100):
+            sched.tick()
+        assert not sched.head_starving()
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SchedulerConfig(prefill_token_budget=0)
+        with pytest.raises(ValueError):
+            SchedulerConfig(evict_after_steps=-1)
+
+
+# -- engine behavior against the fake executor ---------------------------------
+
+
+class TestEngineBehavior:
+    def test_finishes_and_streams(self):
+        got = []
+        eng = make_engine(num_slots=2)
+        req = eng.submit(
+            np.array([3, 4]), 3, stream=lambda r, tok: got.append(tok)
+        )
+        eng.run_until_drained(max_steps=100)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == [5, 6, 7]  # fake: last+1, then +1 per step
+        assert got == req.output_tokens
+
+    def test_one_token_request_finishes_at_prefill(self):
+        eng = make_engine()
+        req = eng.submit(np.array([9]), 1)
+        eng.run_until_drained(max_steps=10)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == [10]
+        assert eng.slots.used_count == 0
+
+    def test_submit_rejects_oversized_request(self):
+        eng = make_engine(max_len=8)
+        with pytest.raises(ValueError, match="exceeds cache max_len"):
+            eng.submit(np.arange(1, 7), 3)  # 6 + 3 > 8
+
+    def test_cancel_queued_request(self):
+        eng = make_engine(num_slots=1)
+        a = eng.submit(np.array([1, 2]), 50)
+        b = eng.submit(np.array([3, 4]), 5)
+        eng.step()  # a admitted, b queued
+        assert eng.cancel(b.request_id)
+        eng.run_until_drained(max_steps=200)
+        assert b.state == RequestState.CANCELLED
+        assert b.output_tokens == []
+        assert a.state == RequestState.FINISHED
+        # a cancelled-in-queue request never counts as admitted
+        assert eng.scheduler.admitted_order == [a.request_id]
+
+    def test_cancel_decoding_request_frees_slot(self):
+        eng = make_engine(num_slots=1)
+        a = eng.submit(np.array([1, 2]), 50)
+        b = eng.submit(np.array([3, 4]), 2)
+        eng.step()
+        assert a.state == RequestState.DECODING
+        eng.cancel(a.request_id)
+        eng.run_until_drained(max_steps=100)
+        assert a.state == RequestState.CANCELLED
+        assert 0 < len(a.output_tokens) < 50  # partial output delivered
+        assert b.state == RequestState.FINISHED
+
+    def test_cancel_unknown_or_terminal_is_false(self):
+        eng = make_engine()
+        assert not eng.cancel("nope")
+        req = eng.submit(np.array([1]), 1)
+        eng.run_until_drained(max_steps=10)
+        assert not eng.cancel(req.request_id)
+
+    def test_starvation_guard_evicts_youngest(self):
+        eng = make_engine(
+            num_slots=2, max_len=128, sched_cfg=SchedulerConfig(evict_after_steps=3)
+        )
+        old = eng.submit(np.array([1]), 100)
+        young = eng.submit(np.array([2]), 100)
+        waiting = eng.submit(np.array([3]), 4)
+        eng.run_until_drained(max_steps=300)
+        assert young.state == RequestState.EVICTED  # youngest slot reclaimed
+        assert old.state == RequestState.FINISHED
+        assert waiting.state == RequestState.FINISHED
+        assert 0 < len(young.output_tokens) < 100
+
+    def test_continuous_refill_interleaves(self):
+        """Slots refill the moment a request retires: with 2 slots and
+        mixed lengths, a later short request finishes while an early long
+        one is still decoding — the lockstep round loop cannot do this."""
+        eng = make_engine(num_slots=2, max_len=64)
+        long = eng.submit(np.array([1]), 40)
+        short1 = eng.submit(np.array([2]), 3)
+        short2 = eng.submit(np.array([3]), 3)
+        eng.run_until_drained(max_steps=200)
+        order = [r.request_id for r in eng.retired]
+        assert order.index(short2.request_id) < order.index(long.request_id)
+
+    def test_metrics_histograms_emitted(self):
+        rec = RecordingMetrics()
+        eng = make_engine(metrics=ServingMetrics(rec))
+        eng.submit(np.array([1, 2]), 4)
+        eng.run_until_drained(max_steps=50)
+        assert len(rec.histograms["serving.ttft_seconds"]) == 1
+        assert len(rec.histograms["serving.tpot_seconds"]) == 3
+        assert len(rec.histograms["serving.queue_wait_seconds"]) == 1
+        assert rec.counters["serving.requests_retired"] == 1
+        assert rec.gauges["serving.slot_occupancy"] == 0.0  # drained
+        summary = eng.metrics.summary()
+        assert summary["tokens_out"] == 4
+        assert summary["requests_retired"] == {RequestState.FINISHED: 1}
+
+    def test_liveness_backstop_raises(self):
+        eng = make_engine(num_slots=1)
+        eng.submit(np.array([1]), 60)
+        with pytest.raises(RuntimeError, match="not drained"):
+            eng.run_until_drained(max_steps=5)
+
+
+def test_percentile_nearest_rank():
+    assert percentile([], 50) == 0.0
+    assert percentile([3.0, 1.0, 2.0], 50) == 2.0
+    assert percentile([1.0, 2.0, 3.0, 4.0], 99) == 4.0
+
+
+def test_prefill_buckets_cover_max_len():
+    assert _prefill_buckets(64) == [8, 16, 32, 64]
+    assert _prefill_buckets(24) == [8, 16, 24]
+    assert _prefill_buckets(8) == [8]
+    assert _prefill_buckets(6) == [6]
+
+
+# -- randomized scheduler invariants -------------------------------------------
+
+
+def _fuzz_one(seed: int):
+    rng = np.random.default_rng(seed)
+    num_slots = int(rng.integers(1, 5))
+    max_len = int(rng.integers(8, 48))
+    sched_cfg = SchedulerConfig(
+        prefill_token_budget=int(rng.integers(1, 2 * max_len)),
+        evict_after_steps=int(rng.choice([0, 0, 2, 5])),
+    )
+    eng = make_engine(num_slots=num_slots, max_len=max_len, sched_cfg=sched_cfg)
+
+    n_requests = int(rng.integers(1, 20))
+    requests = []
+    submitted_order = []
+    # arrival pattern: a burst up front, the rest trickling in mid-flight
+    arrivals = sorted(int(a) for a in rng.integers(0, 30, size=n_requests))
+    to_cancel = set(
+        int(i) for i in rng.choice(n_requests, size=n_requests // 4, replace=False)
+    ) if n_requests >= 4 else set()
+
+    step = 0
+    idx = 0
+    max_total_steps = 5000
+    while idx < len(arrivals) or eng.has_work:
+        while idx < len(arrivals) and arrivals[idx] <= step:
+            prompt_len = int(rng.integers(1, max_len))
+            max_new = int(rng.integers(1, max_len - prompt_len + 1))
+            req = eng.submit(rng.integers(1, 100, size=prompt_len), max_new)
+            requests.append(req)
+            submitted_order.append(req.request_id)
+            if len(requests) - 1 in to_cancel:
+                eng.cancel(req.request_id)
+            idx += 1
+        if eng.has_work:
+            eng.step()
+        # no double-assignment: every busy slot has exactly one owner and
+        # that owner is a live (non-terminal) request holding that slot
+        owners = eng.slots.owners()
+        assert len(set(owners.values())) == len(owners)
+        for slot, rid in owners.items():
+            assert eng.requests[rid].slot == slot
+            assert not eng.requests[rid].is_terminal()
+        step += 1
+        assert step < max_total_steps, f"seed {seed}: engine did not drain"
+
+    # every admitted request reached a terminal state
+    for req in requests:
+        assert req.is_terminal(), f"seed {seed}: {req.request_id} in {req.state}"
+        if req.state == RequestState.FINISHED:
+            assert len(req.output_tokens) == req.max_new_tokens
+        else:
+            assert len(req.output_tokens) < req.max_new_tokens
+    # no slot leak
+    assert eng.slots.used_count == 0
+    assert eng.slots.free_count == num_slots
+    # FIFO: admission order == submission order minus queue-cancelled
+    admitted = set(eng.scheduler.admitted_order)
+    expected = [rid for rid in submitted_order if rid in admitted]
+    assert eng.scheduler.admitted_order == expected, f"seed {seed}: FIFO violated"
+
+
+def test_randomized_scheduler_invariants():
+    """A few hundred synthetic arrival/length/cancel scenarios: no slot
+    leak, no double-assignment, FIFO admission preserved, every admitted
+    request reaches a terminal state (ISSUE 3 acceptance)."""
+    for seed in range(250):
+        _fuzz_one(seed)
+
+
+# -- engine <-> generate parity ------------------------------------------------
+
+
+def _interpret_works() -> bool:
+    from tpu_nexus.ops.decode_attention import decode_attention
+
+    try:
+        q = jnp.ones((1, 1, 2, 8), jnp.float32)
+        kv = jnp.ones((1, 16, 2, 8), jnp.float32)
+        decode_attention(q, kv, kv, jnp.asarray(4, jnp.int32), interpret=True)
+        return True
+    except Exception:  # noqa: BLE001 - any interpreter failure means "skip env"
+        return False
+
+
+_CAN_INTERPRET = _interpret_works()
+
+CFG = LlamaConfig.tiny()
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+
+
+def _kernels():
+    yield "xla"
+    if _CAN_INTERPRET:
+        yield "pallas"
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("kernel", list(_kernels()))
+@pytest.mark.parametrize("ragged", [False, True])
+def test_engine_matches_generate(kv_quant, kernel, ragged):
+    """Greedy engine outputs are token-identical to one-shot ``generate``
+    for a fixed request set — bf16/int8 KV, both decode kernels, uniform
+    and ragged prompts (ISSUE 3 acceptance)."""
+    B, S, T = 3, 8, 5
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(1, CFG.vocab_size, size=(B, S)).astype(np.int32)
+    lens = np.array([5, 8, 3], np.int32) if ragged else np.full(B, S, np.int32)
+    padded = prompts.copy()
+    for i, n in enumerate(lens):
+        padded[i, n:] = 0
+
+    ref = np.asarray(
+        generate(
+            PARAMS,
+            jnp.asarray(padded),
+            CFG,
+            max_new_tokens=T,
+            max_len=S + T,
+            prompt_lengths=jnp.asarray(lens) if ragged else None,
+            kv_quant=kv_quant,
+            decode_kernel=kernel,
+        )
+    )
+
+    executor = ModelExecutor(
+        PARAMS,
+        CFG,
+        num_slots=B,
+        max_len=S + T,
+        kv_quant=kv_quant,
+        decode_kernel=kernel,
+    )
+    eng = ServingEngine(executor)
+    reqs = [eng.submit(padded[i, : lens[i]], T) for i in range(B)]
+    eng.run_until_drained(max_steps=1000)
+    out = np.stack([np.asarray(r.output_tokens) for r in reqs])
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_staggered_refill_matches_solo_generate():
+    """num_slots < requests: every request's tokens still equal its SOLO
+    one-shot generate — slot reuse and mid-flight admission change
+    nothing about any individual decode."""
+    S, T, N = 8, 5, 5
+    rng = np.random.default_rng(11)
+    prompts = rng.integers(1, CFG.vocab_size, size=(N, S)).astype(np.int32)
+    executor = ModelExecutor(PARAMS, CFG, num_slots=2, max_len=S + T)
+    eng = ServingEngine(executor)
+    reqs = [eng.submit(prompts[i], T) for i in range(N)]
+    eng.run_until_drained(max_steps=1000)
+    for i, req in enumerate(reqs):
+        solo = np.asarray(
+            generate(
+                PARAMS, jnp.asarray(prompts[i : i + 1]), CFG,
+                max_new_tokens=T, max_len=S + T,
+            )
+        )[0]
+        np.testing.assert_array_equal(np.asarray(req.output_tokens), solo)
+
+
+def test_executor_rejects_bad_config():
+    with pytest.raises(ValueError, match="decode_kernel"):
+        ModelExecutor(PARAMS, CFG, num_slots=1, max_len=16, decode_kernel="triton")
+    with pytest.raises(ValueError, match="temperature"):
+        ModelExecutor(PARAMS, CFG, num_slots=1, max_len=16, top_k=5)
+    with pytest.raises(ValueError, match="kv_quant"):
+        ModelExecutor(PARAMS, CFG, num_slots=1, max_len=16, kv_quant="fp8")

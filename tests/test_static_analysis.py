@@ -351,6 +351,126 @@ def test_nx003_justification_on_wrapped_clause_line():
     assert lint_source(src, "NX003") == []
 
 
+# -- NX005 request-state totality ----------------------------------------------
+
+REQUEST_OK = """
+class RequestState:
+    QUEUED = "Queued"
+    DECODING = "Decoding"
+    FINISHED = "Finished"
+
+TRANSITIONS = {
+    RequestState.QUEUED: frozenset({RequestState.DECODING}),
+    RequestState.DECODING: frozenset({RequestState.FINISHED}),
+    RequestState.FINISHED: frozenset(),
+}
+TERMINAL_STATES = frozenset({RequestState.FINISHED})
+ACTIVE_STATES = frozenset({RequestState.QUEUED, RequestState.DECODING})
+"""
+
+ENGINE_OK = """
+RETIREMENT_ACTIONS = {
+    RequestState.FINISHED: "completed",
+}
+"""
+
+
+def _lint_serving(request_src, engine_src=ENGINE_OK):
+    extra = [("serving/engine.py", engine_src)] if engine_src is not None else []
+    return lint_source(
+        request_src, "NX005", rel_path="serving/request.py", extra=extra
+    )
+
+
+def test_nx005_clean_state_machine_passes():
+    assert _lint_serving(REQUEST_OK) == []
+
+
+def test_nx005_constant_without_transitions_row():
+    src = REQUEST_OK.replace(
+        'FINISHED = "Finished"', 'FINISHED = "Finished"\n    PAUSED = "Paused"'
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any("PAUSED has no TRANSITIONS row" in m for m in messages)
+    assert any("neither TERMINAL_STATES nor ACTIVE_STATES" in m for m in messages)
+
+
+def test_nx005_terminal_with_outgoing_transitions():
+    src = REQUEST_OK.replace(
+        "RequestState.FINISHED: frozenset(),",
+        "RequestState.FINISHED: frozenset({RequestState.QUEUED}),",
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any("terminal state RequestState.FINISHED declares outgoing" in m for m in messages)
+
+
+def test_nx005_active_dead_end():
+    src = REQUEST_OK.replace(
+        "RequestState.DECODING: frozenset({RequestState.FINISHED}),",
+        "RequestState.DECODING: frozenset(),",
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any("unretirable dead end" in m for m in messages)
+
+
+def test_nx005_state_in_both_partitions():
+    src = REQUEST_OK.replace(
+        "ACTIVE_STATES = frozenset({RequestState.QUEUED, RequestState.DECODING})",
+        "ACTIVE_STATES = frozenset({RequestState.QUEUED, RequestState.DECODING, RequestState.FINISHED})",
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any("both TERMINAL_STATES and ACTIVE_STATES" in m for m in messages)
+
+
+def test_nx005_stale_transition_target():
+    src = REQUEST_OK.replace(
+        "frozenset({RequestState.DECODING})",
+        "frozenset({RequestState.DECODING, RequestState.GONE})",
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any("references unknown RequestState.GONE" in m for m in messages)
+
+
+def test_nx005_retirement_dispatch_missing_terminal():
+    src = REQUEST_OK.replace(
+        "TERMINAL_STATES = frozenset({RequestState.FINISHED})",
+        "TERMINAL_STATES = frozenset({RequestState.FINISHED, RequestState.DECODING})",
+    ).replace(
+        "ACTIVE_STATES = frozenset({RequestState.QUEUED, RequestState.DECODING})",
+        "ACTIVE_STATES = frozenset({RequestState.QUEUED})",
+    )
+    messages = [f.message for f in _lint_serving(src)]
+    assert any(
+        "DECODING has no RETIREMENT_ACTIONS row" in m for m in messages
+    )
+
+
+def test_nx005_retirement_dispatch_non_terminal_row():
+    engine = ENGINE_OK.replace(
+        'RequestState.FINISHED: "completed",',
+        'RequestState.FINISHED: "completed",\n    RequestState.QUEUED: "huh",',
+    )
+    messages = [f.message for f in _lint_serving(REQUEST_OK, engine)]
+    assert any(
+        "row for non-terminal state RequestState.QUEUED" in m for m in messages
+    )
+
+
+def test_nx005_missing_engine_fails_closed():
+    messages = [f.message for f in _lint_serving(REQUEST_OK, engine_src=None)]
+    assert any("serving/engine.py not found" in m for m in messages)
+
+
+def test_nx005_missing_retirement_dict_fails_closed():
+    messages = [f.message for f in _lint_serving(REQUEST_OK, "ACTIONS = {}\n")]
+    assert any("RETIREMENT_ACTIONS dict not found" in m for m in messages)
+
+
+def test_nx005_silent_without_request_module():
+    src = "class RequestState:\n    ORPHAN = 'x'\n"
+    assert lint_source(src, "NX005", rel_path="pkg/other.py") == []
+
+
 # -- NX010 host sync in traced code --------------------------------------------
 
 

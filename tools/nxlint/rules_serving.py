@@ -1,0 +1,204 @@
+"""Serving-engine rules: the request-lifecycle invariants the engine's
+retirement path depends on.
+
+NX005  request-state totality (serving/request.py + serving/engine.py)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.rules_control import _attr_names, _module_assign
+
+REQUEST_PATH = "serving/request.py"
+ENGINE_PATH = "serving/engine.py"
+STATE_CLASS = "RequestState"
+
+
+def _state_constants(class_node: ast.ClassDef) -> Dict[str, ast.AST]:
+    constants: Dict[str, ast.AST] = {}
+    for stmt in class_node.body:
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Constant):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.value, ast.Constant):
+            targets = [stmt.target]
+        else:
+            continue
+        for target in targets:
+            if isinstance(target, ast.Name) and target.id.isupper():
+                constants[target.id] = stmt
+    return constants
+
+
+def _dict_rows(value: ast.AST, owner: str) -> Optional[Dict[str, Tuple[ast.AST, Set[str]]]]:
+    """``{Owner.KEY: <expr>, ...}`` -> key name -> (key node, Owner.* names
+    referenced in the row's value).  None when the node is not a dict."""
+    if not isinstance(value, ast.Dict):
+        return None
+    rows: Dict[str, Tuple[ast.AST, Set[str]]] = {}
+    for key, val in zip(value.keys, value.values):
+        if key is None:
+            continue
+        for name in _attr_names(key, owner):
+            rows[name] = (key, _attr_names(val, owner))
+    return rows
+
+
+@register
+class RequestStateTotalityRule(Rule):
+    """NX005: the serving request lifecycle must be TOTAL — every
+    ``RequestState`` constant has a ``TRANSITIONS`` row and belongs to
+    exactly one of ``TERMINAL_STATES`` / ``ACTIVE_STATES``; terminal means
+    exactly "no outgoing transitions"; and every terminal state has a row
+    in the engine's ``RETIREMENT_ACTIONS`` dispatch.  The NX001
+    taxonomy-totality pattern applied to the serving engine: an unmapped
+    state is the bug class where retirement raises KeyError mid-request
+    (or a request wedges in a state nothing ever retires)."""
+
+    rule_id = "NX005"
+    description = "serving request-state machine must be total over RequestState"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        module = project.find_module(REQUEST_PATH)
+        if module is None or module.tree is None:
+            return
+        class_node = next(
+            (
+                n
+                for n in module.tree.body
+                if isinstance(n, ast.ClassDef) and n.name == STATE_CLASS
+            ),
+            None,
+        )
+        if class_node is None:
+            yield self.finding(
+                module, module.tree, f"{STATE_CLASS} class not found in {module.rel_path}"
+            )
+            return
+        constants = _state_constants(class_node)
+
+        transitions_node = _module_assign(module.tree, "TRANSITIONS")
+        transitions = (
+            None if transitions_node is None else _dict_rows(transitions_node, STATE_CLASS)
+        )
+        if transitions is None:
+            yield self.finding(
+                module,
+                transitions_node or module.tree,
+                "TRANSITIONS table not found (or not a dict literal)",
+            )
+
+        partitions: Dict[str, Optional[Tuple[ast.AST, Set[str]]]] = {}
+        for table in ("TERMINAL_STATES", "ACTIVE_STATES"):
+            value = _module_assign(module.tree, table)
+            if value is None:
+                yield self.finding(module, module.tree, f"required table {table} not found")
+                partitions[table] = None
+            else:
+                partitions[table] = (value, _attr_names(value, STATE_CLASS))
+
+        terminal = partitions.get("TERMINAL_STATES")
+        active = partitions.get("ACTIVE_STATES")
+
+        for name, node in sorted(constants.items()):
+            if transitions is not None and name not in transitions:
+                yield self.finding(
+                    module, node, f"{STATE_CLASS}.{name} has no TRANSITIONS row"
+                )
+            if terminal is not None and active is not None:
+                in_terminal = name in terminal[1]
+                in_active = name in active[1]
+                if not in_terminal and not in_active:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"{STATE_CLASS}.{name} is in neither TERMINAL_STATES nor "
+                        "ACTIVE_STATES (lifecycle undeclared)",
+                    )
+                elif in_terminal and in_active:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"{STATE_CLASS}.{name} is in both TERMINAL_STATES and "
+                        "ACTIVE_STATES",
+                    )
+                # terminal <=> no outgoing transitions: a terminal state with
+                # successors can be resurrected; an active state without any
+                # is a wedge nothing ever retires
+                if transitions is not None and name in transitions:
+                    outgoing = transitions[name][1]
+                    if in_terminal and outgoing:
+                        yield self.finding(
+                            module,
+                            transitions[name][0],
+                            f"terminal state {STATE_CLASS}.{name} declares outgoing "
+                            f"transitions {sorted(outgoing)}",
+                        )
+                    if in_active and not in_terminal and not outgoing:
+                        yield self.finding(
+                            module,
+                            transitions[name][0],
+                            f"active state {STATE_CLASS}.{name} has no outgoing "
+                            "transitions (unretirable dead end)",
+                        )
+
+        # stale references: table members that no longer name a constant
+        if transitions is not None:
+            for name in sorted(set(transitions) - set(constants)):
+                yield self.finding(
+                    module,
+                    transitions[name][0],
+                    f"TRANSITIONS references unknown {STATE_CLASS}.{name}",
+                )
+            for name, (key_node, targets) in sorted(transitions.items()):
+                for target in sorted(targets - set(constants)):
+                    yield self.finding(
+                        module,
+                        key_node,
+                        f"TRANSITIONS[{name}] references unknown {STATE_CLASS}.{target}",
+                    )
+        for table in ("TERMINAL_STATES", "ACTIVE_STATES"):
+            payload = partitions.get(table)
+            if payload is None:
+                continue
+            for name in sorted(payload[1] - set(constants)):
+                yield self.finding(
+                    module, payload[0], f"{table} references unknown {STATE_CLASS}.{name}"
+                )
+
+        # -- engine side: retirement dispatch totality over terminal states
+        engine = project.find_module(ENGINE_PATH)
+        if engine is None or engine.tree is None:
+            yield self.finding(
+                module,
+                module.tree,
+                f"{ENGINE_PATH} not found — retirement-dispatch totality unverifiable",
+            )
+            return
+        actions_node = _module_assign(engine.tree, "RETIREMENT_ACTIONS")
+        actions = None if actions_node is None else _dict_rows(actions_node, STATE_CLASS)
+        if actions is None:
+            # fail CLOSED: a renamed dispatch table must not silently skip
+            # the totality comparison (same contract as NX002's values dict)
+            yield self.finding(
+                engine,
+                actions_node or engine.tree,
+                "RETIREMENT_ACTIONS dict not found (retirement totality unverifiable)",
+            )
+            return
+        terminal_names = terminal[1] if terminal is not None else set()
+        for name in sorted(terminal_names - set(actions)):
+            yield self.finding(
+                engine,
+                actions_node,
+                f"terminal state {STATE_CLASS}.{name} has no RETIREMENT_ACTIONS row",
+            )
+        for name in sorted(set(actions) - terminal_names):
+            what = "non-terminal" if name in constants else "unknown"
+            yield self.finding(
+                engine,
+                actions[name][0],
+                f"RETIREMENT_ACTIONS has a row for {what} state {STATE_CLASS}.{name}",
+            )

@@ -291,6 +291,13 @@ class Metrics:
     def timing(self, name: str, seconds: float, tags: Optional[Mapping[str, str]] = None) -> None:
         raise NotImplementedError
 
+    def histogram(self, name: str, value: float, tags: Optional[Mapping[str, str]] = None) -> None:
+        """Distribution sample (DogStatsD ``|h``): the agent aggregates
+        percentiles server-side — the right verb for per-request latency
+        SLOs (serving TTFT/TPOT) where ``timing`` would mis-tag units and
+        ``gauge`` would drop all but the last sample per flush."""
+        raise NotImplementedError
+
 
 class NullMetrics(Metrics):
     def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
@@ -302,6 +309,9 @@ class NullMetrics(Metrics):
     def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
         pass
 
+    def histogram(self, name, value, tags=None) -> None:  # noqa: ANN001
+        pass
+
 
 class RecordingMetrics(Metrics):
     """In-memory recorder for tests."""
@@ -310,6 +320,7 @@ class RecordingMetrics(Metrics):
         self.counters: Dict[str, int] = {}
         self.gauges: Dict[str, float] = {}
         self.timings: Dict[str, list] = {}
+        self.histograms: Dict[str, list] = {}
 
     def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
         self.counters[name] = self.counters.get(name, 0) + value
@@ -319,6 +330,9 @@ class RecordingMetrics(Metrics):
 
     def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
         self.timings.setdefault(name, []).append(seconds)
+
+    def histogram(self, name, value, tags=None) -> None:  # noqa: ANN001
+        self.histograms.setdefault(name, []).append(value)
 
 
 class StatsdClient(Metrics):
@@ -374,6 +388,9 @@ class StatsdClient(Metrics):
 
     def timing(self, name, seconds, tags=None) -> None:  # noqa: ANN001
         self._send(f"{self.namespace}.{name}:{seconds * 1000.0:.3f}|ms", tags)
+
+    def histogram(self, name, value, tags=None) -> None:  # noqa: ANN001
+        self._send(f"{self.namespace}.{name}:{value}|h", tags)
 
 
 class Timer:

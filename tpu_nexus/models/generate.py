@@ -272,6 +272,18 @@ def decode_step(
     out the row's pad slots ``[len, S)`` — the same trusted lockstep loop,
     made per-row correct by index arithmetic instead of per-row scatters.
 
+    Per-slot mode (``pos`` a [B] VECTOR): each row is an independent
+    serving *slot* with a contiguous cache prefix ``[0, pos[b])`` — row
+    ``b`` writes at its own ``pos[b]``, RoPE-rotates at position
+    ``pos[b]``, and attends ``[0, pos[b]]``.  This is the continuous-
+    batching step (tpu_nexus/serving): slots at different depths decode
+    in one batched call, so a finished row's slot refills from the queue
+    without stalling the others.  ``prompt_lengths``/``prompt_width`` do
+    not apply (slot caches have no pad hole — admission compacts the
+    prompt prefix); attention rides the SAME ragged mask machinery with
+    per-row live lengths ``pos+1`` and the generated-tail window pushed
+    past the cache end, in both the XLA and pallas kernels.
+
     ``decode_kernel``: attention dispatch — ``"auto"`` (fused pallas
     decode kernel on TPU, XLA fallback elsewhere), ``"pallas"``,
     ``"xla"``; the ``NEXUS_DECODE_KERNEL`` env var replaces the ``auto``
@@ -289,17 +301,45 @@ def decode_step(
     cfg = _decode_cfg(cfg)
     ct = cfg.dtype
     b = token.shape[0]
+    per_slot = jnp.ndim(pos) == 1
+    max_len = cache["k"].shape[2]
     x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]
-    if prompt_lengths is None:
+    if per_slot:
+        if prompt_lengths is not None or prompt_width is not None:
+            raise ValueError(
+                "per-slot decode (vector pos) keeps each row's cache contiguous; "
+                "prompt_lengths/prompt_width do not apply"
+            )
+        positions = pos.astype(jnp.int32)[:, None]  # [B,1] — per-row cursor
+        # per-row live prefix [0, pos[b]]; the generated-tail window of the
+        # ragged mask formula is pushed past the cache end (width=max_len)
+        # so the mask degenerates to exactly `k_pos <= pos[b]`.  kv_len
+        # only drives the kernel's DMA clamp — the deepest live slot.
+        att_lens: Optional[jax.Array] = positions[:, 0] + 1
+        att_width: Optional[int] = max_len
+        att_kv_len = jnp.max(pos) + 1
+    elif prompt_lengths is None:
         positions = jnp.broadcast_to(pos[None, None], (b, 1))
+        att_lens, att_width, att_kv_len = None, None, pos + 1
     else:
         assert prompt_width is not None, "ragged decode needs prompt_width"
         positions = (prompt_lengths + (pos - prompt_width))[:, None]  # [B,1]
+        att_lens, att_width, att_kv_len = prompt_lengths, prompt_width, pos + 1
     cos, sin = rope_tables(positions.astype(jnp.int32), cfg.head_dim, cfg.rope_theta)
     kv_quant = "k_s" in cache  # int8 KV mode travels with the cache itself
     n_layers = cache["k"].shape[0]
     if unroll_layers is None:
         unroll_layers = n_layers <= 32
+
+    def _cache_write(arr, update, li):
+        # update [B, 1, Hkv|1, D|1]: the new row(s) at this step's write
+        # position.  Scalar pos: one dynamic-slice update shared by the
+        # batch.  Vector pos (per-slot): a batched scatter — each row lands
+        # at its own cursor (out-of-bounds rows are dropped by XLA scatter
+        # semantics; the serving engine never issues them)
+        if per_slot:
+            return arr.at[li, jnp.arange(b), pos].set(update[:, 0])
+        return jax.lax.dynamic_update_slice(arr, update[None], (li, 0, pos, 0, 0))
 
     def _cache_read(arr, li):
         # static index (unrolled): a plain slice XLA fuses into the
@@ -328,13 +368,13 @@ def decode_step(
             (k, k_s), (v, v_s) = _quantize_kv(k), _quantize_kv(v)
             c = dict(
                 c,
-                k_s=jax.lax.dynamic_update_slice(c["k_s"], k_s[None], (li, 0, pos, 0, 0)),
-                v_s=jax.lax.dynamic_update_slice(c["v_s"], v_s[None], (li, 0, pos, 0, 0)),
+                k_s=_cache_write(c["k_s"], k_s, li),
+                v_s=_cache_write(c["v_s"], v_s, li),
             )
         c = dict(
             c,
-            k=jax.lax.dynamic_update_slice(c["k"], k[None], (li, 0, pos, 0, 0)),
-            v=jax.lax.dynamic_update_slice(c["v"], v[None], (li, 0, pos, 0, 0)),
+            k=_cache_write(c["k"], k, li),
+            v=_cache_write(c["v"], v, li),
         )
         ck = _cache_read(c["k"], li)
         cv = _cache_read(c["v"], li)
@@ -344,8 +384,8 @@ def decode_step(
             else {}
         )
         o = cached_attention(
-            q, ck, cv, pos + 1,
-            prompt_lengths=prompt_lengths, prompt_width=prompt_width,
+            q, ck, cv, att_kv_len,
+            prompt_lengths=att_lens, prompt_width=att_width,
             impl=decode_kernel, **scales,
         )
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
@@ -410,6 +450,38 @@ def teacher_forced_decode_ce(
     return ces.mean()
 
 
+def sample_logits(
+    logits: jax.Array,
+    key: jax.Array,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    dtype: Any = jnp.int32,
+) -> jax.Array:
+    """Next-token sampling of ``logits`` [B, vocab] → tokens [B].
+    ``temperature=0`` is greedy argmax (``key`` unused); otherwise
+    categorical with optional ``top_k`` / ``top_p`` nucleus truncation —
+    static-shape sort/threshold masks, jit-compatible.  This is the ONE
+    sampling implementation: :func:`generate`'s scan body and the serving
+    engine's per-step sampler both call it, so the two paths cannot
+    drift."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(dtype)
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k:
+        # kth-largest per row without a full-vocab sort
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
+        logits = jnp.where(logits >= kth, logits, _NEG_INF)
+    if top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]  # one descending sort
+        cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
+        # smallest prefix with mass >= p: keep logits >= the cutoff value
+        n_keep = jnp.sum(cum < top_p, axis=-1) + 1  # [B]
+        cutoff = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
+        logits = jnp.where(logits >= cutoff, logits, _NEG_INF)
+    return jax.random.categorical(key, logits, axis=-1).astype(dtype)
+
+
 def generate(
     params: Dict[str, Any],
     prompt: jax.Array,
@@ -464,27 +536,10 @@ def generate(
 
     cache, logits = prefill(params, prompt, cfg, max_len, prompt_lengths, kv_quant=kv_quant)
 
-    def sample(logits, k):
-        if temperature == 0.0:
-            return jnp.argmax(logits, axis=-1).astype(prompt.dtype)
-        logits = logits.astype(jnp.float32) / temperature
-        if top_k:
-            # kth-largest per row without a full-vocab sort
-            kth = jax.lax.top_k(logits, top_k)[0][:, -1][:, None]
-            logits = jnp.where(logits >= kth, logits, _NEG_INF)
-        if top_p < 1.0:
-            srt = jnp.sort(logits, axis=-1)[:, ::-1]  # one descending sort
-            cum = jnp.cumsum(jax.nn.softmax(srt, axis=-1), axis=-1)
-            # smallest prefix with mass >= p: keep logits >= the cutoff value
-            n_keep = jnp.sum(cum < top_p, axis=-1) + 1  # [B]
-            cutoff = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
-            logits = jnp.where(logits >= cutoff, logits, _NEG_INF)
-        return jax.random.categorical(k, logits, axis=-1).astype(prompt.dtype)
-
     def body(carry, _):
         cache, logits, pos, key = carry
         key, sub = jax.random.split(key)
-        tok = sample(logits, sub)
+        tok = sample_logits(logits, sub, temperature, top_k, top_p, dtype=prompt.dtype)
         logits, cache = decode_step(
             params, cache, tok, pos, cfg,
             prompt_lengths=prompt_lengths, prompt_width=s,

@@ -1,0 +1,53 @@
+"""Continuous-batching serving engine (iteration-level scheduling).
+
+The subsystem that feeds PR 2's decode kernel under real traffic: admit
+individual requests, assign each a KV-cache *slot* (a row of the fixed
+``[L, num_slots, max_len, Hkv, D]`` buffer), interleave new-request
+prefill with a single persistent per-slot decode step, and retire/refill
+slots every iteration instead of every round.  Architecture and env
+contract: docs/SERVING.md; launcher wiring: ``NEXUS_MODE=serve-engine``.
+
+Layering (each module imports only downward):
+
+* ``request``        — Request + the total lifecycle state machine
+* ``cache_manager``  — slot free-list + int8-aware cache buffers
+* ``scheduler``      — FIFO admission, prefill-token budget, starvation guard
+* ``metrics``        — TTFT/TPOT/queue-depth/occupancy via core.telemetry
+* ``engine``         — ModelExecutor (jitted compute) + ServingEngine (host loop)
+"""
+
+from tpu_nexus.serving.cache_manager import KVSlotManager, SlotError, init_cache
+from tpu_nexus.serving.engine import (
+    RETIREMENT_ACTIONS,
+    ModelExecutor,
+    ServingEngine,
+)
+from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.request import (
+    ACTIVE_STATES,
+    TERMINAL_STATES,
+    TRANSITIONS,
+    IllegalTransition,
+    Request,
+    RequestState,
+)
+from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
+
+__all__ = [
+    "ACTIVE_STATES",
+    "FifoScheduler",
+    "IllegalTransition",
+    "KVSlotManager",
+    "ModelExecutor",
+    "RETIREMENT_ACTIONS",
+    "Request",
+    "RequestState",
+    "SchedulerConfig",
+    "ServingEngine",
+    "ServingMetrics",
+    "SlotError",
+    "TERMINAL_STATES",
+    "TRANSITIONS",
+    "init_cache",
+    "percentile",
+]

@@ -1,0 +1,119 @@
+"""KV-slot management: a free-list allocator over the fixed-shape cache.
+
+The decode cache is one ``[L, num_slots, max_len, Hkv, D]`` buffer (the
+``models/generate`` layout with the batch axis reinterpreted as SLOTS).  A
+slot is the unit of admission: a request owns exactly one slot row from
+prefill-insert to retirement, its live tokens occupy the contiguous prefix
+``[0, cursor)``, and a freed slot is reused verbatim — the next prefill
+insert overwrites the whole row, so no zeroing pass is needed between
+tenants.
+
+:class:`KVSlotManager` is deliberately pure host-side Python (no jax): the
+randomized scheduler-invariant tests drive hundreds of admission/eviction
+scenarios against it without touching a device.  :func:`init_cache` is the
+one jax-aware piece — it allocates the buffers, int8-KV aware (int8 values
++ per-slot f32 scales, the ``models/generate`` cache contract).
+"""
+
+from __future__ import annotations
+
+import heapq
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional
+
+
+class SlotError(RuntimeError):
+    """Slot accounting violation (double-free, free of an unowned slot) —
+    an engine bug surfaced loudly, never a recoverable traffic condition."""
+
+
+def init_cache(cfg: Any, num_slots: int, max_len: int, kv_quant: str = ""):
+    """Zero-initialized decode cache ``{"k","v"[,"k_s","v_s"]}`` shaped
+    ``[L, num_slots, max_len, Hkv, D]`` (scales ``[..., 1]`` f32), matching
+    what :func:`tpu_nexus.models.generate.prefill` emits row-for-row so a
+    per-request prefill inserts with one dynamic-update-slice."""
+    import jax.numpy as jnp
+
+    if kv_quant not in ("", "int8"):
+        raise ValueError(f"unknown kv_quant mode {kv_quant!r}; use 'int8' or ''")
+    if num_slots < 1:
+        raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+    if max_len < 2:
+        raise ValueError(f"max_len must be >= 2 (one prompt + one generated token)")
+    kv_shape = (cfg.n_layers, num_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
+    if kv_quant == "int8":
+        scale_shape = kv_shape[:-1] + (1,)
+        return {
+            "k": jnp.zeros(kv_shape, jnp.int8),
+            "v": jnp.zeros(kv_shape, jnp.int8),
+            "k_s": jnp.zeros(scale_shape, jnp.float32),
+            "v_s": jnp.zeros(scale_shape, jnp.float32),
+        }
+    return {
+        "k": jnp.zeros(kv_shape, cfg.dtype),
+        "v": jnp.zeros(kv_shape, cfg.dtype),
+    }
+
+
+class KVSlotManager:
+    """Free-list slot allocator with ownership + admission-order tracking.
+
+    Allocation order is deterministic (lowest free slot id first) so
+    engine runs replay exactly under a fixed seed.  The eviction candidate
+    is the YOUNGEST busy slot (least sunk decode work lost), consumed by
+    the scheduler's starvation guard when no slot frees up for a bounded
+    number of steps.  NOTE: unlike vLLM preemption, eviction here is
+    TERMINAL — the victim retires EVICTED with its partial output
+    delivered and is NOT re-queued (re-queueing with a starvation guard
+    can ping-pong two requests through one slot forever); the client owns
+    the retry.
+    """
+
+    def __init__(self, num_slots: int, max_len: int) -> None:
+        if num_slots < 1:
+            raise ValueError(f"num_slots must be >= 1, got {num_slots}")
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self._free: List[int] = list(range(num_slots))  # min-heap: lowest id first
+        #: slot -> owning request_id, in admission order (oldest first)
+        self._owner: "OrderedDict[int, str]" = OrderedDict()
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return len(self._owner)
+
+    def occupancy(self) -> float:
+        return self.used_count / self.num_slots
+
+    def fits(self, total_len: int) -> bool:
+        """Can a request needing ``total_len`` cache rows ever run here?"""
+        return total_len <= self.max_len
+
+    def owner(self, slot: int) -> Optional[str]:
+        return self._owner.get(slot)
+
+    def owners(self) -> Dict[int, str]:
+        return dict(self._owner)
+
+    def allocate(self, request_id: str) -> Optional[int]:
+        """Claim the lowest free slot id for ``request_id`` (min-heap, so
+        the claim holds across out-of-order frees); None when full."""
+        if not self._free:
+            return None
+        slot = heapq.heappop(self._free)
+        self._owner[slot] = request_id
+        return slot
+
+    def free(self, slot: int) -> None:
+        if slot not in self._owner:
+            raise SlotError(f"slot {slot} is not allocated (double free?)")
+        del self._owner[slot]
+        heapq.heappush(self._free, slot)
+
+    def eviction_candidate(self) -> Optional[int]:
+        """Youngest busy slot (most recent admission), or None when idle."""
+        return next(reversed(self._owner), None)

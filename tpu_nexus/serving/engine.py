@@ -1,0 +1,402 @@
+"""The continuous-batching step loop: admit, prefill, decode, retire.
+
+Replaces the lockstep round loop of ``workload/serve.run_serving`` (every
+request in a round waits for the slowest) with iteration-level scheduling
+(Orca, Yu et al. OSDI'22): each engine iteration admits individual queued
+requests into free KV *slots*, prefilling their prompts into the shared
+``[L, num_slots, max_len, Hkv, D]`` cache, then advances EVERY in-flight
+slot by one token with a single persistent jitted decode step — the
+vector-``pos`` mode of ``models/generate.decode_step``, where each slot
+row writes and attends at its own cursor.  Finished rows retire
+immediately and their slots refill from the queue the same iteration, so
+one long generation never stalls the batch.
+
+Split of responsibilities:
+
+* :class:`ModelExecutor` owns the device state (params, cache, PRNG) and
+  the three jitted entry points: bucketed prefill, slot insert, decode
+  step.  It is the ONLY jax-aware class here.
+* :class:`ServingEngine` owns the host state machine: queue, slots,
+  cursors, per-request lifecycle, metrics.  Tests drive it with a fake
+  executor to fuzz hundreds of arrival patterns without a device.
+
+Retirement is dispatched through :data:`RETIREMENT_ACTIONS`, total over
+``request.TERMINAL_STATES`` (nxlint NX005, mirroring the NX001
+decision-taxonomy pattern): adding a terminal state without declaring how
+the engine retires it is a static-analysis error, not a midnight KeyError.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpu_nexus.serving.cache_manager import KVSlotManager, init_cache
+from tpu_nexus.serving.metrics import ServingMetrics
+from tpu_nexus.serving.request import (
+    Request,
+    RequestState,
+)
+from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
+
+logger = logging.getLogger(__name__)
+
+#: terminal state -> retirement action tag (metrics ``state:`` tag + log
+#: verb).  TOTAL over request.TERMINAL_STATES — enforced by nxlint NX005;
+#: the dispatch in :meth:`ServingEngine._retire` indexes this dict, so an
+#: unmapped terminal state cannot ship.
+RETIREMENT_ACTIONS: Dict[str, str] = {
+    RequestState.FINISHED: "completed",
+    RequestState.CANCELLED: "cancelled",
+    RequestState.EVICTED: "evicted",
+}
+
+
+def _prefill_buckets(max_len: int) -> List[int]:
+    """Static prompt pad widths: powers of two from 8 up to ``max_len``
+    (inclusive).  Prefill retraces once per DISTINCT width, so bucketing
+    bounds compile count at ~log2(max_len) regardless of traffic."""
+    buckets: List[int] = []
+    w = 8
+    while w < max_len:
+        buckets.append(w)
+        w *= 2
+    buckets.append(max_len)
+    return buckets
+
+
+class ModelExecutor:
+    """Device half of the engine: cache + params + three jitted fns.
+
+    ``begin(slot, prompt)`` prefills one request (prompt right-padded to a
+    static bucket width, per-row ``prompt_lengths`` — exactly
+    ``generate``'s ragged semantics) and inserts its KV rows into the
+    slot; returns the request's FIRST output token, sampled from the
+    prefill logits like ``generate``'s scan body does.
+
+    ``step(tokens, cursors)`` advances all ``num_slots`` rows one token
+    with the per-slot (vector-``pos``) ``decode_step`` and returns the
+    sampled next token per slot.  Inactive slots decode garbage that the
+    host discards — the fixed shape is what keeps this ONE compilation.
+    """
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        num_slots: int,
+        max_len: int,
+        kv_quant: str = "",
+        decode_kernel: str = "auto",
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> None:
+        import functools
+
+        import jax
+
+        from tpu_nexus.models.generate import decode_step, prefill, sample_logits
+
+        if decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"unknown decode_kernel mode {decode_kernel!r}; use auto, pallas, or xla"
+            )
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if (top_k or top_p < 1.0) and temperature == 0.0:
+            raise ValueError("top_k/top_p truncation requires temperature > 0")
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.kv_quant = kv_quant
+        self.temperature = temperature
+        self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
+        self._buckets = _prefill_buckets(max_len)
+        self._key = jax.random.PRNGKey(seed)
+        self._jax = jax
+
+        self._sample = functools.partial(
+            sample_logits,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+        )
+
+        def _begin(params, cache, padded, lengths, slot, key):
+            # prefill + slot insert + first-token sample in ONE jitted call
+            # (retraces once per prompt bucket width): admission is on the
+            # critical path of every step that refills a slot, so its host
+            # dispatch count matters as much as its FLOPs
+            row_cache, logits = prefill(
+                params, padded, cfg, max_len=max_len,
+                prompt_lengths=lengths, kv_quant=kv_quant,
+            )
+            cache = jax.tree.map(
+                lambda big, row: jax.lax.dynamic_update_slice(
+                    big, row, (0, slot, 0, 0, 0)
+                ),
+                cache,
+                row_cache,
+            )
+            return cache, self._sample(logits, key)
+
+        # donate the cache buffer (arg 1) so XLA updates the [L, slots,
+        # max_len, Hkv, D] stack in place instead of copying it every
+        # token — the train-step donation pattern (workload/train.py).
+        # CPU donation is an unimplemented no-op that only logs warnings,
+        # so gate on the accelerator backends.
+        donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
+        self._begin = jax.jit(_begin, donate_argnums=donate)
+
+        def _step(params, cache, tokens, cursors, key):
+            logits, cache = decode_step(
+                params, cache, tokens, cursors, cfg, decode_kernel=decode_kernel
+            )
+            return self._sample(logits, key), cache
+
+        self._step = jax.jit(_step, donate_argnums=donate)
+
+    def _next_key(self):
+        if self.temperature == 0.0:
+            return self._key  # greedy ignores it; skip the split dispatch
+        self._key, sub = self._jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, prompt_len: int) -> int:
+        for w in self._buckets:
+            if w >= prompt_len:
+                return w
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds cache max_len {self.max_len}"
+        )
+
+    def begin(self, slot: int, prompt: np.ndarray) -> int:
+        """Prefill ``prompt`` into ``slot``; returns the first token."""
+        jnp = self._jax.numpy
+        n = int(prompt.shape[0])
+        width = self._bucket(n)
+        padded = np.zeros((1, width), np.int32)
+        padded[0, :n] = prompt
+        self.cache, first = self._begin(
+            self.params,
+            self.cache,
+            jnp.asarray(padded),
+            jnp.asarray([n], jnp.int32),
+            jnp.asarray(slot, jnp.int32),
+            self._next_key(),
+        )
+        return int(first[0])
+
+    def step(self, tokens: np.ndarray, cursors: np.ndarray) -> np.ndarray:
+        """One decode iteration over all slots -> next token per slot."""
+        jnp = self._jax.numpy
+        next_tokens, self.cache = self._step(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens, jnp.int32),
+            jnp.asarray(cursors, jnp.int32),
+            self._next_key(),
+        )
+        return np.asarray(next_tokens)
+
+
+class ServingEngine:
+    """Host half: the continuous-batching state machine (see module doc).
+
+    ``executor`` must expose ``num_slots``, ``max_len``, ``begin(slot,
+    prompt) -> first_token`` and ``step(tokens, cursors) -> tokens`` —
+    :class:`ModelExecutor` in production, a fake in the invariant tests.
+    """
+
+    def __init__(
+        self,
+        executor: Any,
+        *,
+        scheduler: Optional[FifoScheduler] = None,
+        metrics: Optional[ServingMetrics] = None,
+        clock: Callable[[], float] = time.monotonic,
+        retired_log_limit: int = 10_000,
+    ) -> None:
+        self.executor = executor
+        self.slots = KVSlotManager(executor.num_slots, executor.max_len)
+        self.scheduler = scheduler or FifoScheduler()
+        self.metrics = metrics or ServingMetrics()
+        self._clock = clock
+        self._retired_log_limit = retired_log_limit
+        #: LIVE requests only (queued + in flight): retirement removes the
+        #: entry, so a long-running engine's memory is bounded by what is
+        #: actually in the system, and a retired request_id may be reused
+        self.requests: Dict[str, Request] = {}
+        self._active: Dict[int, Request] = {}  # slot -> DECODING request
+        self._tokens = np.zeros(executor.num_slots, np.int32)
+        self._cursors = np.zeros(executor.num_slots, np.int32)
+        self._counter = itertools.count()
+        self.steps = 0
+        #: retirement log in order — what the bench and tests audit;
+        #: trimmed from the FRONT past ``retired_log_limit`` so a serving
+        #: process that never restarts cannot grow it without bound
+        self.retired: List[Request] = []
+
+    # -- admission interface ---------------------------------------------------
+
+    def submit(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        request_id: Optional[str] = None,
+        stream: Optional[Callable[[Request, int], None]] = None,
+    ) -> Request:
+        """Enqueue one generation request; returns its live Request record.
+        Raises immediately when the request can never fit a cache slot
+        (prompt + budget > max_len) — a config error, not a lifecycle."""
+        rid = request_id if request_id is not None else f"req-{next(self._counter)}"
+        if rid in self.requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        req = Request(
+            request_id=rid,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            stream=stream,
+            submitted_at=self._clock(),
+        )
+        if not self.slots.fits(req.total_len):
+            raise ValueError(
+                f"request {rid}: prompt {req.prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds cache max_len {self.slots.max_len}"
+            )
+        self.requests[rid] = req
+        self.scheduler.submit(req)
+        return req
+
+    def cancel(self, request_id: str) -> bool:
+        """Flag a request for cancellation; honored at the next step
+        boundary.  False when unknown or already terminal."""
+        req = self.requests.get(request_id)
+        if req is None or req.is_terminal():
+            return False
+        req.cancel_requested = True
+        return True
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active) or self.scheduler.pending > 0
+
+    # -- the step loop ---------------------------------------------------------
+
+    def step(self) -> Dict[str, int]:
+        """One engine iteration: cancellations → admission/prefill →
+        starvation guard → one decode step over every live slot.  Returns
+        counts for observability ({admitted, decoded, retired})."""
+        self.steps += 1
+        retired_before = len(self.retired)
+
+        # 1. cancellations, queued and in-flight
+        for req in self.scheduler.remove_cancelled():
+            self._retire(req, RequestState.CANCELLED)
+        for slot, req in list(self._active.items()):
+            if req.cancel_requested:
+                self._retire(req, RequestState.CANCELLED)
+
+        # 2. admission: prefill into free slots under the token budget
+        admitted = self._admit()
+
+        # 3. starvation guard: reclaim the youngest slot for a starving head
+        if self.scheduler.head_starving() and self.slots.free_count == 0:
+            victim_slot = self.slots.eviction_candidate()
+            if victim_slot is not None:
+                self._retire(self._active[victim_slot], RequestState.EVICTED)
+                admitted += self._admit()
+
+        # 4. one decode step over every live slot
+        decoded = 0
+        if self._active:
+            next_tokens = self.executor.step(self._tokens, self._cursors)
+            now = self._clock()
+            for slot, req in list(self._active.items()):
+                tok = int(next_tokens[slot])
+                self._cursors[slot] += 1
+                self._tokens[slot] = tok
+                self.metrics.token_interval(req.emit(tok, now))
+                decoded += 1
+                if req.done:
+                    self._retire(req, RequestState.FINISHED)
+                elif int(self._cursors[slot]) >= self.slots.max_len:
+                    # cache overflow — unreachable when submit() enforced
+                    # total_len <= max_len, kept as the runtime backstop
+                    self._retire(req, RequestState.EVICTED)
+
+        self.scheduler.tick()
+        self.metrics.step_gauges(
+            self.scheduler.pending, self.slots.used_count, self.slots.num_slots
+        )
+        return {
+            "admitted": admitted,
+            "decoded": decoded,
+            "retired": len(self.retired) - retired_before,
+        }
+
+    def run_until_drained(self, max_steps: int = 1_000_000) -> None:
+        """Step until queue and slots are empty; ``max_steps`` is the
+        liveness backstop (a bug that wedges a request must fail the run,
+        not spin it)."""
+        while self.has_work:
+            if self.steps >= max_steps:
+                raise RuntimeError(
+                    f"engine not drained after {max_steps} steps: "
+                    f"{self.scheduler.pending} queued, {len(self._active)} active"
+                )
+            self.step()
+
+    # -- internals -------------------------------------------------------------
+
+    def _admit(self) -> int:
+        admitted = self.scheduler.admit(self.slots.free_count)
+        for req in admitted:
+            slot = self.slots.allocate(req.request_id)
+            assert slot is not None, "scheduler admitted beyond free slots"
+            req.slot = slot
+            req.transition(RequestState.PREFILLING)
+            self.metrics.queue_wait(self._clock() - req.submitted_at)
+            first_token = self.executor.begin(slot, req.prompt)
+            req.emit(first_token, self._clock())
+            self.metrics.first_token(req)
+            if req.done:  # max_new_tokens == 1: prefill produced everything
+                self._retire(req, RequestState.FINISHED)
+                continue
+            req.transition(RequestState.DECODING)
+            self._active[slot] = req
+            self._cursors[slot] = req.prompt_len
+            self._tokens[slot] = req.output_tokens[-1]
+        return len(admitted)
+
+    def _retire(self, req: Request, terminal_state: str) -> None:
+        """Retire ``req`` into ``terminal_state``: transition, release the
+        slot, emit metrics.  Dispatch is through RETIREMENT_ACTIONS —
+        total over TERMINAL_STATES by nxlint NX005."""
+        action = RETIREMENT_ACTIONS[terminal_state]
+        req.transition(terminal_state)
+        req.finished_at = self._clock()
+        if req.slot is not None and self.slots.owner(req.slot) == req.request_id:
+            self._active.pop(req.slot, None)
+            self.slots.free(req.slot)
+            self._tokens[req.slot] = 0
+            self._cursors[req.slot] = 0
+        self.metrics.retired_request(req, action)
+        self.requests.pop(req.request_id, None)  # bound live-request memory
+        self.retired.append(req)
+        if len(self.retired) > self._retired_log_limit:
+            del self.retired[: len(self.retired) - self._retired_log_limit]
+        logger.info(
+            "request %s %s after %d tokens",
+            req.request_id,
+            action,
+            len(req.output_tokens),
+        )

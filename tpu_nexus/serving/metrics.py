@@ -1,0 +1,86 @@
+"""Serving SLO metrics: TTFT, TPOT, queue depth, slot occupancy.
+
+Emits through the repo's :class:`tpu_nexus.core.telemetry.Metrics`
+interface — ``histogram`` for the latency distributions (the DogStatsD
+agent owns percentile aggregation in production), ``gauge`` for the
+per-step queue/occupancy levels, ``count`` for retirement outcomes — and
+additionally keeps in-process samples so ``summary()`` can report
+p50/p99 for benches and tests without a metrics backend.
+
+Definitions (the usual LLM-serving SLOs):
+
+* **TTFT** — submit → first token (includes queue wait + prefill);
+* **TPOT** — interval between consecutive tokens of one request after the
+  first (decode cadence; what a streaming reader perceives);
+* **queue depth** — requests waiting for a slot, sampled per step;
+* **slot occupancy** — busy slots / total slots, sampled per step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from tpu_nexus.core.telemetry import Metrics, NullMetrics
+from tpu_nexus.serving.request import Request
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile of an UNSORTED sample (q in [0, 100]);
+    0.0 on an empty sample — benches handle the degenerate case."""
+    if not values:
+        return 0.0
+    ordered = sorted(values)
+    rank = min(len(ordered) - 1, max(0, int(round(q / 100.0 * (len(ordered) - 1)))))
+    return ordered[rank]
+
+
+class ServingMetrics:
+    """Per-engine metrics recorder + telemetry emitter (see module doc)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None) -> None:
+        self._m = metrics or NullMetrics()
+        self.ttft_s: List[float] = []
+        self.tpot_s: List[float] = []
+        self.queue_wait_s: List[float] = []
+        self.retired: Dict[str, int] = {}
+        self.tokens_out = 0
+
+    def queue_wait(self, seconds: float) -> None:
+        """Submit → admission (slot granted), the scheduler-owned slice of
+        TTFT — recorded separately so queue pressure is distinguishable
+        from prefill cost."""
+        self.queue_wait_s.append(seconds)
+        self._m.histogram("serving.queue_wait_seconds", seconds)
+
+    def first_token(self, req: Request) -> None:
+        assert req.first_token_at is not None
+        ttft = req.first_token_at - req.submitted_at
+        self.ttft_s.append(ttft)
+        self.tokens_out += 1
+        self._m.histogram("serving.ttft_seconds", ttft)
+
+    def token_interval(self, dt: Optional[float]) -> None:
+        self.tokens_out += 1
+        if dt is not None:
+            self.tpot_s.append(dt)
+            self._m.histogram("serving.tpot_seconds", dt)
+
+    def retired_request(self, req: Request, action: str) -> None:
+        self.retired[req.state] = self.retired.get(req.state, 0) + 1
+        self._m.count("serving.requests_retired", tags={"state": action})
+
+    def step_gauges(self, queue_depth: int, slots_used: int, num_slots: int) -> None:
+        self._m.gauge("serving.queue_depth", queue_depth)
+        self._m.gauge("serving.slot_occupancy", slots_used / max(1, num_slots))
+
+    def summary(self) -> Dict[str, float]:
+        return {
+            "tokens_out": self.tokens_out,
+            "requests_retired": dict(self.retired),
+            "ttft_p50_s": percentile(self.ttft_s, 50),
+            "ttft_p99_s": percentile(self.ttft_s, 99),
+            "tpot_p50_s": percentile(self.tpot_s, 50),
+            "tpot_p99_s": percentile(self.tpot_s, 99),
+            "queue_wait_p50_s": percentile(self.queue_wait_s, 50),
+            "queue_wait_p99_s": percentile(self.queue_wait_s, 99),
+        }

@@ -56,12 +56,18 @@ def main() -> int:
         from tpu_nexus.workload.serve import ServeConfig, run_serving
 
         result = run_serving(ServeConfig.from_env(), store=store)
+    elif mode == "serve-engine":
+        from tpu_nexus.workload.serve import ServeConfig, run_serve_engine
+
+        result = run_serve_engine(ServeConfig.from_env(), store=store)
     elif mode == "train":
         from tpu_nexus.workload.harness import WorkloadConfig, run_workload
 
         result = run_workload(WorkloadConfig.from_env(), store=store)
     else:
-        raise SystemExit(f"unknown NEXUS_MODE {mode!r}; use 'train' or 'serve'")
+        raise SystemExit(
+            f"unknown NEXUS_MODE {mode!r}; use 'train', 'serve' or 'serve-engine'"
+        )
     logging.getLogger(__name__).info("workload done: %s", result)
     return 0
 

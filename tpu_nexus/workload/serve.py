@@ -8,14 +8,19 @@ same fault-injection hooks and failure-trace capture path via the
 harness's env contract, but the inner loop is KV-cache batch decoding
 (models/generate.py) instead of a train step.
 
-Launcher contract: ``NEXUS_MODE=serve`` selects this loop in the workload
-container entrypoint; ``NEXUS_PROMPT_LEN`` / ``NEXUS_GEN_TOKENS`` /
-``NEXUS_TEMPERATURE`` shape the decode; ``NEXUS_STEPS`` counts generate
-rounds; ``NEXUS_CHECKPOINT_DIR`` restores trained weights (the tensor
-checkpoint written by the training harness — params-only, template-free,
-so serve never depends on the training run's optimizer/opt-state layout);
-``NEXUS_DECODE_KERNEL`` picks the decode attention implementation
-(auto | pallas | xla).
+Launcher contract: ``NEXUS_MODE=serve`` selects the lockstep round loop
+(:func:`run_serving`), ``NEXUS_MODE=serve-engine`` the continuous-batching
+engine (:func:`run_serve_engine`, tpu_nexus/serving — per-request
+admission, slot refill every iteration; docs/SERVING.md).  Shared knobs:
+``NEXUS_PROMPT_LEN`` / ``NEXUS_GEN_TOKENS`` / ``NEXUS_TEMPERATURE`` shape
+the decode; ``NEXUS_STEPS`` counts rounds (the engine serves
+``rounds * batch`` individual requests); ``NEXUS_CHECKPOINT_DIR`` restores
+trained weights (the tensor checkpoint written by the training harness —
+params-only, template-free, so serve never depends on the training run's
+optimizer/opt-state layout); ``NEXUS_DECODE_KERNEL`` picks the decode
+attention implementation (auto | pallas | xla).  Config VALUES are
+validated at ``ServeConfig`` construction, so a bad env fails at parse
+time in both loops.
 """
 
 from __future__ import annotations
@@ -73,6 +78,41 @@ class ServeConfig:
     #: downstream — cached_attention precedence)
     decode_kernel: str = "auto"
 
+    def __post_init__(self) -> None:
+        # value validation lives HERE, not in the run loops: a bad env
+        # config (NEXUS_QUANTIZE=int4, NEXUS_DECODE_KERNEL=triton, ...)
+        # must fail at parse time in BOTH the lockstep loop and the
+        # continuous-batching engine, before any model/device work starts
+        if self.quantize not in ("", "int8"):
+            raise ValueError(f"unknown quantize mode {self.quantize!r}; use 'int8'")
+        if self.quantize_kv not in ("", "int8"):
+            raise ValueError(
+                f"unknown quantize_kv mode {self.quantize_kv!r}; use 'int8'"
+            )
+        if self.decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"unknown decode_kernel mode {self.decode_kernel!r}; "
+                "use auto, pallas, or xla"
+            )
+        if self.temperature < 0.0:
+            # a negative temperature silently INVERTS the sampling
+            # distribution (least-likely tokens win) — a config bug, not
+            # a sampling mode
+            raise ValueError(f"temperature must be >= 0, got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p {self.top_p} outside (0, 1]")
+        if (self.top_k or self.top_p < 1.0) and self.temperature == 0.0:
+            # generate() rejects this at call time; both serving loops must
+            # reject it at parse time instead
+            raise ValueError("top_k/top_p truncation requires temperature > 0")
+        for field_name in ("batch_size", "prompt_len", "gen_tokens", "rounds"):
+            if getattr(self, field_name) < 1:
+                raise ValueError(
+                    f"{field_name} must be >= 1, got {getattr(self, field_name)}"
+                )
+
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
         import os
@@ -96,25 +136,17 @@ class ServeConfig:
         )
 
 
-def run_serving(
-    cfg: ServeConfig,
-    store: Optional[CheckpointStore] = None,
-    ctx: Optional[ProcessContext] = None,
-    prompts: Optional[Any] = None,
-) -> Dict[str, Any]:
-    """Run the batch-decode loop under the ledger protocol; returns summary
-    metrics (rounds, decoded tokens/s).  ``prompts`` is an injectable
-    iterator of int32 ``[B, prompt_len]`` arrays (tests); default is the
-    synthetic token stream."""
-    ctx = initialize_distributed(ctx)
-    reporter = LedgerReporter(store, ctx)
-    plan = FaultPlan.from_env()
+def _load_serving_params(cfg: ServeConfig, ctx: ProcessContext):
+    """Shared serving preamble for both loops: resolve the LM adapter,
+    init/restore params (params-only tensor checkpoint, template-free),
+    apply int8 weight-only quantization.  Returns ``(adapter, model_cfg,
+    params, restored_from)``.  Config VALUES were already validated at
+    ``ServeConfig`` construction."""
     adapter = adapter_for(cfg.model)
     if not isinstance(adapter, (LlamaAdapter, MoeAdapter)):
         raise ValueError(
             f"serving requires an LM adapter (llama/moe), got {adapter.name!r}"
         )
-    mcfg = adapter.config
     logger.info("serving %s/%s: model %s", ctx.algorithm, ctx.run_id, adapter.name)
 
     params = adapter.init(jax.random.PRNGKey(cfg.seed))
@@ -131,18 +163,27 @@ def run_serving(
         ckpt.close()
 
     if cfg.quantize:
-        if cfg.quantize != "int8":
-            raise ValueError(f"unknown quantize mode {cfg.quantize!r}; use 'int8'")
         from tpu_nexus.models.quant import quantize_params
 
         params = quantize_params(params)
         logger.info("serving with int8 weight-only quantization")
-    if cfg.quantize_kv and cfg.quantize_kv != "int8":
-        raise ValueError(f"unknown quantize_kv mode {cfg.quantize_kv!r}; use 'int8'")
-    if cfg.decode_kernel not in ("auto", "pallas", "xla"):
-        raise ValueError(
-            f"unknown decode_kernel mode {cfg.decode_kernel!r}; use auto, pallas, or xla"
-        )
+    return adapter, adapter.config, params, restored_from
+
+
+def run_serving(
+    cfg: ServeConfig,
+    store: Optional[CheckpointStore] = None,
+    ctx: Optional[ProcessContext] = None,
+    prompts: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """Run the batch-decode loop under the ledger protocol; returns summary
+    metrics (rounds, decoded tokens/s).  ``prompts`` is an injectable
+    iterator of int32 ``[B, prompt_len]`` arrays (tests); default is the
+    synthetic token stream."""
+    ctx = initialize_distributed(ctx)
+    reporter = LedgerReporter(store, ctx)
+    plan = FaultPlan.from_env()
+    adapter, mcfg, params, restored_from = _load_serving_params(cfg, ctx)
 
     if prompts is None:
         prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
@@ -194,4 +235,95 @@ def run_serving(
         "elapsed_s": elapsed,
         "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
         "last_tokens_shape": tuple(last.shape) if last is not None else None,
+    }
+
+
+def run_serve_engine(
+    cfg: ServeConfig,
+    store: Optional[CheckpointStore] = None,
+    ctx: Optional[ProcessContext] = None,
+    prompts: Optional[Any] = None,
+) -> Dict[str, Any]:
+    """Continuous-batching serving under the SAME ledger protocol as
+    :func:`run_serving` (``NEXUS_MODE=serve-engine``): RUNNING →
+    per-iteration heartbeats → COMPLETED, with ``FaultPlan`` injection
+    keyed on engine iterations.
+
+    Traffic shape mirrors the lockstep loop for apples-to-apples history:
+    ``rounds * batch_size`` total requests of ``prompt_len`` prompt tokens
+    and ``gen_tokens`` generated tokens each, over ``batch_size`` KV
+    slots — but admission is per-request and per-iteration (see
+    ``tpu_nexus/serving``), so slots refill the moment a request retires
+    instead of at round boundaries.  Returns the summary dict with
+    engine SLO metrics (TTFT/TPOT p50/p99) alongside throughput."""
+    from tpu_nexus.core.telemetry import StatsdClient
+    from tpu_nexus.serving import ModelExecutor, RequestState, ServingEngine, ServingMetrics
+
+    ctx = initialize_distributed(ctx)
+    reporter = LedgerReporter(store, ctx)
+    plan = FaultPlan.from_env()
+    # live DogStatsD emission (agent sidecar / DD_DOGSTATSD_URL), the same
+    # fire-and-forget contract as the supervisor's metrics in main.py — an
+    # absent agent drops datagrams, never raises into the serving loop
+    statsd = StatsdClient(
+        "tpu_nexus.workload",  # metric names carry their own serving. prefix
+        static_tags={"algorithm": ctx.algorithm, "run_id": ctx.run_id},
+    )
+    adapter, mcfg, params, restored_from = _load_serving_params(cfg, ctx)
+    if prompts is None:
+        prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
+
+    executor = ModelExecutor(
+        params,
+        mcfg,
+        num_slots=cfg.batch_size,
+        max_len=cfg.prompt_len + cfg.gen_tokens,
+        kv_quant=cfg.quantize_kv,
+        decode_kernel=cfg.decode_kernel,
+        temperature=cfg.temperature,
+        top_k=cfg.top_k,
+        top_p=cfg.top_p,
+        seed=cfg.seed,
+    )
+    engine = ServingEngine(executor)
+
+    reporter.running()
+    # untimed warmup: one short request pays the prefill-bucket + decode-step
+    # jit compiles that would otherwise dominate small-run throughput
+    warm = np.asarray(next(prompts))
+    engine.submit(warm[0], min(2, cfg.gen_tokens), request_id="warmup-0")
+    engine.run_until_drained()
+    n_warm = len(engine.retired)
+    engine.metrics = metrics = ServingMetrics(statsd)  # drop warmup samples
+
+    t0 = time.perf_counter()
+    for _ in range(cfg.rounds):
+        for row in np.asarray(next(prompts)):
+            engine.submit(row, cfg.gen_tokens)
+    # iteration counter from 0, NOT engine.steps (warmup already advanced
+    # it): NEXUS_FAULT_STEP keys off the same zero-based count as the
+    # serve/train loops, so the default-step fault drill really fires
+    it = 0
+    while engine.has_work:
+        maybe_inject(plan, it)
+        engine.step()
+        it += 1
+        if cfg.heartbeat_every and it % cfg.heartbeat_every == 0:
+            reporter.heartbeat(it)
+    elapsed = time.perf_counter() - t0
+    reporter.heartbeat(it)
+    if ctx.is_coordinator:
+        reporter.completed()
+
+    done = engine.retired[n_warm:]
+    finished = [r for r in done if r.state == RequestState.FINISHED]
+    tokens_done = sum(len(r.output_tokens) for r in finished)
+    return {
+        "requests": len(done),
+        "finished": len(finished),
+        "restored_from": restored_from,
+        "engine_steps": it,
+        "elapsed_s": elapsed,
+        "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        **metrics.summary(),
     }
