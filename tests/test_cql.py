@@ -29,7 +29,9 @@ from tpu_nexus.checkpoint.cql import (
     TYPE_MAP,
     TYPE_TIMESTAMP,
     TYPE_VARCHAR,
+    CqlCheckpointStore,
     CqlConnection,
+    CqlConnectionError,
     CqlError,
     ScyllaCqlStore,
     encode_frame,
@@ -612,3 +614,77 @@ def test_migrate_schema_reraises_non_positive_errors(message):
     with pytest.raises(CqlError):
         store.migrate_schema()
     store.close()
+
+
+# -- transient-write retry (ISSUE 4 satellite) ----------------------------------
+
+
+class _FlakyStore(CqlCheckpointStore):
+    """Store whose connections fail transiently for the first
+    ``fail_times`` queries — the rolled-coordinator shape (long-lived
+    connection dropped; server back after reconnect)."""
+
+    def __init__(self, fail_times, definitive=False):
+        super().__init__()
+        self.fail_times = fail_times
+        self.definitive = definitive
+        self.connects = 0
+        self.queries = []
+        self.sleeps = []
+        self._sleep = self.sleeps.append  # no wall-clock waits in the suite
+        import random as _random
+
+        self._rng = _random.Random(0)
+
+    def _connect(self):
+        self.connects += 1
+        outer = self
+
+        class _Conn:
+            def query(self, cql):
+                outer.queries.append(cql)
+                if len(outer.queries) <= outer.fail_times:
+                    if outer.definitive:
+                        raise CqlError("syntax error in CQL statement")
+                    raise CqlConnectionError("connection closed by server")
+                return []
+
+            def close(self):
+                pass
+
+        return _Conn()
+
+
+def test_transient_write_retries_then_succeeds():
+    """A heartbeat/terminal write that hits two dropped connections must
+    reconnect-retry and land — not surface a one-shot driver error to the
+    workload (the pre-ISSUE-4 behavior retried exactly once)."""
+    store = _FlakyStore(fail_times=2)
+    store.update_fields("algo", "run-1", {"lifecycle_stage": "RUNNING"})
+    assert store.connects == 3  # initial + 2 reconnects
+    # first retry is immediate (stale-connection common case); the second
+    # backs off with jitter under the first ceiling
+    assert len(store.sleeps) == 1
+    assert 0.0 <= store.sleeps[0] <= store.retry_base_s
+
+
+def test_transient_retries_exhausted_raise():
+    store = _FlakyStore(fail_times=99)
+    with pytest.raises(CqlConnectionError, match="connection closed"):
+        store.read_checkpoint("algo", "run-1")
+    # initial attempt + max_retries reconnects, then give up
+    assert store.connects == store.max_retries + 1
+    # backoff ceilings grow exponentially (jittered below them)
+    assert len(store.sleeps) == store.max_retries - 1
+    for i, slept in enumerate(store.sleeps):
+        assert 0.0 <= slept <= store.retry_base_s * (2.0 ** i)
+
+
+def test_definitive_cql_error_never_retries():
+    """Auth/protocol/query errors are facts about the request, not the
+    transport — retrying replays them and hides real bugs."""
+    store = _FlakyStore(fail_times=99, definitive=True)
+    with pytest.raises(CqlError, match="syntax error"):
+        store.read_checkpoint("algo", "run-1")
+    assert store.connects == 1
+    assert store.sleeps == []

@@ -1,0 +1,746 @@
+"""Serving chaos harness (ISSUE 4): fault-isolation invariants under
+injected step faults, deadlines, backpressure, and SIGTERM drain.
+
+Layers, cheapest first:
+
+* targeted fault scenarios against the deterministic FakeExecutor — each
+  recovery path (transient retry, per-request FAILED retirement, prefill
+  fault, deadline eviction, shed, drain) exercised in isolation;
+* a seeded randomized chaos fuzz: random traffic × random fault plans,
+  asserting after EVERY step that slot accounting is consistent, and at
+  the end that every submitted request reached a terminal state, no slot
+  leaked, unaffected requests' outputs are identical to the fault-free
+  run of the same schedule, and every failure cause was recorded
+  (quick tier ≤25 seeds for tier-1; the full matrix is ``slow``);
+* real-model fault parity: a ModelExecutor decode with an injected HBM
+  OOM — the surviving requests' greedy tokens must equal one-shot
+  ``generate`` (the fault must not corrupt the cache of the batch);
+* the ledger acceptance: SIGTERM / cancelled lifecycle mid-serve lands an
+  honest PREEMPTED row with per-cause retirement counts — not a hang,
+  not a stack trace.
+"""
+
+import json
+import os
+import random
+import signal
+
+import numpy as np
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.serving import (
+    FifoScheduler,
+    QueueFull,
+    Request,
+    RequestState,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    StepFaultPolicy,
+)
+from tpu_nexus.serving.engine import (
+    CAUSE_DEADLINE,
+    CAUSE_DRAIN_GRACE,
+    CAUSE_DRAIN_SHED,
+)
+from tpu_nexus.workload.faults import (
+    EXECUTOR_FAULT_MODES,
+    FaultPlan,
+    FaultyExecutor,
+    maybe_inject,
+    wrap_executor,
+)
+
+from tests.test_serving_engine import FakeExecutor
+
+
+class StepClock:
+    """Deterministic engine clock: 1.0 'seconds' per engine step, so
+    deadlines and grace budgets are expressed in steps and the fuzz never
+    touches the wall clock."""
+
+    def __init__(self) -> None:
+        self.now = 0.0
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, dt: float = 1.0) -> None:
+        self.now += dt
+
+
+def make_engine(num_slots=2, max_len=64, executor=None, sched_cfg=None, clock=None):
+    executor = executor or FakeExecutor(num_slots, max_len)
+    policy = StepFaultPolicy(sleep=lambda s: None, rng=random.Random(0))
+    return ServingEngine(
+        executor,
+        scheduler=FifoScheduler(sched_cfg or SchedulerConfig()),
+        metrics=ServingMetrics(),
+        fault_policy=policy,
+        clock=clock or StepClock(),
+    )
+
+
+def drive(eng, clock=None, max_steps=2000):
+    while eng.has_work:
+        assert eng.steps < max_steps, "engine did not drain"
+        eng.step()
+        eng.slots.verify_consistent()
+        if clock is not None:
+            clock.advance()
+
+
+# -- targeted fault scenarios ---------------------------------------------------
+
+
+class TestStepFaultRecovery:
+    def test_hbm_oom_retires_only_the_youngest(self):
+        fake = FakeExecutor(3, 64)
+        faulty = FaultyExecutor(fake, "step-hbm-oom", at_step=2)
+        eng = make_engine(executor=faulty)
+        reqs = [eng.submit(np.array([10 * (i + 1)]), 8) for i in range(3)]
+        drive(eng)
+        states = [r.state for r in reqs]
+        assert states.count(RequestState.FAILED) == 1
+        victim = next(r for r in reqs if r.state == RequestState.FAILED)
+        assert victim is reqs[2]  # youngest admission implicated
+        assert victim.cause == "hbm-oom"
+        for r in reqs[:2]:
+            assert r.state == RequestState.FINISHED
+            assert len(r.output_tokens) == 8
+        assert eng.metrics.step_faults == {"hbm-oom": 1}
+        assert eng.metrics.retired_causes == {"hbm-oom": 1}
+        assert eng.slots.free_count == 3  # the victim's slot was released
+
+    def test_prefill_fault_retires_only_that_request(self):
+        fake = FakeExecutor(2, 64)
+        faulty = FaultyExecutor(fake, "step-hbm-oom", at_begin=1)
+        eng = make_engine(executor=faulty)
+        a = eng.submit(np.array([5]), 4)
+        b = eng.submit(np.array([7]), 4)  # second prefill faults
+        c = eng.submit(np.array([9]), 4)
+        drive(eng)
+        assert a.state == RequestState.FINISHED
+        assert b.state == RequestState.FAILED
+        assert b.cause == "hbm-oom"
+        assert b.output_tokens == []  # never produced a token
+        assert c.state == RequestState.FINISHED  # refilled the freed slot
+        assert eng.slots.free_count == 2
+
+    def test_transient_ici_heals_within_retry_budget(self):
+        fake = FakeExecutor(2, 64)
+        faulty = FaultyExecutor(fake, "step-ici", at_step=1, times=2)
+        eng = make_engine(executor=faulty)
+        reqs = [eng.submit(np.array([3 * (i + 1)]), 6) for i in range(2)]
+        drive(eng)
+        for r in reqs:
+            assert r.state == RequestState.FINISHED
+            assert len(r.output_tokens) == 6
+        # the fault was absorbed by retries, invisible to every request
+        assert eng.metrics.step_faults == {}
+        assert eng.metrics.step_retries >= 2
+        assert eng.fault_policy.retries_used >= 2
+
+    def test_ici_exhaustion_falls_back_to_retirement(self):
+        fake = FakeExecutor(2, 64)
+        # more consecutive faults than the whole retry budget can absorb
+        faulty = FaultyExecutor(fake, "step-ici", at_step=0, times=10)
+        eng = make_engine(executor=faulty)
+        reqs = [eng.submit(np.array([4 * (i + 1)]), 6) for i in range(2)]
+        drive(eng)
+        failed = [r for r in reqs if r.state == RequestState.FAILED]
+        assert failed, "exhausted transient retries must retire a victim"
+        for r in failed:
+            assert r.cause == "ici-link-failure"
+        assert eng.metrics.step_faults.get("ici-link-failure", 0) == len(failed)
+        assert eng.slots.free_count == 2
+
+    def test_device_state_lost_fails_batch_engine_survives(self):
+        """A fault that consumed the executor's device state (TPU cache
+        donation) must fail the WHOLE in-flight batch with the classified
+        cause — and the engine keeps serving later admissions on the fresh
+        cache, instead of unwinding on an 'Array has been deleted' retry."""
+        from tpu_nexus.serving import DeviceStateLost
+        from tpu_nexus.workload.faults import MSG_ICI
+
+        class StateLosingExecutor(FakeExecutor):
+            def __init__(self, num_slots, max_len, lose_at):
+                super().__init__(num_slots, max_len)
+                self.lose_at = lose_at
+                self.step_calls = 0
+
+            def step(self, tokens, cursors):
+                call = self.step_calls
+                self.step_calls += 1
+                if call == self.lose_at:
+                    raise DeviceStateLost(RuntimeError(MSG_ICI))
+                return super().step(tokens, cursors)
+
+        eng = make_engine(executor=StateLosingExecutor(2, 64, lose_at=2))
+        doomed = [eng.submit(np.array([5 * (i + 1)]), 10) for i in range(2)]
+        later = eng.submit(np.array([30]), 4)  # queued behind the batch
+        drive(eng)
+        for r in doomed:
+            assert r.state == RequestState.FAILED
+            # the ICI wording classified, but retry was rightly skipped
+            assert r.cause == "ici-link-failure"
+        assert later.state == RequestState.FINISHED
+        assert len(later.output_tokens) == 4
+        assert eng.slots.free_count == 2
+        assert eng.metrics.step_faults == {"ici-link-failure": 1}
+
+    def test_model_executor_escalates_deleted_donated_cache(self):
+        """The real executor: a RuntimeError whose aftermath left the
+        donated cache deleted raises DeviceStateLost and reinstalls a
+        fresh cache (simulating the TPU donation path on CPU by deleting
+        the buffers by hand)."""
+        import jax
+
+        from tpu_nexus.models import LlamaConfig
+        from tpu_nexus.models.llama import llama_init
+        from tpu_nexus.serving import DeviceStateLost, ModelExecutor
+        from tpu_nexus.workload.faults import MSG_ICI
+
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        executor = ModelExecutor(params, cfg, num_slots=1, max_len=8)
+
+        def boom(*a, **k):
+            raise RuntimeError(MSG_ICI)
+
+        executor._step = boom
+        for leaf in jax.tree.leaves(executor.cache):
+            leaf.delete()
+        with pytest.raises(DeviceStateLost):
+            executor.step(np.zeros(1, np.int32), np.zeros(1, np.int32))
+        # fresh cache installed: the engine can keep admitting
+        assert not any(
+            leaf.is_deleted() for leaf in jax.tree.leaves(executor.cache)
+        )
+        # with the cache INTACT the original error re-raises for the
+        # normal classify/retry path
+        with pytest.raises(RuntimeError, match="ICI link"):
+            executor.step(np.zeros(1, np.int32), np.zeros(1, np.int32))
+
+    def test_unclassified_runtime_error_propagates(self):
+        class BrokenExecutor(FakeExecutor):
+            def step(self, tokens, cursors):
+                raise RuntimeError("list index out of range")  # an engine BUG
+
+        eng = make_engine(executor=BrokenExecutor(2, 64))
+        eng.submit(np.array([1]), 4)
+        with pytest.raises(RuntimeError, match="list index"):
+            drive(eng)
+
+    def test_backoff_grows_and_is_jittered(self):
+        policy = StepFaultPolicy(
+            backoff_base_s=0.1, backoff_max_s=1.0, rng=random.Random(7)
+        )
+        waits = [policy.backoff_s(a) for a in range(6)]
+        assert all(0.0 <= w <= 1.0 for w in waits)
+        assert max(waits) <= 1.0  # ceiling respected
+        # jitter: not all equal, and ceilings grow with attempt
+        assert len({round(w, 6) for w in waits}) > 1
+
+
+class TestDeadlines:
+    def test_queued_deadline_evicts_without_device_time(self):
+        clock = StepClock()
+        eng = make_engine(num_slots=1, clock=clock)
+        hog = eng.submit(np.array([1]), 30)
+        waiting = eng.submit(np.array([2]), 4, deadline_s=3.0)
+        drive(eng, clock=clock)
+        assert hog.state == RequestState.FINISHED
+        assert waiting.state == RequestState.EVICTED
+        assert waiting.cause == CAUSE_DEADLINE
+        assert waiting.output_tokens == []
+        assert eng.metrics.retired_causes == {CAUSE_DEADLINE: 1}
+
+    def test_decoding_deadline_evicts_partial_output(self):
+        clock = StepClock()
+        eng = make_engine(num_slots=1, clock=clock)
+        req = eng.submit(np.array([1]), 30, deadline_s=5.0)
+        drive(eng, clock=clock)
+        assert req.state == RequestState.EVICTED
+        assert req.cause == CAUSE_DEADLINE
+        assert 0 < len(req.output_tokens) < 30  # partial output delivered
+
+    def test_slow_step_trips_deadlines(self):
+        clock = StepClock()
+        fake = FakeExecutor(1, 64)
+        # the injected slowness advances the SAME clock the engine reads
+        faulty = FaultyExecutor(
+            fake, "slow-step", at_step=0, slow_s=2.0, sleep=clock.advance
+        )
+        eng = make_engine(executor=faulty, clock=clock)
+        req = eng.submit(np.array([1]), 30, deadline_s=6.0)
+        drive(eng, clock=clock)
+        assert req.state == RequestState.EVICTED
+        assert req.cause == CAUSE_DEADLINE
+        assert faulty.injected > 0
+
+    def test_deadline_validation(self):
+        with pytest.raises(ValueError, match="deadline_s"):
+            Request(request_id="r", prompt=np.array([1]), max_new_tokens=1, deadline_s=0)
+
+    def test_cancel_wins_over_deadline_attribution(self):
+        """A request that is both cancel-requested and past-deadline when
+        the step runs retires CANCELLED (the user's intent) — not as an
+        SLO violation an operator would chase."""
+        clock = StepClock()
+        eng = make_engine(num_slots=1, clock=clock)
+        req = eng.submit(np.array([1]), 30, deadline_s=2.0)
+        eng.step()
+        eng.cancel(req.request_id)
+        clock.advance(5.0)  # now past the deadline too
+        eng.step()
+        assert req.state == RequestState.CANCELLED
+        assert req.cause == ""
+        assert CAUSE_DEADLINE not in eng.metrics.retired_causes
+
+
+class TestBackpressure:
+    def test_queue_limit_sheds_with_counter(self):
+        eng = make_engine(num_slots=1, sched_cfg=SchedulerConfig(max_queue=2))
+        eng.submit(np.array([1]), 4)
+        eng.step()  # first request takes the slot; queue is now empty
+        kept = [eng.submit(np.array([2]), 4), eng.submit(np.array([3]), 4)]
+        with pytest.raises(QueueFull, match="queue at capacity"):
+            eng.submit(np.array([4]), 4)
+        assert eng.metrics.shed_total == 1
+        # a shed request leaves NO trace in the engine
+        assert len(eng.requests) == 3
+        drive(eng)
+        for r in kept:
+            assert r.state == RequestState.FINISHED
+
+    def test_unbounded_by_default(self):
+        eng = make_engine(num_slots=1)
+        for i in range(50):
+            eng.submit(np.array([i + 1]), 2)
+        assert eng.metrics.shed_total == 0
+
+
+class TickingExecutor(FakeExecutor):
+    """FakeExecutor that advances the engine clock one 'second' per decode
+    step — so ``drain()``'s INTERNAL loop consumes grace budget (the
+    outer-loop clock advance never runs inside drain)."""
+
+    def __init__(self, num_slots, max_len, clock):
+        super().__init__(num_slots, max_len)
+        self.clock = clock
+
+    def step(self, tokens, cursors):
+        self.clock.advance()
+        return super().step(tokens, cursors)
+
+
+class TestDrain:
+    def test_drain_finishes_short_evicts_long_sheds_queued(self):
+        clock = StepClock()
+        eng = make_engine(
+            num_slots=2, clock=clock, executor=TickingExecutor(2, 64, clock)
+        )
+        short = eng.submit(np.array([1]), 3)
+        long = eng.submit(np.array([2]), 60)
+        queued = eng.submit(np.array([3]), 3)  # no free slot at drain time
+        eng.step()  # short+long admitted and decoding
+        summary = eng.drain(grace_s=10.0)
+        assert short.state == RequestState.FINISHED
+        assert long.state == RequestState.EVICTED
+        assert long.cause == CAUSE_DRAIN_GRACE
+        assert queued.state == RequestState.EVICTED
+        assert queued.cause == CAUSE_DRAIN_SHED
+        assert summary["drain_shed_queue"] == 1
+        assert summary["drain_evicted"] == 1
+        assert summary["drain_finished"] == 1
+        assert eng.slots.free_count == 2
+        assert not eng.has_work
+        # admission is over: post-drain submits shed
+        with pytest.raises(QueueFull, match="draining"):
+            eng.submit(np.array([9]), 2)
+        assert eng.metrics.shed_total == 1
+
+    def test_zero_grace_evicts_everything_in_flight(self):
+        eng = make_engine(num_slots=2)
+        a = eng.submit(np.array([1]), 50)
+        b = eng.submit(np.array([2]), 50)
+        eng.step()
+        eng.drain(grace_s=0.0)
+        for r in (a, b):
+            assert r.state == RequestState.EVICTED
+            assert r.cause == CAUSE_DRAIN_GRACE
+        assert eng.metrics.retired_causes == {CAUSE_DRAIN_GRACE: 2}
+
+    def test_drain_steps_keep_deadline_and_finish_semantics(self):
+        clock = StepClock()
+        eng = make_engine(
+            num_slots=2, clock=clock, executor=TickingExecutor(2, 64, clock)
+        )
+        dl = eng.submit(np.array([1]), 60, deadline_s=4.0)
+        ok = eng.submit(np.array([2]), 6)
+        eng.step()
+        eng.drain(grace_s=50.0)
+        assert ok.state == RequestState.FINISHED
+        assert dl.state == RequestState.EVICTED
+        assert dl.cause == CAUSE_DEADLINE  # deadline beat the grace budget
+
+
+def test_retirement_cause_tags_reach_telemetry():
+    """The cause must survive all the way to the metrics backend as a tag
+    dimension, not just the in-process dicts — that is what an operator's
+    dashboard groups by (RUNBOOK §10)."""
+    from tpu_nexus.core.telemetry import RecordingMetrics
+
+    rec = RecordingMetrics()
+    faulty = FaultyExecutor(FakeExecutor(1, 64), "step-hbm-oom", at_step=0)
+    eng = ServingEngine(
+        faulty,
+        metrics=ServingMetrics(rec),
+        fault_policy=StepFaultPolicy(sleep=lambda s: None),
+        clock=StepClock(),
+    )
+    eng.submit(np.array([1]), 4)
+    drive(eng)
+    assert rec.tagged_counts[
+        ("serving.requests_retired", ("cause:hbm-oom", "state:failed"))
+    ] == 1
+    assert rec.tagged_counts[("serving.step_faults", ("cause:hbm-oom",))] == 1
+
+
+# -- fault-plan env contract ----------------------------------------------------
+
+
+class TestFaultPlanContract:
+    def test_env_parses_serving_fields(self):
+        plan = FaultPlan.from_env(
+            {
+                "NEXUS_FAULT_MODE": "step-ici",
+                "NEXUS_FAULT_STEP": "3",
+                "NEXUS_FAULT_TIMES": "2",
+                "NEXUS_FAULT_SLOW_S": "0.25",
+            }
+        )
+        assert (plan.mode, plan.step, plan.times, plan.slow_s) == ("step-ici", 3, 2, 0.25)
+        assert plan.request is None
+        wrapped = wrap_executor(plan, FakeExecutor(2, 16))
+        assert isinstance(wrapped, FaultyExecutor)
+        assert (wrapped.at_step, wrapped.at_begin) == (3, None)
+
+    def test_request_targeting(self):
+        plan = FaultPlan.from_env(
+            {"NEXUS_FAULT_MODE": "step-hbm-oom", "NEXUS_FAULT_REQUEST": "1"}
+        )
+        wrapped = wrap_executor(plan, FakeExecutor(2, 16))
+        assert (wrapped.at_step, wrapped.at_begin) == (None, 1)
+
+    def test_non_executor_modes_pass_through(self):
+        fake = FakeExecutor(2, 16)
+        assert wrap_executor(FaultPlan(mode=None, step=0), fake) is fake
+        assert wrap_executor(FaultPlan(mode="hbm-oom", step=0), fake) is fake
+
+    def test_maybe_inject_executor_modes_need_a_wrapped_executor(self):
+        """The serve-engine loop declares it wrapped its executor and the
+        hook stays silent; any OTHER loop reaching the fault step with an
+        executor mode must fail loudly — a drill that injects nothing and
+        reports success is worse than no drill."""
+        for mode in EXECUTOR_FAULT_MODES:
+            plan = FaultPlan(mode=mode, step=0)
+            maybe_inject(plan, 0, executor_faults_handled=True)  # silent
+            maybe_inject(plan, 5, executor_faults_handled=False)  # wrong step
+            with pytest.raises(ValueError, match="serving-executor"):
+                maybe_inject(plan, 0)  # unwrapped loop at the fault step
+
+    def test_unknown_executor_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown executor fault mode"):
+            FaultyExecutor(FakeExecutor(1, 8), "step-meteor")
+
+
+# -- seeded randomized chaos fuzz ------------------------------------------------
+
+
+def _build_schedule(rng, max_len):
+    """One traffic schedule: (arrival_step, prompt, max_new, deadline)."""
+    n_requests = int(rng.integers(2, 14))
+    arrivals = sorted(int(a) for a in rng.integers(0, 25, size=n_requests))
+    schedule = []
+    for a in arrivals:
+        prompt_len = int(rng.integers(1, max_len // 2))
+        max_new = int(rng.integers(1, max_len - prompt_len + 1))
+        prompt = rng.integers(1, 100, size=prompt_len)
+        deadline = float(rng.integers(4, 60)) if rng.random() < 0.25 else None
+        schedule.append((a, prompt, max_new, deadline))
+    return schedule
+
+
+def _run_schedule(schedule, num_slots, max_len, sched_cfg, fault=None):
+    """Drive one schedule to completion; returns (requests, engine)."""
+    clock = StepClock()
+    executor = FakeExecutor(num_slots, max_len)
+    if fault is not None:
+        mode, kwargs = fault
+        executor = FaultyExecutor(executor, mode, sleep=lambda s: None, **kwargs)
+    eng = make_engine(
+        num_slots=num_slots, max_len=max_len, executor=executor,
+        sched_cfg=sched_cfg, clock=clock,
+    )
+    requests = []
+    step, idx = 0, 0
+    while idx < len(schedule) or eng.has_work:
+        while idx < len(schedule) and schedule[idx][0] <= step:
+            _, prompt, max_new, deadline = schedule[idx]
+            try:
+                requests.append(
+                    eng.submit(prompt, max_new, request_id=f"r{idx}", deadline_s=deadline)
+                )
+            except QueueFull:
+                requests.append(None)  # shed at admission: no lifecycle at all
+            idx += 1
+        if eng.has_work:
+            eng.step()
+        # the per-step invariants: allocator consistency + owner/active parity
+        eng.slots.verify_consistent()
+        owners = eng.slots.owners()
+        assert len(set(owners.values())) == len(owners)
+        for slot, rid in owners.items():
+            assert eng.requests[rid].slot == slot
+            assert not eng.requests[rid].is_terminal()
+        clock.advance()
+        step += 1
+        assert step < 3000, "chaos schedule did not drain"
+    return requests, eng
+
+
+def _chaos_one(seed: int) -> None:
+    rng = np.random.default_rng(seed)
+    num_slots = int(rng.integers(1, 5))
+    max_len = int(rng.integers(8, 48))
+    sched_cfg = SchedulerConfig(
+        prefill_token_budget=int(rng.integers(1, 2 * max_len)),
+        evict_after_steps=int(rng.choice([0, 0, 3])),
+        max_queue=int(rng.choice([0, 0, 0, 2, 5])),
+    )
+    schedule = _build_schedule(rng, max_len)
+    fault_kind = rng.choice(["none", "step-hbm-oom", "step-ici", "begin-hbm-oom"])
+    fault = None
+    if fault_kind == "step-hbm-oom":
+        fault = ("step-hbm-oom", {"at_step": int(rng.integers(0, 20)),
+                                  "times": int(rng.integers(1, 3))})
+    elif fault_kind == "step-ici":
+        fault = ("step-ici", {"at_step": int(rng.integers(0, 20)),
+                              "times": int(rng.integers(1, 8))})
+    elif fault_kind == "begin-hbm-oom":
+        fault = ("step-hbm-oom", {"at_begin": int(rng.integers(0, 6))})
+
+    # fault-free reference run of the SAME schedule
+    ref_requests, _ = _run_schedule(schedule, num_slots, max_len, sched_cfg)
+    requests, eng = _run_schedule(schedule, num_slots, max_len, sched_cfg, fault)
+
+    failed_causes = 0
+    for req in requests:
+        if req is None:
+            continue  # shed at admission — deliberately no lifecycle
+        # 1. every submitted request reached a terminal state
+        assert req.is_terminal(), f"seed {seed}: {req.request_id} in {req.state}"
+        if req.state == RequestState.FINISHED:
+            assert len(req.output_tokens) == req.max_new_tokens
+        elif req.state == RequestState.FAILED:
+            # 4. failure causes recorded on request AND metrics
+            assert req.cause in ("hbm-oom", "ici-link-failure"), req.cause
+            failed_causes += 1
+        elif req.state == RequestState.EVICTED:
+            assert req.cause, f"seed {seed}: EVICTED without a cause"
+    assert failed_causes == sum(eng.metrics.step_faults.values())
+    for cause, n in eng.metrics.step_faults.items():
+        assert eng.metrics.retired_causes.get(cause, 0) == n
+
+    # 2. no slot leak / double assignment survived to the end
+    eng.slots.verify_consistent()
+    assert eng.slots.used_count == 0
+    assert eng.slots.free_count == num_slots
+
+    # 3. unaffected requests: token streams identical to the fault-free run.
+    # The fake executor's tokens are a pure function of the prompt, so ANY
+    # divergence means the fault bled across slots (cross-request
+    # corruption), which is exactly what fault isolation forbids.
+    ref_by_id = {r.request_id: r for r in ref_requests if r is not None}
+    for req in requests:
+        if req is None or req.state != RequestState.FINISHED:
+            continue
+        ref = ref_by_id.get(req.request_id)
+        if ref is not None and ref.state == RequestState.FINISHED:
+            assert req.output_tokens == ref.output_tokens, (
+                f"seed {seed}: fault bled into unaffected request {req.request_id}"
+            )
+
+
+def test_chaos_fuzz_quick():
+    """Tier-1 slice of the chaos matrix (seeds 0..24, ~seconds)."""
+    for seed in range(25):
+        _chaos_one(seed)
+
+
+@pytest.mark.slow
+def test_chaos_fuzz_full():
+    """The full seed matrix — run with ``-m slow`` (not part of tier-1's
+    870 s budget on the 2-CPU CI box)."""
+    for seed in range(25, 200):
+        _chaos_one(seed)
+
+
+# -- real-model fault parity -----------------------------------------------------
+
+
+def test_model_executor_fault_keeps_survivors_token_identical():
+    """An HBM-OOM step fault against the REAL jitted executor: the victim
+    retires FAILED, and every surviving request's greedy tokens remain
+    identical to one-shot ``generate`` — the fault must not corrupt the
+    shared cache (ISSUE 4 acceptance)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.generate import generate
+    from tpu_nexus.models.llama import llama_init
+
+    cfg = LlamaConfig.tiny()
+    params = llama_init(jax.random.PRNGKey(0), cfg)
+    from tpu_nexus.serving import ModelExecutor
+
+    B, S, T = 3, 8, 6
+    rng = np.random.default_rng(13)
+    prompts = rng.integers(1, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    executor = ModelExecutor(params, cfg, num_slots=B, max_len=S + T)
+    faulty = FaultyExecutor(executor, "step-hbm-oom", at_step=2)
+    eng = make_engine(executor=faulty)
+    reqs = [eng.submit(prompts[i], T, request_id=f"r{i}") for i in range(B)]
+    drive(eng)
+
+    assert reqs[2].state == RequestState.FAILED  # youngest implicated
+    assert reqs[2].cause == "hbm-oom"
+    for i in (0, 1):
+        assert reqs[i].state == RequestState.FINISHED
+        solo = np.asarray(
+            generate(
+                params, jnp.asarray(prompts[i : i + 1]), cfg,
+                max_new_tokens=T, max_len=S + T,
+            )
+        )[0]
+        np.testing.assert_array_equal(np.asarray(reqs[i].output_tokens), solo)
+
+
+# -- ledger acceptance: drain lands an honest PREEMPTED --------------------------
+
+
+CTX = ProcessContext(
+    run_id="chaos-1", algorithm="llama-serve", process_id=0, num_processes=1,
+    coordinator=None,
+)
+
+
+def _seeded_store():
+    store = InMemoryCheckpointStore()
+    store.upsert_checkpoint(
+        CheckpointedRequest(
+            algorithm=CTX.algorithm, id=CTX.run_id,
+            lifecycle_stage=LifecycleStage.BUFFERED,
+        )
+    )
+    return store
+
+
+def _serve_cfg(**overrides):
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.workload.serve import ServeConfig
+
+    defaults = dict(
+        model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+        gen_tokens=16, rounds=2, heartbeat_every=2, drain_grace_s=0.0,
+    )
+    defaults.update(overrides)
+    return ServeConfig(**defaults)
+
+
+class TestDrainLedger:
+    def test_cancelled_lifecycle_mid_serve_lands_preempted_with_causes(self):
+        """Deterministic drain drill without real signals: the lifecycle
+        cancels between submission rounds (the injectable seam), so round
+        1's requests are in flight when admission stops.  The ledger must
+        land PREEMPTED with the per-cause retirement counts in the details
+        column, and every request must reach a terminal state."""
+        from tpu_nexus.workload.serve import run_serve_engine
+
+        store = _seeded_store()
+        lifecycle = LifecycleContext()
+        cfg = _serve_cfg()
+
+        def prompts():
+            rng = np.random.default_rng(3)
+            n = 0
+            while True:
+                if n == 2:  # warmup batch + round-1 batch delivered
+                    lifecycle.cancel(reason="SIGTERM")
+                yield rng.integers(1, 64, size=(cfg.batch_size, cfg.prompt_len))
+                n += 1
+
+        summary = run_serve_engine(
+            cfg, store=store, ctx=CTX, prompts=prompts(), lifecycle=lifecycle
+        )
+        assert summary["drained"] is True
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+        assert "SIGTERM" in row.algorithm_failure_cause
+        details = json.loads(row.algorithm_failure_details)
+        assert details["retired_causes"], details
+        # zero grace: everything in flight was evicted with a drain cause
+        drain_causes = {CAUSE_DRAIN_GRACE, CAUSE_DRAIN_SHED}
+        assert set(details["retired_causes"]) <= drain_causes
+        assert sum(details["retired_causes"].values()) == summary["requests"]
+        assert details["drain_evicted"] + details["drain_shed_queue"] >= 1
+        # summary mirrors the ledger
+        assert summary["retired_causes"] == details["retired_causes"]
+
+    def test_real_sigterm_via_drain_fault_mode(self, monkeypatch):
+        """The full drill: NEXUS_FAULT_MODE=drain-sigterm sends a REAL
+        SIGTERM mid-loop; the installed handler cancels the lifecycle and
+        the drain protocol produces PREEMPTED — no hang, no stack trace."""
+        from tpu_nexus.core.signals import setup_signal_context
+        from tpu_nexus.workload.serve import run_serve_engine
+
+        monkeypatch.setenv("NEXUS_FAULT_MODE", "drain-sigterm")
+        monkeypatch.setenv("NEXUS_FAULT_STEP", "1")
+        saved = {s: signal.getsignal(s) for s in (signal.SIGTERM, signal.SIGINT)}
+        try:
+            lifecycle = setup_signal_context(install=True)
+            store = _seeded_store()
+            summary = run_serve_engine(
+                _serve_cfg(gen_tokens=24), store=store, ctx=CTX, lifecycle=lifecycle
+            )
+        finally:
+            for sig, handler in saved.items():
+                signal.signal(sig, handler)
+        assert lifecycle.cancelled and lifecycle.reason == "SIGTERM"
+        assert summary["drained"] is True
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+        assert "SIGTERM" in row.algorithm_failure_cause
+        assert json.loads(row.algorithm_failure_details)["retired_causes"]
+
+    def test_completed_run_stays_completed(self):
+        """No cancellation → the drain path is never taken and the ledger
+        lands COMPLETED exactly as before (regression guard)."""
+        from tpu_nexus.workload.serve import run_serve_engine
+
+        store = _seeded_store()
+        summary = run_serve_engine(
+            _serve_cfg(gen_tokens=4, rounds=1), store=store, ctx=CTX,
+            lifecycle=LifecycleContext(),
+        )
+        assert summary["drained"] is False
+        assert summary["finished"] == summary["requests"] == 2
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED

@@ -315,6 +315,99 @@ class TestEngineBehavior:
         with pytest.raises(RuntimeError, match="not drained"):
             eng.run_until_drained(max_steps=5)
 
+    def test_not_drained_error_names_stuck_requests(self):
+        """The backstop message must say WHICH requests are stuck and in
+        what state — the first thing an on-call needs from a wedged
+        engine (ISSUE 4 satellite; previously only counts were reported)."""
+        eng = make_engine(num_slots=1)
+        decoding = eng.submit(np.array([1]), 30)
+        queued = eng.submit(np.array([2]), 5)
+        with pytest.raises(RuntimeError) as excinfo:
+            eng.run_until_drained(max_steps=3)
+        msg = str(excinfo.value)
+        assert f"{decoding.request_id}[{RequestState.DECODING}]" in msg
+        assert f"{queued.request_id}[{RequestState.QUEUED}]" in msg
+        assert "1 queued, 1 active" in msg
+
+
+class TestCancelRetirementRace:
+    """cancel() racing retirement in the SAME engine step must never
+    double-release the KV slot or double-count metrics (ISSUE 4
+    satellite).  The racy seam is the stream callback — it runs
+    synchronously inside the decode loop, so it can flag cancellation
+    between a request's final token and its retirement."""
+
+    def test_cancel_own_request_on_final_token_finish_wins(self):
+        eng = make_engine(num_slots=1)
+        req = eng.submit(
+            np.array([1, 2]), 3,
+            # cancel lands exactly between the FINAL token's emit and the
+            # FINISHED retirement a few lines below it in the decode loop
+            stream=lambda r, tok: (
+                eng.cancel(r.request_id)
+                if len(r.output_tokens) == r.max_new_tokens
+                else None
+            ),
+        )
+        eng.run_until_drained(max_steps=50)
+        # finish and cancel raced; finish won (the token budget was met in
+        # the same step) and the cancel flag must not re-retire
+        assert req.state == RequestState.FINISHED
+        assert req.cancel_requested
+        assert [r.request_id for r in eng.retired].count(req.request_id) == 1
+        assert eng.metrics.retired == {RequestState.FINISHED: 1}
+        eng.slots.verify_consistent()
+        assert eng.slots.free_count == 1
+
+    def test_cancel_peer_finishing_in_same_step(self):
+        """Slot 0's stream cancels slot 1 while slot 1's final token is
+        already in flight in the SAME decode iteration: exactly one
+        retirement, one slot release, one metrics count."""
+        eng = make_engine(num_slots=2)
+        peer = {}
+
+        def cancel_peer(r, tok):
+            # fires during the decode iteration in which b (processed
+            # AFTER a, higher slot id) is about to emit its final token
+            if len(r.output_tokens) == r.max_new_tokens and "b" in peer:
+                eng.cancel(peer["b"].request_id)
+
+        a = eng.submit(np.array([1]), 3, stream=cancel_peer)
+        peer["b"] = b = eng.submit(np.array([2]), 3)
+        eng.run_until_drained(max_steps=50)
+        # b's budget was met the same step the cancel landed: FINISHED wins,
+        # retired exactly once, slot freed exactly once
+        assert b.state == RequestState.FINISHED
+        assert b.cancel_requested
+        assert [r.request_id for r in eng.retired].count(b.request_id) == 1
+        assert a.state == RequestState.FINISHED
+        assert eng.metrics.retired == {RequestState.FINISHED: 2}
+        eng.slots.verify_consistent()
+        assert eng.slots.free_count == 2
+        # a retired request is gone from the live table: cancel is a no-op
+        assert not eng.cancel(b.request_id)
+
+    def test_cancel_mid_flight_peer_retires_once_next_step(self):
+        eng = make_engine(num_slots=2)
+        peer = {}
+
+        def cancel_peer(r, tok):
+            if "b" in peer:
+                eng.cancel(peer["b"].request_id)
+
+        a = eng.submit(np.array([1]), 6, stream=cancel_peer)
+        peer["b"] = b = eng.submit(np.array([2]), 40)
+        eng.run_until_drained(max_steps=100)
+        assert b.state == RequestState.CANCELLED
+        assert 0 < len(b.output_tokens) < 40
+        assert [r.request_id for r in eng.retired].count(b.request_id) == 1
+        assert eng.metrics.retired == {
+            RequestState.FINISHED: 1,
+            RequestState.CANCELLED: 1,
+        }
+        eng.slots.verify_consistent()
+        assert eng.slots.free_count == 2
+
 
 def test_percentile_nearest_rank():
     assert percentile([], 50) == 0.0

@@ -756,3 +756,175 @@ def test_tools_tree_is_clean():
     findings = lint_project(Project(REPO_ROOT, modules))
     rendered = "\n".join(f.render() for f in findings)
     assert findings == [], f"nxlint found unsuppressed issues:\n{rendered}"
+
+
+# -- NX006 serving except discipline -------------------------------------------
+
+
+def _lint_nx006(src, rel_path="tpu_nexus/serving/engine.py"):
+    return lint_source(src, "NX006", rel_path=rel_path)
+
+
+def test_nx006_silent_swallow_flagged():
+    src = """
+    try:
+        step()
+    except ValueError:
+        pass
+    """
+    findings = _lint_nx006(src)
+    assert [f.rule_id for f in findings] == ["NX006"]
+    assert "neither re-raises" in findings[0].message
+
+
+def test_nx006_applies_to_workload_serve():
+    src = "try:\n    x()\nexcept KeyError:\n    pass\n"
+    assert _lint_nx006(src, rel_path="tpu_nexus/workload/serve.py")
+
+
+def test_nx006_out_of_scope_modules_untouched():
+    """Narrow swallowed excepts elsewhere are NOT this rule's business
+    (NX003 still governs broad ones everywhere)."""
+    src = "try:\n    x()\nexcept KeyError:\n    pass\n"
+    assert _lint_nx006(src, rel_path="tpu_nexus/supervisor/service.py") == []
+
+
+def test_nx006_reraise_passes():
+    src = """
+    try:
+        step()
+    except ValueError as exc:
+        raise RuntimeError("context") from exc
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_conditional_reraise_passes():
+    src = """
+    try:
+        step()
+    except RuntimeError as exc:
+        if transient(exc):
+            retry()
+        else:
+            raise
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_classifier_call_passes():
+    src = """
+    try:
+        step()
+    except RuntimeError as exc:
+        cause = classify_tpu_failure(str(exc))
+        retire(cause)
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_method_classifier_passes():
+    src = """
+    try:
+        step()
+    except RuntimeError as exc:
+        cause = self.policy.classify(exc)
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_stepfault_catch_passes():
+    """StepFault IS the classification product — catching it means the
+    taxonomy already ran (serving/recovery.py)."""
+    src = """
+    try:
+        step()
+    except StepFault as fault:
+        retire(fault.cause)
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_justified_clause_passes():
+    src = """
+    try:
+        submit()
+    except QueueFull:  # noqa: BLE001 - load shedding is the handled contract
+        count_shed()
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_bare_except_without_escape_flagged():
+    src = """
+    try:
+        step()
+    except:
+        log()
+    """
+    findings = _lint_nx006(src)
+    assert findings and "bare except" in findings[0].message
+
+
+def test_nx006_per_line_suppression_works():
+    src = """
+    try:
+        step()
+    except ValueError:  # nxlint: disable=NX006
+        pass
+    """
+    assert _lint_nx006(src) == []
+
+
+def test_nx006_raise_in_nested_def_does_not_count():
+    """A raise tucked inside a nested function the handler never calls is
+    not a re-raise — the handler itself still swallows."""
+    src = """
+    try:
+        step()
+    except ValueError:
+        def helper():
+            raise RuntimeError("unreachable")
+        log()
+    """
+    findings = _lint_nx006(src)
+    assert findings and "neither re-raises" in findings[0].message
+
+
+def test_nx006_classifier_must_touch_the_caught_exception():
+    """classify() on unrelated data is not exception classification."""
+    src = """
+    try:
+        step()
+    except ValueError as exc:
+        label = text_model.classify(doc)
+    """
+    assert _lint_nx006(src)
+    # and with no bound name there is nothing to classify at all
+    src2 = """
+    try:
+        step()
+    except ValueError:
+        classify_tpu_failure("static text")
+    """
+    assert _lint_nx006(src2)
+
+
+def test_nx006_tuple_with_classified_and_broad_flagged():
+    """`except (StepFault, OSError)` must not ride StepFault's pass: the
+    OSError leg still swallows an unclassified exception."""
+    src = """
+    try:
+        step()
+    except (StepFault, OSError):
+        continue_serving()
+    """
+    assert _lint_nx006(src)
+    # a pure classified tuple is fine
+    src_ok = """
+    try:
+        step()
+    except (StepFault,):
+        continue_serving()
+    """
+    assert _lint_nx006(src_ok) == []

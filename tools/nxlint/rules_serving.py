@@ -2,14 +2,17 @@
 retirement path depends on.
 
 NX005  request-state totality (serving/request.py + serving/engine.py)
+NX006  serving except discipline: every handler re-raises, classifies
+       through supervisor.taxonomy, or carries a BLE001 justification
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, Iterator, Optional, Set, Tuple
 
-from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.engine import Finding, Module, Project, Rule, RuleVisitor, register
 from tools.nxlint.rules_control import _attr_names, _module_assign
 
 REQUEST_PATH = "serving/request.py"
@@ -202,3 +205,140 @@ class RequestStateTotalityRule(Rule):
                 actions[name][0],
                 f"RETIREMENT_ACTIONS has a row for {what} state {STATE_CLASS}.{name}",
             )
+
+
+# -- NX006: serving except discipline ------------------------------------------
+
+#: module path fragments the rule covers: the serving data plane and its
+#: workload loop — exactly where a swallowed exception strands requests in
+#: non-terminal states with no recorded cause
+_NX006_SCOPES = ("serving/", "workload/serve.py")
+
+#: exception types that ARE a recovery-layer product: catching them means
+#: the fault already went through supervisor.taxonomy (serving/recovery.py)
+_CLASSIFIED_TYPES = frozenset({"StepFault", "DeviceStateLost"})
+
+#: call names (last attribute segment) that classify through the taxonomy
+_CLASSIFIER_CALLS = frozenset(
+    {"classify", "classify_tpu_failure", "classify_step_fault"}
+)
+
+_NX006_JUSTIFICATION_RE = re.compile(r"#\s*noqa:\s*BLE001\s*-\s*\S")
+
+
+def _last_segment(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _type_names(type_node: Optional[ast.expr]) -> Set[str]:
+    if type_node is None:
+        return set()
+    if isinstance(type_node, ast.Tuple):
+        return {_last_segment(e) for e in type_node.elts}
+    return {_last_segment(type_node)}
+
+
+#: scopes whose bodies do NOT execute as part of the handler — a `raise`
+#: (or classifier call) inside a nested def/lambda/class proves nothing
+#: about what the handler itself does with the caught exception
+_NESTED_SCOPES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _handler_nodes(stmts) -> "list[ast.AST]":
+    """All AST nodes that execute IN the handler's own scope (nested
+    function/class bodies excluded)."""
+    out = []
+    stack = list(stmts)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, _NESTED_SCOPES):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _ServingExceptVisitor(RuleVisitor):
+    def _clause_text(self, node: ast.ExceptHandler) -> str:
+        last = node.lineno
+        if node.type is not None:
+            last = getattr(node.type, "end_lineno", None) or node.lineno
+        return "\n".join(
+            self.module.line_text(line) for line in range(node.lineno, last + 1)
+        )
+
+    def _compliant(self, node: ast.ExceptHandler) -> bool:
+        nodes = _handler_nodes(node.body)
+        # 1. re-raise on some path of the handler ITSELF (a raise tucked
+        # inside a nested def that may never run doesn't count)
+        if any(isinstance(n, ast.Raise) for n in nodes):
+            return True
+        # 2. the caught types are ALL taxonomy-classification products —
+        # `except (StepFault, OSError)` must not ride StepFault's pass,
+        # because the OSError leg still swallows unclassified
+        caught = _type_names(node.type)
+        if caught and caught <= _CLASSIFIED_TYPES:
+            return True
+        # 3. the handler classifies the CAUGHT exception: a classifier-named
+        # call whose arguments reference the `as` name (directly or wrapped,
+        # e.g. str(exc)).  `label = model.classify(doc)` on unrelated data
+        # is not an escape; neither is any call when nothing was bound.
+        if node.name:
+            for child in nodes:
+                if (
+                    isinstance(child, ast.Call)
+                    and _last_segment(child.func) in _CLASSIFIER_CALLS
+                    and any(
+                        isinstance(sub, ast.Name) and sub.id == node.name
+                        for arg in (*child.args, *(kw.value for kw in child.keywords))
+                        for sub in ast.walk(arg)
+                    )
+                ):
+                    return True
+        # 4. explicit justification on the clause line(s)
+        return bool(_NX006_JUSTIFICATION_RE.search(self._clause_text(node)))
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if not self._compliant(node):
+            what = (
+                "bare except"
+                if node.type is None
+                else f"except {ast.unparse(node.type)}"
+            )
+            self.report(
+                node,
+                f"{what} in serving code neither re-raises, classifies via "
+                "supervisor.taxonomy, nor carries a '# noqa: BLE001 - "
+                "<reason>' justification (a swallowed fault strands "
+                "requests without a terminal state)",
+            )
+        self.generic_visit(node)
+
+
+@register
+class ServingExceptDisciplineRule(Rule):
+    """NX006: the serving data plane must never swallow an exception
+    silently.  Every ``except`` handler in ``tpu_nexus/serving/`` and
+    ``workload/serve.py`` — broad OR narrow — must (a) re-raise on some
+    path, (b) classify through ``supervisor.taxonomy`` (call a
+    ``classify*`` function, or catch the already-classified ``StepFault``),
+    or (c) carry the repo's ``# noqa: BLE001 - <reason>`` justification.
+    Fail-closed by construction: a handler is flagged unless it PROVES one
+    of the three escapes; the repo-clean gate in
+    tests/test_static_analysis.py keeps the shipped tree at zero."""
+
+    rule_id = "NX006"
+    description = "serving except handlers must re-raise, classify, or justify"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        if not any(scope in module.rel_path for scope in _NX006_SCOPES):
+            return
+        visitor = _ServingExceptVisitor(self, module)
+        visitor.visit(module.tree)
+        yield from visitor.findings

@@ -22,16 +22,19 @@ from __future__ import annotations
 import base64
 import io
 import json
+import random
 import socket
 import ssl
 import struct
 import tempfile
 import threading
+import time
 import zipfile
 from datetime import datetime, timezone
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from tpu_nexus.checkpoint.models import CheckpointedRequest
+from tpu_nexus.core.util import backoff_jitter_s
 from tpu_nexus.checkpoint.store import (
     CheckpointStore,
     CheckpointStoreError,
@@ -345,10 +348,24 @@ class CqlCheckpointStore(CheckpointStore):
 
     table = "nexus.checkpoints"
 
+    #: transient-error retry budget: reconnect-and-retry attempts AFTER the
+    #: initial try (so max_retries=3 means up to 4 total attempts).  The
+    #: ledger is the workload's only witness — a heartbeat or terminal-state
+    #: write that dies on ONE dropped TCP connection while the server rolls
+    #: (a routine Scylla restart) used to surface straight to the caller and
+    #: kill the run the supervisor exists to keep honest.  Auth/protocol/
+    #: query errors (plain CqlError) are definitive and never retry.
+    max_retries = 3
+    retry_base_s = 0.1
+    retry_max_s = 2.0
+
     def __init__(self, logger: Optional[VLogger] = None) -> None:
         self._conn: Optional[CqlConnection] = None
         self._conn_lock = threading.Lock()
         self._log = logger or get_logger("tpu_nexus.cql")
+        #: injectable for tests (no wall-clock waits in the suite)
+        self._sleep = time.sleep
+        self._rng = random.Random()
 
     def _connect(self) -> CqlConnection:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -359,17 +376,39 @@ class CqlCheckpointStore(CheckpointStore):
                 self._conn = self._connect()
             return self._conn
 
+    def _drop_connection(self) -> None:
+        with self._conn_lock:
+            if self._conn is not None:
+                self._conn.close()
+            self._conn = None
+
     def _execute(self, cql: str) -> List[Dict[str, Any]]:
-        try:
-            return self._connection().query(cql)
-        except (OSError, CqlConnectionError):
-            # one reconnect attempt: CQL connections are long-lived and the
-            # server may have rolled; auth/protocol/query errors do NOT retry
-            with self._conn_lock:
-                if self._conn is not None:
-                    self._conn.close()
-                self._conn = None
-            return self._connection().query(cql)
+        """Run one statement with bounded reconnect-retries for TRANSIENT
+        (transport) failures: the shared ``core.util.backoff_jitter_s``
+        shape (full jitter — a thundering herd of N hosts retrying a
+        rolled coordinator in lockstep is its own outage), same as the
+        serving engine's step-fault policy.  The first retry is immediate
+        (the common case is one stale long-lived connection; the server
+        is already back)."""
+        attempt = 0
+        while True:
+            try:
+                return self._connection().query(cql)
+            except (OSError, CqlConnectionError) as exc:
+                self._drop_connection()
+                if attempt >= self.max_retries:
+                    raise
+                if attempt > 0:
+                    self._sleep(
+                        backoff_jitter_s(
+                            attempt - 1, self.retry_base_s, self.retry_max_s, self._rng
+                        )
+                    )
+                attempt += 1
+                self._log.warning(
+                    "transient CQL failure, retrying",
+                    attempt=attempt, max_retries=self.max_retries, error=repr(exc),
+                )
 
     def apply_schema(self, schema_cql: str) -> None:
         """Apply keyspace/table DDL (idempotent).

@@ -26,12 +26,19 @@ class LifecycleContext:
         self._flag = threading.Event()
         self._async_event: Optional[asyncio.Event] = None
         self._loop: Optional[asyncio.AbstractEventLoop] = None
+        #: WHY the context was cancelled ("SIGTERM", "SIGINT", or whatever
+        #: the canceller passed) — the serving drain protocol records it in
+        #: the ledger so the supervisor sees "preempted by SIGTERM", not
+        #: just a stage flip.  First cancellation wins; empty until then.
+        self.reason: str = ""
 
     @property
     def cancelled(self) -> bool:
         return self._flag.is_set()
 
-    def cancel(self) -> None:
+    def cancel(self, reason: str = "") -> None:
+        if reason and not self._flag.is_set():
+            self.reason = reason
         self._flag.set()
         if self._loop is not None and self._async_event is not None:
             self._loop.call_soon_threadsafe(self._async_event.set)
@@ -64,7 +71,7 @@ def setup_signal_context(install: bool = True) -> LifecycleContext:
         if ctx.cancelled:
             # second signal: hard exit, matching client-go signal handler
             os._exit(1)
-        ctx.cancel()
+        ctx.cancel(reason=signal.Signals(signum).name)
 
     signal.signal(signal.SIGINT, _handler)
     signal.signal(signal.SIGTERM, _handler)

@@ -314,16 +314,25 @@ class NullMetrics(Metrics):
 
 
 class RecordingMetrics(Metrics):
-    """In-memory recorder for tests."""
+    """In-memory recorder for tests.
+
+    ``counters`` aggregates by bare metric name (the long-standing
+    contract); ``tagged_counts`` additionally aggregates by
+    ``(name, sorted "k:v" tag tuple)`` so tests can assert tag DIMENSIONS
+    — e.g. that a retirement really carried its ``cause:`` tag — which the
+    name-keyed dict erases."""
 
     def __init__(self) -> None:
         self.counters: Dict[str, int] = {}
+        self.tagged_counts: Dict[tuple, int] = {}
         self.gauges: Dict[str, float] = {}
         self.timings: Dict[str, list] = {}
         self.histograms: Dict[str, list] = {}
 
     def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
         self.counters[name] = self.counters.get(name, 0) + value
+        key = (name, tuple(sorted(f"{k}:{v}" for k, v in (tags or {}).items())))
+        self.tagged_counts[key] = self.tagged_counts.get(key, 0) + value
 
     def gauge(self, name, value, tags=None) -> None:  # noqa: ANN001
         self.gauges[name] = value

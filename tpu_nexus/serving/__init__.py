@@ -11,9 +11,12 @@ Layering (each module imports only downward):
 
 * ``request``        — Request + the total lifecycle state machine
 * ``cache_manager``  — slot free-list + int8-aware cache buffers
-* ``scheduler``      — FIFO admission, prefill-token budget, starvation guard
-* ``metrics``        — TTFT/TPOT/queue-depth/occupancy via core.telemetry
-* ``engine``         — ModelExecutor (jitted compute) + ServingEngine (host loop)
+* ``scheduler``      — FIFO admission, prefill-token budget, starvation
+                       guard, bounded queue, deadline sweep
+* ``metrics``        — TTFT/TPOT/queue-depth/occupancy/shed/fault counters
+* ``recovery``       — taxonomy-classified step-fault retry/retire policy
+* ``engine``         — ModelExecutor (jitted compute) + ServingEngine (host
+                       loop: fault isolation, deadlines, graceful drain)
 """
 
 from tpu_nexus.serving.cache_manager import KVSlotManager, SlotError, init_cache
@@ -23,6 +26,7 @@ from tpu_nexus.serving.engine import (
     ServingEngine,
 )
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
     ACTIVE_STATES,
     TERMINAL_STATES,
@@ -31,14 +35,16 @@ from tpu_nexus.serving.request import (
     Request,
     RequestState,
 )
-from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
+from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
 
 __all__ = [
     "ACTIVE_STATES",
+    "DeviceStateLost",
     "FifoScheduler",
     "IllegalTransition",
     "KVSlotManager",
     "ModelExecutor",
+    "QueueFull",
     "RETIREMENT_ACTIONS",
     "Request",
     "RequestState",
@@ -46,6 +52,8 @@ __all__ = [
     "ServingEngine",
     "ServingMetrics",
     "SlotError",
+    "StepFault",
+    "StepFaultPolicy",
     "TERMINAL_STATES",
     "TRANSITIONS",
     "init_cache",

@@ -117,3 +117,26 @@ class KVSlotManager:
     def eviction_candidate(self) -> Optional[int]:
         """Youngest busy slot (most recent admission), or None when idle."""
         return next(reversed(self._owner), None)
+
+    def verify_consistent(self) -> None:
+        """Audit the allocator's internal invariants: free ∪ owned is an
+        exact partition of ``range(num_slots)`` (no leak, no overlap, no
+        phantom id) and no request owns two slots.  Raises :class:`SlotError`
+        on violation.  Pure host-side and O(num_slots) — the serving chaos
+        fuzz calls it after EVERY engine step, so an accounting bug surfaces
+        at the step that introduced it, not at drain time."""
+        free = set(self._free)
+        owned = set(self._owner)
+        if len(free) != len(self._free):
+            raise SlotError(f"free list holds duplicates: {sorted(self._free)}")
+        if free & owned:
+            raise SlotError(f"slots both free and owned: {sorted(free & owned)}")
+        expected = set(range(self.num_slots))
+        if free | owned != expected:
+            raise SlotError(
+                f"slot leak/phantom: free {sorted(free)} + owned {sorted(owned)} "
+                f"!= {self.num_slots} slots"
+            )
+        owners = list(self._owner.values())
+        if len(set(owners)) != len(owners):
+            raise SlotError(f"request owns multiple slots: {owners}")

@@ -24,6 +24,16 @@ Retirement is dispatched through :data:`RETIREMENT_ACTIONS`, total over
 ``request.TERMINAL_STATES`` (nxlint NX005, mirroring the NX001
 decision-taxonomy pattern): adding a terminal state without declaring how
 the engine retires it is a static-analysis error, not a midnight KeyError.
+
+Fault isolation (ISSUE 4): the jitted dispatches are wrapped in a
+classifier-aware recovery layer (``serving/recovery.py``, the engine-side
+mirror of ``supervisor.taxonomy``) — transient faults retry with backoff +
+jitter, request-fatal faults retire ONLY the implicated request as
+``FAILED`` and the batch keeps decoding; per-request deadlines retire as
+``EVICTED`` with cause ``deadline exceeded``; a bounded queue sheds
+over-capacity submits; and :meth:`ServingEngine.drain` implements the
+graceful-preemption protocol (stop admission, finish what fits in the
+grace budget, evict the rest with honest causes).
 """
 
 from __future__ import annotations
@@ -37,11 +47,12 @@ import numpy as np
 
 from tpu_nexus.serving.cache_manager import KVSlotManager, init_cache
 from tpu_nexus.serving.metrics import ServingMetrics
+from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
     Request,
     RequestState,
 )
-from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
+from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
 
 logger = logging.getLogger(__name__)
 
@@ -53,7 +64,18 @@ RETIREMENT_ACTIONS: Dict[str, str] = {
     RequestState.FINISHED: "completed",
     RequestState.CANCELLED: "cancelled",
     RequestState.EVICTED: "evicted",
+    RequestState.FAILED: "failed",
 }
+
+#: canonical ``Request.cause`` strings for EVICTED retirements — matched by
+#: tests and aggregated per-cause into the drain ledger report.  "deadline
+#: exceeded" deliberately mirrors the reference's SCHEDULING_TIMEOUT class
+#: wording.
+CAUSE_DEADLINE = "deadline exceeded"
+CAUSE_STARVATION = "starvation guard reclaimed slot"
+CAUSE_OVERFLOW = "cache overflow backstop"
+CAUSE_DRAIN_SHED = "drain: shed before admission"
+CAUSE_DRAIN_GRACE = "drain: grace budget exhausted"
 
 
 def _prefill_buckets(max_len: int) -> List[int]:
@@ -170,6 +192,23 @@ class ModelExecutor:
         self._key, sub = self._jax.random.split(self._key)
         return sub
 
+    def _guard_cache(self, exc: RuntimeError) -> None:
+        """After a faulted jitted call: if the DONATED cache buffer was
+        consumed by the failed execution (TPU backends donate it for
+        in-place updates), every retry would die on "Array has been
+        deleted" — an unclassified error that would unwind the whole
+        engine.  Reinitialize a fresh cache (so the engine can keep
+        serving NEW admissions) and raise the non-retryable
+        :class:`DeviceStateLost` signal instead; with the state intact
+        (CPU, or fault before dispatch) re-raise for normal recovery."""
+        leaves = self._jax.tree.leaves(self.cache)
+        if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in leaves):
+            self.cache = init_cache(
+                self.cfg, self.num_slots, self.max_len, self.kv_quant
+            )
+            raise DeviceStateLost(exc) from exc
+        raise exc
+
     def _bucket(self, prompt_len: int) -> int:
         for w in self._buckets:
             if w >= prompt_len:
@@ -185,26 +224,32 @@ class ModelExecutor:
         width = self._bucket(n)
         padded = np.zeros((1, width), np.int32)
         padded[0, :n] = prompt
-        self.cache, first = self._begin(
-            self.params,
-            self.cache,
-            jnp.asarray(padded),
-            jnp.asarray([n], jnp.int32),
-            jnp.asarray(slot, jnp.int32),
-            self._next_key(),
-        )
+        try:
+            self.cache, first = self._begin(
+                self.params,
+                self.cache,
+                jnp.asarray(padded),
+                jnp.asarray([n], jnp.int32),
+                jnp.asarray(slot, jnp.int32),
+                self._next_key(),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
         return int(first[0])
 
     def step(self, tokens: np.ndarray, cursors: np.ndarray) -> np.ndarray:
         """One decode iteration over all slots -> next token per slot."""
         jnp = self._jax.numpy
-        next_tokens, self.cache = self._step(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens, jnp.int32),
-            jnp.asarray(cursors, jnp.int32),
-            self._next_key(),
-        )
+        try:
+            next_tokens, self.cache = self._step(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(cursors, jnp.int32),
+                self._next_key(),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
         return np.asarray(next_tokens)
 
 
@@ -223,6 +268,7 @@ class ServingEngine:
         scheduler: Optional[FifoScheduler] = None,
         metrics: Optional[ServingMetrics] = None,
         clock: Callable[[], float] = time.monotonic,
+        fault_policy: Optional[StepFaultPolicy] = None,
         retired_log_limit: int = 10_000,
     ) -> None:
         self.executor = executor
@@ -230,6 +276,10 @@ class ServingEngine:
         self.scheduler = scheduler or FifoScheduler()
         self.metrics = metrics or ServingMetrics()
         self._clock = clock
+        self.fault_policy = fault_policy or StepFaultPolicy()
+        #: set by :meth:`drain`: admission is over, the engine only finishes
+        #: (or evicts) what is already in flight
+        self.draining = False
         self._retired_log_limit = retired_log_limit
         #: LIVE requests only (queued + in flight): retirement removes the
         #: entry, so a long-running engine's memory is bounded by what is
@@ -253,10 +303,15 @@ class ServingEngine:
         max_new_tokens: int,
         request_id: Optional[str] = None,
         stream: Optional[Callable[[Request, int], None]] = None,
+        deadline_s: Optional[float] = None,
     ) -> Request:
         """Enqueue one generation request; returns its live Request record.
-        Raises immediately when the request can never fit a cache slot
-        (prompt + budget > max_len) — a config error, not a lifecycle."""
+        Raises ValueError when the request can never fit a cache slot
+        (prompt + budget > max_len) — a config error, not a lifecycle —
+        and :class:`~tpu_nexus.serving.scheduler.QueueFull` when admission
+        sheds it (bounded queue at capacity, or the engine is draining);
+        sheds are counted on ``serving.shed`` and the client owns the
+        retry."""
         rid = request_id if request_id is not None else f"req-{next(self._counter)}"
         if rid in self.requests:
             raise ValueError(f"duplicate request id {rid!r}")
@@ -265,12 +320,22 @@ class ServingEngine:
             prompt=prompt,
             max_new_tokens=max_new_tokens,
             stream=stream,
+            deadline_s=deadline_s,
             submitted_at=self._clock(),
         )
         if not self.slots.fits(req.total_len):
             raise ValueError(
                 f"request {rid}: prompt {req.prompt_len} + max_new_tokens "
                 f"{max_new_tokens} exceeds cache max_len {self.slots.max_len}"
+            )
+        if self.draining:
+            self.metrics.shed("draining")
+            raise QueueFull(f"request {rid} shed: engine is draining")
+        if self.scheduler.full:
+            self.metrics.shed("queue-full")
+            raise QueueFull(
+                f"request {rid} shed: queue at capacity "
+                f"({self.scheduler.cfg.max_queue})"
             )
         self.requests[rid] = req
         self.scheduler.submit(req)
@@ -292,33 +357,84 @@ class ServingEngine:
     # -- the step loop ---------------------------------------------------------
 
     def step(self) -> Dict[str, int]:
-        """One engine iteration: cancellations → admission/prefill →
-        starvation guard → one decode step over every live slot.  Returns
-        counts for observability ({admitted, decoded, retired})."""
+        """One engine iteration: cancellations → deadlines →
+        admission/prefill → starvation guard → one fault-isolated decode
+        step over every live slot.  Returns counts for observability
+        ({admitted, decoded, retired})."""
         self.steps += 1
         retired_before = len(self.retired)
 
-        # 1. cancellations, queued and in-flight
+        # 1. cancellations, queued and in-flight — BEFORE the deadline
+        # sweep: a request that is both cancel-requested and past-deadline
+        # retires CANCELLED (the user's intent), not as an SLO violation
+        # an operator would chase
         for req in self.scheduler.remove_cancelled():
             self._retire(req, RequestState.CANCELLED)
         for slot, req in list(self._active.items()):
             if req.cancel_requested:
                 self._retire(req, RequestState.CANCELLED)
 
-        # 2. admission: prefill into free slots under the token budget
-        admitted = self._admit()
+        # 2. deadline sweep, queued and in-flight: past-deadline requests
+        # retire EVICTED with the SCHEDULING_TIMEOUT-mirror cause — checked
+        # BEFORE admission so an expired queued request never wastes a
+        # prefill, and before decode so a blown latency budget stops
+        # burning slot time this very step
+        now = self._clock()
+        for req in self.scheduler.remove_expired(now):
+            self._retire(req, RequestState.EVICTED, cause=CAUSE_DEADLINE)
+        for slot, req in list(self._active.items()):
+            if req.past_deadline(now):
+                self._retire(req, RequestState.EVICTED, cause=CAUSE_DEADLINE)
 
-        # 3. starvation guard: reclaim the youngest slot for a starving head
-        if self.scheduler.head_starving() and self.slots.free_count == 0:
+        # 3. admission: prefill into free slots under the token budget
+        # (suspended while draining — nothing new starts during shutdown)
+        admitted = 0 if self.draining else self._admit()
+
+        # 4. starvation guard: reclaim the youngest slot for a starving head
+        if (
+            not self.draining
+            and self.scheduler.head_starving()
+            and self.slots.free_count == 0
+        ):
             victim_slot = self.slots.eviction_candidate()
             if victim_slot is not None:
-                self._retire(self._active[victim_slot], RequestState.EVICTED)
+                self._retire(
+                    self._active[victim_slot],
+                    RequestState.EVICTED,
+                    cause=CAUSE_STARVATION,
+                )
                 admitted += self._admit()
 
-        # 4. one decode step over every live slot
+        # 5. one decode step over every live slot, fault-isolated: a
+        # transient fault retries inside the policy (the jitted step is a
+        # pure function of its inputs, so a successful retry is
+        # token-identical); an unrecoverable fault retires the implicated
+        # request — the youngest admission, whose arrival changed the
+        # device footprint — and re-attempts with the survivors.  Bounded:
+        # each pass either succeeds or shrinks the batch by one.
         decoded = 0
-        if self._active:
-            next_tokens = self.executor.step(self._tokens, self._cursors)
+        next_tokens = None
+        while self._active:
+            try:
+                next_tokens = self._dispatch(
+                    lambda: self.executor.step(self._tokens, self._cursors)
+                )
+                break
+            except DeviceStateLost as lost:
+                self._fail_batch(lost)
+                break
+            except StepFault as fault:
+                victim_slot = self.slots.eviction_candidate()
+                assert victim_slot is not None  # _active nonempty => owned slot
+                victim = self._active[victim_slot]
+                logger.warning(
+                    "step fault [%s] retired request %s (slot %d); "
+                    "%d request(s) keep decoding: %s",
+                    fault.cause, victim.request_id, victim_slot,
+                    len(self._active) - 1, fault.original,
+                )
+                self._retire(victim, RequestState.FAILED, cause=fault.cause)
+        if next_tokens is not None:
             now = self._clock()
             for slot, req in list(self._active.items()):
                 tok = int(next_tokens[slot])
@@ -331,7 +447,7 @@ class ServingEngine:
                 elif int(self._cursors[slot]) >= self.slots.max_len:
                     # cache overflow — unreachable when submit() enforced
                     # total_len <= max_len, kept as the runtime backstop
-                    self._retire(req, RequestState.EVICTED)
+                    self._retire(req, RequestState.EVICTED, cause=CAUSE_OVERFLOW)
 
         self.scheduler.tick()
         self.metrics.step_gauges(
@@ -346,16 +462,81 @@ class ServingEngine:
     def run_until_drained(self, max_steps: int = 1_000_000) -> None:
         """Step until queue and slots are empty; ``max_steps`` is the
         liveness backstop (a bug that wedges a request must fail the run,
-        not spin it)."""
+        not spin it).  The failure message names WHICH requests are stuck
+        and in what state — the first thing an on-call needs."""
         while self.has_work:
             if self.steps >= max_steps:
+                stuck = [
+                    f"{r.request_id}[{r.state}]"
+                    for r in (*self.scheduler.queued_requests(), *self._active.values())
+                ]
+                shown = ", ".join(stuck[:16]) + (
+                    f", ... ({len(stuck) - 16} more)" if len(stuck) > 16 else ""
+                )
                 raise RuntimeError(
                     f"engine not drained after {max_steps} steps: "
-                    f"{self.scheduler.pending} queued, {len(self._active)} active"
+                    f"{self.scheduler.pending} queued, {len(self._active)} active; "
+                    f"stuck requests: {shown}"
                 )
             self.step()
 
+    def drain(self, grace_s: float, max_steps: int = 1_000_000) -> Dict[str, int]:
+        """Graceful shutdown (SIGTERM / preemption): stop admission, shed
+        the queue immediately (nothing queued can ever run again), keep
+        decoding in-flight requests under the ``grace_s`` budget, then
+        evict whatever remains — every request lands a terminal state with
+        an honest cause, never a hang.  Returns a summary for the final
+        ledger report; per-cause counts live in
+        ``metrics.retired_causes``."""
+        self.draining = True
+        for req in self.scheduler.remove_cancelled():
+            self._retire(req, RequestState.CANCELLED)
+        shed_queue = 0
+        for req in self.scheduler.drain_queue():
+            self._retire(req, RequestState.EVICTED, cause=CAUSE_DRAIN_SHED)
+            shed_queue += 1
+        deadline = self._clock() + max(0.0, grace_s)
+        finished_before = self.metrics.retired.get(RequestState.FINISHED, 0)
+        steps = 0
+        while self._active and steps < max_steps and self._clock() < deadline:
+            self.step()
+            steps += 1
+        evicted = 0
+        for req in list(self._active.values()):
+            self._retire(req, RequestState.EVICTED, cause=CAUSE_DRAIN_GRACE)
+            evicted += 1
+        logger.info(
+            "drain complete: %d steps, %d finished in grace, %d evicted, "
+            "%d shed from queue",
+            steps,
+            self.metrics.retired.get(RequestState.FINISHED, 0) - finished_before,
+            evicted, shed_queue,
+        )
+        return {
+            "drain_steps": steps,
+            "drain_finished": self.metrics.retired.get(RequestState.FINISHED, 0)
+            - finished_before,
+            "drain_evicted": evicted,
+            "drain_shed_queue": shed_queue,
+        }
+
     # -- internals -------------------------------------------------------------
+
+    def _dispatch(self, fn: Callable[[], Any]) -> Any:
+        """Run one jitted dispatch through the fault policy; feed the
+        policy's audit counters into metrics.  Raises :class:`StepFault`
+        for unrecoverable classified faults (caller retires the implicated
+        request), re-raises unclassified errors."""
+        retries_before = self.fault_policy.retries_used
+        try:
+            result = self.fault_policy.run(fn)
+        except StepFault as fault:
+            self.metrics.step_fault(fault.cause, fault.retries)
+            raise
+        recovered = self.fault_policy.retries_used - retries_before
+        if recovered:
+            self.metrics.step_recovered(recovered)
+        return result
 
     def _admit(self) -> int:
         admitted = self.scheduler.admit(self.slots.free_count)
@@ -365,7 +546,24 @@ class ServingEngine:
             req.slot = slot
             req.transition(RequestState.PREFILLING)
             self.metrics.queue_wait(self._clock() - req.submitted_at)
-            first_token = self.executor.begin(slot, req.prompt)
+            try:
+                # same recovery policy as the decode step; a prefill fault
+                # implicates exactly ONE request — this one.  Transient
+                # causes re-run the begin itself (backoff + jitter inside).
+                first_token = self._dispatch(
+                    lambda slot=slot, req=req: self.executor.begin(slot, req.prompt)
+                )
+            except DeviceStateLost as lost:
+                self._fail_batch(lost, extra=req)
+                continue
+            except StepFault as fault:
+                logger.warning(
+                    "prefill fault [%s] retired request %s (slot %d); "
+                    "engine keeps serving: %s",
+                    fault.cause, req.request_id, slot, fault.original,
+                )
+                self._retire(req, RequestState.FAILED, cause=fault.cause)
+                continue
             req.emit(first_token, self._clock())
             self.metrics.first_token(req)
             if req.done:  # max_new_tokens == 1: prefill produced everything
@@ -377,12 +575,35 @@ class ServingEngine:
             self._tokens[slot] = req.output_tokens[-1]
         return len(admitted)
 
-    def _retire(self, req: Request, terminal_state: str) -> None:
+    def _fail_batch(self, lost: DeviceStateLost, extra: Optional[Request] = None) -> None:
+        """A fault consumed the executor's device state (donated cache):
+        every in-flight request's KV is gone, so ALL of them retire FAILED
+        with the classified cause — and the engine keeps serving, because
+        the executor already reinstalled a fresh cache for new
+        admissions."""
+        cause = self.fault_policy.classify(lost.original) or "device-state-lost"
+        victims = list(self._active.values())
+        if extra is not None:
+            victims.append(extra)
+        logger.error(
+            "device state lost [%s]: failing %d in-flight request(s); "
+            "engine continues on a fresh cache: %s",
+            cause, len(victims), lost.original,
+        )
+        self.metrics.step_fault(cause, 0)
+        for req in victims:
+            self._retire(req, RequestState.FAILED, cause=cause)
+
+    def _retire(self, req: Request, terminal_state: str, cause: str = "") -> None:
         """Retire ``req`` into ``terminal_state``: transition, release the
         slot, emit metrics.  Dispatch is through RETIREMENT_ACTIONS —
-        total over TERMINAL_STATES by nxlint NX005."""
+        total over TERMINAL_STATES by nxlint NX005.  ``cause`` records WHY
+        for non-FINISHED outcomes (failure classification, deadline, drain
+        — see the CAUSE_* constants)."""
         action = RETIREMENT_ACTIONS[terminal_state]
         req.transition(terminal_state)
+        if cause:
+            req.cause = cause
         req.finished_at = self._clock()
         if req.slot is not None and self.slots.owner(req.slot) == req.request_id:
             self._active.pop(req.slot, None)

@@ -43,6 +43,16 @@ class ServingMetrics:
         self.tpot_s: List[float] = []
         self.queue_wait_s: List[float] = []
         self.retired: Dict[str, int] = {}
+        #: per-CAUSE retirement counts for non-FINISHED outcomes (keys are
+        #: the recorded ``Request.cause`` strings: "hbm-oom", "deadline
+        #: exceeded", drain wordings, ...) — what the drain protocol reports
+        #: into the ledger and the chaos tests audit
+        self.retired_causes: Dict[str, int] = {}
+        #: admission sheds (bounded queue at capacity / engine draining)
+        self.shed_total = 0
+        #: classified step faults seen / transient retries spent
+        self.step_faults: Dict[str, int] = {}
+        self.step_retries = 0
         self.tokens_out = 0
 
     def queue_wait(self, seconds: float) -> None:
@@ -67,7 +77,35 @@ class ServingMetrics:
 
     def retired_request(self, req: Request, action: str) -> None:
         self.retired[req.state] = self.retired.get(req.state, 0) + 1
-        self._m.count("serving.requests_retired", tags={"state": action})
+        tags = {"state": action}
+        if req.cause:
+            self.retired_causes[req.cause] = self.retired_causes.get(req.cause, 0) + 1
+            tags["cause"] = req.cause
+        self._m.count("serving.requests_retired", tags=tags)
+
+    def shed(self, reason: str) -> None:
+        """One over-capacity (or mid-drain) submit rejected at admission."""
+        self.shed_total += 1
+        self._m.count("serving.shed", tags={"reason": reason})
+
+    def step_fault(self, cause: str, retries: int) -> None:
+        """One classified device fault went unrecoverable: ``cause`` is the
+        taxonomy token, ``retries`` the transient attempts spent before
+        giving up (0 for an immediately-fatal cause).  Retries spent here
+        ship on ``serving.step_retries`` too — transient-fault pressure is
+        highest exactly when the budget exhausts, and a dashboard that only
+        saw recovered retries would under-report the worst regime."""
+        self.step_faults[cause] = self.step_faults.get(cause, 0) + 1
+        self.step_retries += retries
+        self._m.count("serving.step_faults", tags={"cause": cause})
+        if retries:
+            self._m.count("serving.step_retries", value=retries)
+
+    def step_recovered(self, retries: int) -> None:
+        """A transient fault healed within the retry budget — ``retries``
+        backoff attempts spent, no request harmed."""
+        self.step_retries += retries
+        self._m.count("serving.step_retries", value=retries)
 
     def step_gauges(self, queue_depth: int, slots_used: int, num_slots: int) -> None:
         self._m.gauge("serving.queue_depth", queue_depth)
@@ -77,6 +115,10 @@ class ServingMetrics:
         return {
             "tokens_out": self.tokens_out,
             "requests_retired": dict(self.retired),
+            "retired_causes": dict(self.retired_causes),
+            "shed": self.shed_total,
+            "step_faults": dict(self.step_faults),
+            "step_retries": self.step_retries,
             "ttft_p50_s": percentile(self.ttft_s, 50),
             "ttft_p99_s": percentile(self.ttft_s, 99),
             "tpot_p50_s": percentile(self.tpot_s, 50),

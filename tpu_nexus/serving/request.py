@@ -4,10 +4,22 @@ One :class:`Request` is one user generation: a prompt, a token budget, and
 a per-token streaming callback.  Its life is a TOTAL state machine::
 
     QUEUED ──────► PREFILLING ──────► DECODING ──────► FINISHED
-      │                │  │              │ │
-      │                │  └─► FINISHED   │ └──────────► EVICTED
-      └─► CANCELLED ◄──┴─────────────────┘     (slot overflow / starvation
-           (user-initiated, any active state)   guard reclaimed the slot)
+      │ │              │  │              │ │ │
+      │ │              │  └─► FINISHED   │ │ └────────► EVICTED
+      │ └─────────────────────────────────────────────► EVICTED
+      │   (deadline exceeded in queue / drain shed)    (slot overflow /
+      │                │                 │ │            starvation guard /
+      │                └───► FAILED ◄────┘ │            deadline / drain)
+      │     (non-retryable step fault:     │
+      │      HBM OOM, XLA compile abort)   │
+      └─► CANCELLED ◄──────────────────────┘
+           (user-initiated, any active state)
+
+``FAILED`` is the fault-isolation terminal: a non-retryable device fault
+(classified through ``supervisor.taxonomy``) retired THIS request while
+the engine kept serving the rest of the batch; ``cause`` carries the
+classified failure string.  ``EVICTED`` additionally covers deadline
+expiry and graceful-drain shedding — ``cause`` distinguishes them.
 
 Totality is load-bearing, not decorative: the engine's retirement dispatch
 (``engine.RETIREMENT_ACTIONS``) must cover every terminal state, every
@@ -36,6 +48,7 @@ class RequestState:
     FINISHED = "Finished"
     CANCELLED = "Cancelled"
     EVICTED = "Evicted"
+    FAILED = "Failed"
 
 
 #: state -> legal successor states, TOTAL over RequestState (nxlint NX005).
@@ -43,7 +56,9 @@ class RequestState:
 #: the prefill logits already produced its only output token).
 TRANSITIONS: Dict[str, FrozenSet[str]] = {
     RequestState.QUEUED: frozenset(
-        {RequestState.PREFILLING, RequestState.CANCELLED}
+        # QUEUED -> EVICTED: deadline expired while waiting for a slot, or
+        # the queue was shed by a graceful drain (never got device time)
+        {RequestState.PREFILLING, RequestState.CANCELLED, RequestState.EVICTED}
     ),
     RequestState.PREFILLING: frozenset(
         {
@@ -51,21 +66,33 @@ TRANSITIONS: Dict[str, FrozenSet[str]] = {
             RequestState.FINISHED,
             RequestState.CANCELLED,
             RequestState.EVICTED,
+            RequestState.FAILED,
         }
     ),
     RequestState.DECODING: frozenset(
-        {RequestState.FINISHED, RequestState.CANCELLED, RequestState.EVICTED}
+        {
+            RequestState.FINISHED,
+            RequestState.CANCELLED,
+            RequestState.EVICTED,
+            RequestState.FAILED,
+        }
     ),
     RequestState.FINISHED: frozenset(),
     RequestState.CANCELLED: frozenset(),
     RequestState.EVICTED: frozenset(),
+    RequestState.FAILED: frozenset(),
 }
 
 #: terminal states never transition again and never hold a slot.  Every
 #: RequestState member belongs to exactly one of TERMINAL_STATES /
 #: ACTIVE_STATES, and terminal <=> empty TRANSITIONS row (nxlint NX005).
 TERMINAL_STATES: FrozenSet[str] = frozenset(
-    {RequestState.FINISHED, RequestState.CANCELLED, RequestState.EVICTED}
+    {
+        RequestState.FINISHED,
+        RequestState.CANCELLED,
+        RequestState.EVICTED,
+        RequestState.FAILED,
+    }
 )
 
 ACTIVE_STATES: FrozenSet[str] = frozenset(
@@ -95,6 +122,16 @@ class Request:
     state: str = RequestState.QUEUED
     slot: Optional[int] = None
     output_tokens: List[int] = field(default_factory=list)
+    #: per-request latency budget in engine-clock seconds from submit; the
+    #: engine retires the request EVICTED with cause "deadline exceeded"
+    #: once ``submitted_at + deadline_s`` passes (queued OR decoding) —
+    #: the serving mirror of the supervisor's SCHEDULING_TIMEOUT class.
+    #: None = no deadline.
+    deadline_s: Optional[float] = None
+    #: why the request retired, for non-FINISHED terminals: the classified
+    #: step-fault string (FAILED), "deadline exceeded" / drain / guard
+    #: wording (EVICTED).  Empty for FINISHED and plain user CANCELLED.
+    cause: str = ""
     submitted_at: float = 0.0
     first_token_at: Optional[float] = None
     last_token_at: Optional[float] = None
@@ -113,6 +150,11 @@ class Request:
                 f"request {self.request_id}: max_new_tokens must be >= 1, "
                 f"got {self.max_new_tokens}"
             )
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(
+                f"request {self.request_id}: deadline_s must be > 0, "
+                f"got {self.deadline_s}"
+            )
 
     @property
     def prompt_len(self) -> int:
@@ -129,6 +171,14 @@ class Request:
 
     def is_terminal(self) -> bool:
         return self.state in TERMINAL_STATES
+
+    def past_deadline(self, now: float) -> bool:
+        """True when a deadline is set and engine time ``now`` has passed
+        it.  Terminal requests are never past-deadline — their outcome is
+        already decided."""
+        if self.deadline_s is None or self.is_terminal():
+            return False
+        return now >= self.submitted_at + self.deadline_s
 
     def transition(self, new_state: str) -> None:
         if new_state not in TRANSITIONS[self.state]:

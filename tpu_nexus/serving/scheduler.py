@@ -18,6 +18,14 @@ spike).  Two liveness guards keep FIFO honest:
 
 Admission order is strictly submission order (FIFO) — asserted by the
 randomized invariant tests across hundreds of arrival patterns.
+
+Robustness additions (ISSUE 4): the queue is *bounded* (``max_queue``; the
+engine sheds over-capacity submits with a ``serving.shed`` counter instead
+of growing without bound under overload) and *deadline-aware*
+(``remove_expired`` pulls queued requests whose per-request ``deadline_s``
+elapsed before a slot freed — the serving mirror of the reference's
+SCHEDULING_TIMEOUT class; the engine retires them ``EVICTED`` with cause
+``deadline exceeded``).
 """
 
 from __future__ import annotations
@@ -37,6 +45,11 @@ class SchedulerConfig:
     #: engine steps the queue head may wait with ZERO free slots before the
     #: engine evicts the youngest running request; 0 = never evict
     evict_after_steps: int = 0
+    #: admission backpressure: queued requests beyond this are SHED at
+    #: submit (QueueFull + ``serving.shed`` counter) instead of growing the
+    #: queue unboundedly under overload; 0 = unbounded (the default — small
+    #: deployments prefer waiting over rejecting)
+    max_queue: int = 0
 
     def __post_init__(self) -> None:
         if self.prefill_token_budget < 1:
@@ -47,6 +60,14 @@ class SchedulerConfig:
             raise ValueError(
                 f"evict_after_steps must be >= 0, got {self.evict_after_steps}"
             )
+        if self.max_queue < 0:
+            raise ValueError(f"max_queue must be >= 0, got {self.max_queue}")
+
+
+class QueueFull(RuntimeError):
+    """Admission shed: the bounded queue is at capacity (or the engine is
+    draining).  A TRAFFIC condition, not a bug — the client owns the retry,
+    exactly like EVICTED."""
 
 
 class FifoScheduler:
@@ -63,6 +84,11 @@ class FifoScheduler:
     def pending(self) -> int:
         return len(self._queue)
 
+    @property
+    def full(self) -> bool:
+        """Bounded-queue backpressure check (False when unbounded)."""
+        return bool(self.cfg.max_queue) and len(self._queue) >= self.cfg.max_queue
+
     def submit(self, req: Request) -> None:
         if req.state != RequestState.QUEUED:
             raise ValueError(
@@ -78,6 +104,27 @@ class FifoScheduler:
         if cancelled:
             self._queue = deque(r for r in self._queue if not r.cancel_requested)
         return cancelled
+
+    def remove_expired(self, now: float) -> List[Request]:
+        """Pull queued requests whose deadline elapsed before a slot freed
+        (the engine retires them EVICTED, cause ``deadline exceeded``)."""
+        expired = [r for r in self._queue if r.past_deadline(now)]
+        if expired:
+            self._queue = deque(r for r in self._queue if not r.past_deadline(now))
+        return expired
+
+    def queued_requests(self) -> List[Request]:
+        """Snapshot of the queue, FIFO order — diagnostics only (the
+        not-drained failure message names who is stuck where)."""
+        return list(self._queue)
+
+    def drain_queue(self) -> List[Request]:
+        """Pop EVERY queued request (graceful drain: admission has stopped,
+        so nothing left in the queue can ever run — the engine sheds them
+        EVICTED immediately rather than leaving them non-terminal)."""
+        drained = list(self._queue)
+        self._queue.clear()
+        return drained
 
     def admit(self, free_slots: int) -> List[Request]:
         """Pop up to ``free_slots`` requests FIFO, stopping once the

@@ -114,6 +114,18 @@ NON_DELETING_ACTIONS = frozenset(
     }
 )
 
+#: TPU decisions whose *wording* is worth a bounded in-process retry when it
+#: surfaces as a step fault inside a LIVE serving engine (serving/recovery.py)
+#: — distinct from DECISION_STAGE, which is the whole-run verdict AFTER the
+#: workload died.  An ICI link flap mid-decode often heals in milliseconds
+#: (the slice stays up; one collective timed out), so the engine retries the
+#: step with backoff before declaring anything dead; HBM OOM and compile
+#: aborts are deterministic program facts — retrying replays the same fault,
+#: so the implicated request retires FAILED instead.  Preemption never
+#: arrives as a step RuntimeError (it is a SIGTERM, handled by the drain
+#: protocol), so it is deliberately absent.
+STEP_RETRYABLE_ACTIONS = frozenset({DecisionAction.TO_FAIL_ICI_LINK_DOWN})
+
 #: decision -> human run-status message, TOTAL over DecisionAction (nxlint
 #: NX001).  TO_RUNNING maps to "" because Running results carry the raw
 #: event reason, not a canned message (reference services/supervisor.go:166).

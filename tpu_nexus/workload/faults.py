@@ -22,6 +22,35 @@ mode            effect / classified as
 
 Configured by env (set by tests / chaos harness, read once at loop entry):
 ``NEXUS_FAULT_MODE``, ``NEXUS_FAULT_STEP``.
+
+Serving-engine fault modes (ISSUE 4 chaos harness) exercise the engine's
+fault-ISOLATION layer instead of killing the process, so they inject at
+the executor boundary (:func:`wrap_executor` around ``ModelExecutor``) or
+the iteration loop rather than raising into ``run_serve_engine`` itself:
+
+===============  ==============================================================
+mode             effect / expected engine behavior
+===============  ==============================================================
+``step-hbm-oom`` executor raises the HBM RESOURCE_EXHAUSTED wording at the
+                 configured call → implicated request retires FAILED
+                 (cause ``hbm-oom``), batch keeps serving
+``step-ici``     executor raises the ICI wording for ``times`` consecutive
+                 calls → transient: bounded retry with backoff heals it,
+                 no request harmed (exhausted retries → FAILED)
+``slow-step``    executor sleeps ``NEXUS_FAULT_SLOW_S`` per decode step from
+                 the configured call on → per-request deadlines trip and
+                 retire EVICTED ``deadline exceeded``
+``drain-sigterm`` SIGTERM to self at the configured engine iteration (no
+                 sleep-forever — unlike ``preempt``, the drain protocol is
+                 expected to CATCH it): admission stops, grace drain runs,
+                 ledger lands PREEMPTED with per-cause retirement counts
+===============  ==============================================================
+
+``NEXUS_FAULT_STEP`` counts executor *step* calls (or engine iterations for
+``drain-sigterm``), ``NEXUS_FAULT_REQUEST`` counts ``begin`` calls — so a
+fault can target iteration N or the Nth admitted request.
+``NEXUS_FAULT_TIMES`` repeats the fault (default 1; how ``step-ici``
+exercises retry-then-succeed vs retries-exhausted).
 """
 
 from __future__ import annotations
@@ -37,6 +66,14 @@ logger = logging.getLogger(__name__)
 
 ENV_FAULT_MODE = "NEXUS_FAULT_MODE"
 ENV_FAULT_STEP = "NEXUS_FAULT_STEP"
+ENV_FAULT_REQUEST = "NEXUS_FAULT_REQUEST"
+ENV_FAULT_TIMES = "NEXUS_FAULT_TIMES"
+ENV_FAULT_SLOW_S = "NEXUS_FAULT_SLOW_S"
+
+#: modes injected at the EXECUTOR boundary by :func:`wrap_executor`
+#: (serve-engine only) — :func:`maybe_inject` deliberately no-ops on them
+#: so the engine's recovery layer, not the loop, sees the fault
+EXECUTOR_FAULT_MODES = frozenset({"step-hbm-oom", "step-ici", "slow-step"})
 
 #: message wordings recognized by the supervisor's classifier
 #: (tpu_nexus.supervisor.taxonomy) — injection uses the same strings so the
@@ -50,17 +87,46 @@ MSG_ICI = "ICI link failure detected on interconnect 3: neighbor chip unreachabl
 class FaultPlan:
     mode: Optional[str]
     step: int
+    #: serving extensions (defaults keep every existing call site valid):
+    #: target the Nth ``begin`` call instead of the Nth step (None = step-
+    #: targeted), repeat the fault ``times`` consecutive calls, and the
+    #: per-step delay for ``slow-step``
+    request: Optional[int] = None
+    times: int = 1
+    slow_s: float = 0.05
 
     @staticmethod
     def from_env(env=None) -> "FaultPlan":
         e = os.environ if env is None else env
-        return FaultPlan(mode=e.get(ENV_FAULT_MODE) or None, step=int(e.get(ENV_FAULT_STEP, "0")))
+        raw_request = e.get(ENV_FAULT_REQUEST, "")
+        return FaultPlan(
+            mode=e.get(ENV_FAULT_MODE) or None,
+            step=int(e.get(ENV_FAULT_STEP, "0")),
+            request=int(raw_request) if raw_request else None,
+            times=int(e.get(ENV_FAULT_TIMES, "1")),
+            slow_s=float(e.get(ENV_FAULT_SLOW_S, "0.05")),
+        )
 
 
-def maybe_inject(plan: FaultPlan, step: int) -> None:
-    """Called once per training step; fires the configured fault at its step."""
+def maybe_inject(plan: FaultPlan, step: int, executor_faults_handled: bool = False) -> None:
+    """Called once per training step / engine iteration; fires the
+    configured fault at its step.  Executor-boundary modes
+    (:data:`EXECUTOR_FAULT_MODES`) are owned by :func:`wrap_executor` —
+    the serve-engine loop passes ``executor_faults_handled=True`` and this
+    hook stays silent so the engine's recovery layer sees the fault.  A
+    loop that did NOT wrap its executor (train, lockstep serve) raises at
+    the fault step instead: a chaos drill that injects nothing and
+    reports success is worse than no drill."""
     if plan.mode is None or step != plan.step:
         return
+    if plan.mode in EXECUTOR_FAULT_MODES:
+        if executor_faults_handled:
+            return
+        raise ValueError(
+            f"fault mode {plan.mode!r} injects at the serving-executor "
+            "boundary; this loop has no wrapped executor — use "
+            "NEXUS_MODE=serve-engine for this drill"
+        )
     logger.warning("injecting fault %r at step %d", plan.mode, step)
     if plan.mode == "oom":
         os._exit(137)
@@ -70,6 +136,14 @@ def maybe_inject(plan: FaultPlan, step: int) -> None:
         os.kill(os.getpid(), signal.SIGTERM)
         time.sleep(60)  # wait for the handler/runtime to take us down
         os._exit(143)
+    if plan.mode == "drain-sigterm":
+        # the graceful-preemption drill: SIGTERM with NO sleep-forever —
+        # the serve-engine drain protocol is expected to CATCH it, finish
+        # in-flight work under the grace budget and land an honest
+        # PREEMPTED ledger row (train/serve loops without a handler die
+        # with the default SIGTERM disposition, same as a real preemption)
+        os.kill(os.getpid(), signal.SIGTERM)
+        return
     if plan.mode == "xla-abort":
         raise RuntimeError(MSG_XLA_ABORT)
     if plan.mode == "hbm-oom":
@@ -80,3 +154,101 @@ def maybe_inject(plan: FaultPlan, step: int) -> None:
         while True:  # pragma: no cover - unbounded by design
             time.sleep(3600)
     raise ValueError(f"unknown fault mode {plan.mode!r}")
+
+
+class FaultyExecutor:
+    """Executor wrapper injecting serving faults at the jitted-dispatch
+    boundary — exactly where a real XLA/HBM fault surfaces, so the engine's
+    recovery layer (classify → retry/retire) is exercised end to end.
+
+    ``at_step`` counts ``step()`` calls, ``at_begin`` counts ``begin()``
+    calls (both zero-based, matching the zero-based NEXUS_FAULT_STEP
+    contract); ``times`` consecutive calls fault before the executor heals
+    (``slow-step`` never heals — slowness is a condition, not an event).
+    """
+
+    def __init__(
+        self,
+        inner,
+        mode: str,
+        *,
+        at_step: Optional[int] = None,
+        at_begin: Optional[int] = None,
+        times: int = 1,
+        slow_s: float = 0.05,
+        sleep=time.sleep,
+    ) -> None:
+        if mode not in EXECUTOR_FAULT_MODES:
+            raise ValueError(
+                f"unknown executor fault mode {mode!r}; use one of "
+                f"{sorted(EXECUTOR_FAULT_MODES)}"
+            )
+        self.inner = inner
+        self.mode = mode
+        self.at_step = at_step
+        self.at_begin = at_begin
+        self.times = times
+        self.slow_s = slow_s
+        self._sleep = sleep
+        self.step_calls = 0
+        self.begin_calls = 0
+        self.injected = 0
+
+    # the engine reads these through the executor contract
+    @property
+    def num_slots(self):
+        return self.inner.num_slots
+
+    @property
+    def max_len(self):
+        return self.inner.max_len
+
+    def _in_window(self, count: int, target: Optional[int]) -> bool:
+        if target is None:
+            return False
+        if self.mode == "slow-step":
+            return count >= target  # a slow device stays slow
+        return target <= count < target + self.times
+
+    def _fire(self) -> None:
+        self.injected += 1
+        if self.mode == "step-hbm-oom":
+            raise RuntimeError(MSG_HBM_OOM)
+        if self.mode == "step-ici":
+            raise RuntimeError(MSG_ICI)
+        # slow-step: delay, then proceed normally
+        self._sleep(self.slow_s)
+
+    def begin(self, slot, prompt):
+        count = self.begin_calls
+        self.begin_calls += 1
+        if self._in_window(count, self.at_begin):
+            self._fire()
+        return self.inner.begin(slot, prompt)
+
+    def step(self, tokens, cursors):
+        count = self.step_calls
+        self.step_calls += 1
+        if self._in_window(count, self.at_step):
+            self._fire()
+        return self.inner.step(tokens, cursors)
+
+
+def wrap_executor(plan: FaultPlan, executor):
+    """Wrap ``executor`` per the fault plan; pass-through for non-executor
+    modes (including no fault).  ``NEXUS_FAULT_REQUEST`` targets the Nth
+    prefill, otherwise ``NEXUS_FAULT_STEP`` targets the Nth decode step."""
+    if plan.mode not in EXECUTOR_FAULT_MODES:
+        return executor
+    logger.warning(
+        "serving chaos: wrapping executor with %r (step=%s request=%s times=%d)",
+        plan.mode, plan.step, plan.request, plan.times,
+    )
+    if plan.request is not None:
+        return FaultyExecutor(
+            executor, plan.mode, at_begin=plan.request,
+            times=plan.times, slow_s=plan.slow_s,
+        )
+    return FaultyExecutor(
+        executor, plan.mode, at_step=plan.step, times=plan.times, slow_s=plan.slow_s
+    )

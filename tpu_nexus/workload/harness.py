@@ -192,6 +192,21 @@ class LedgerReporter:
             {"lifecycle_stage": LifecycleStage.COMPLETED, "result_uri": result_uri}
         )
 
+    def preempted(self, cause: str = "", details: str = "") -> None:
+        """Workload-side preemption report: the graceful-drain protocol
+        lands the row PREEMPTED *itself* (with the drain cause and the
+        per-cause retirement counts in the details column) instead of
+        betting that a k8s event will arrive after the process dies —
+        the supervisor's restart machinery then treats it exactly like an
+        event-classified preemption (PREEMPTED is non-terminal, rank-equal
+        with RUNNING, so a restarted run returns to RUNNING cleanly)."""
+        fields: Dict[str, Any] = {"lifecycle_stage": LifecycleStage.PREEMPTED}
+        if cause:
+            fields["algorithm_failure_cause"] = cause
+        if details:
+            fields["algorithm_failure_details"] = details
+        self._guarded_update(fields)
+
     def hlo_trace(self, uri: str) -> None:
         """Record the failure-time trace artifact ref; the lifecycle itself
         stays untouched — the terminal transition is the supervisor's call."""

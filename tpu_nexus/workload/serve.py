@@ -77,6 +77,19 @@ class ServeConfig:
     #: (a non-auto value set HERE is explicit and wins over ambient env
     #: downstream — cached_attention precedence)
     decode_kernel: str = "auto"
+    #: engine mode only — per-request latency budget in seconds; requests
+    #: that outlive it (queued OR decoding) retire EVICTED with cause
+    #: "deadline exceeded" (the serving mirror of SCHEDULING_TIMEOUT);
+    #: 0 = no deadline (NEXUS_DEADLINE_S)
+    deadline_s: float = 0.0
+    #: engine mode only — bounded admission queue: submits beyond this are
+    #: SHED (serving.shed counter) instead of growing the queue without
+    #: bound; 0 = unbounded (NEXUS_QUEUE_LIMIT)
+    queue_limit: int = 0
+    #: engine mode only — graceful-drain grace budget after SIGTERM/
+    #: preemption: in-flight requests get this many seconds to finish
+    #: before being evicted with an honest cause (NEXUS_DRAIN_GRACE_S)
+    drain_grace_s: float = 5.0
 
     def __post_init__(self) -> None:
         # value validation lives HERE, not in the run loops: a bad env
@@ -112,6 +125,11 @@ class ServeConfig:
                 raise ValueError(
                     f"{field_name} must be >= 1, got {getattr(self, field_name)}"
                 )
+        for field_name in ("deadline_s", "queue_limit", "drain_grace_s"):
+            if getattr(self, field_name) < 0:
+                raise ValueError(
+                    f"{field_name} must be >= 0, got {getattr(self, field_name)}"
+                )
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
@@ -133,6 +151,9 @@ class ServeConfig:
             quantize=e.get("NEXUS_QUANTIZE", ""),
             quantize_kv=e.get("NEXUS_QUANTIZE_KV", ""),
             decode_kernel=e.get("NEXUS_DECODE_KERNEL", "auto"),
+            deadline_s=float(e.get("NEXUS_DEADLINE_S", "0")),
+            queue_limit=int(e.get("NEXUS_QUEUE_LIMIT", "0")),
+            drain_grace_s=float(e.get("NEXUS_DRAIN_GRACE_S", "5.0")),
         )
 
 
@@ -243,6 +264,7 @@ def run_serve_engine(
     store: Optional[CheckpointStore] = None,
     ctx: Optional[ProcessContext] = None,
     prompts: Optional[Any] = None,
+    lifecycle: Optional["LifecycleContext"] = None,
 ) -> Dict[str, Any]:
     """Continuous-batching serving under the SAME ledger protocol as
     :func:`run_serving` (``NEXUS_MODE=serve-engine``): RUNNING →
@@ -255,13 +277,66 @@ def run_serve_engine(
     slots — but admission is per-request and per-iteration (see
     ``tpu_nexus/serving``), so slots refill the moment a request retires
     instead of at round boundaries.  Returns the summary dict with
-    engine SLO metrics (TTFT/TPOT p50/p99) alongside throughput."""
-    from tpu_nexus.core.telemetry import StatsdClient
-    from tpu_nexus.serving import ModelExecutor, RequestState, ServingEngine, ServingMetrics
+    engine SLO metrics (TTFT/TPOT p50/p99) alongside throughput.
+
+    Fault isolation (ISSUE 4): step faults are classified and recovered
+    inside the engine (transient → retry, fatal → per-request FAILED);
+    SIGTERM/SIGINT cancels ``lifecycle`` and triggers the graceful-drain
+    protocol — stop admission, finish what fits in ``cfg.drain_grace_s``,
+    evict the rest, and land the ledger row PREEMPTED with the per-cause
+    retirement counts instead of a hang or a stack trace.  ``lifecycle``
+    is injectable for tests; by default signal handlers install when
+    running on the main thread."""
+    import threading
+
+    from tpu_nexus.core.signals import setup_signal_context
 
     ctx = initialize_distributed(ctx)
     reporter = LedgerReporter(store, ctx)
     plan = FaultPlan.from_env()
+    restore_handlers = {}
+    if lifecycle is None:
+        # signal.signal only works on the main thread; elsewhere (nested
+        # test runners, thread pools) fall back to an uninstalled context.
+        # Handlers WE install are restored on exit (the finally below) so a
+        # host process (tests, notebooks) is not left with a handler bound
+        # to this run's dead context.
+        import signal as _signal
+
+        on_main = threading.current_thread() is threading.main_thread()
+        if on_main:
+            restore_handlers = {
+                s: _signal.getsignal(s) for s in (_signal.SIGINT, _signal.SIGTERM)
+            }
+        lifecycle = setup_signal_context(install=on_main)
+    try:
+        return _serve_engine_loop(cfg, store, ctx, prompts, lifecycle, reporter, plan)
+    finally:
+        if restore_handlers:
+            import signal as _signal
+
+            for sig, handler in restore_handlers.items():
+                _signal.signal(sig, handler)
+
+
+def _serve_engine_loop(
+    cfg: ServeConfig,
+    store: Optional[CheckpointStore],
+    ctx: ProcessContext,
+    prompts: Optional[Any],
+    lifecycle: "LifecycleContext",
+    reporter: LedgerReporter,
+    plan: FaultPlan,
+) -> Dict[str, Any]:
+    from tpu_nexus.core.telemetry import StatsdClient
+    from tpu_nexus.serving import (
+        ModelExecutor,
+        QueueFull,
+        RequestState,
+        ServingEngine,
+        ServingMetrics,
+    )
+    from tpu_nexus.workload.faults import wrap_executor
     # live DogStatsD emission (agent sidecar / DD_DOGSTATSD_URL), the same
     # fire-and-forget contract as the supervisor's metrics in main.py — an
     # absent agent drops datagrams, never raises into the serving loop
@@ -272,6 +347,8 @@ def run_serve_engine(
     adapter, mcfg, params, restored_from = _load_serving_params(cfg, ctx)
     if prompts is None:
         prompts = adapter.data(cfg.batch_size, cfg.prompt_len, seed=cfg.seed + 101)
+
+    from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
 
     executor = ModelExecutor(
         params,
@@ -285,7 +362,10 @@ def run_serve_engine(
         top_p=cfg.top_p,
         seed=cfg.seed,
     )
-    engine = ServingEngine(executor)
+    engine = ServingEngine(
+        executor,
+        scheduler=FifoScheduler(SchedulerConfig(max_queue=cfg.queue_limit)),
+    )
 
     reporter.running()
     # untimed warmup: one short request pays the prefill-bucket + decode-step
@@ -295,25 +375,77 @@ def run_serve_engine(
     engine.run_until_drained()
     n_warm = len(engine.retired)
     engine.metrics = metrics = ServingMetrics(statsd)  # drop warmup samples
+    # chaos seam AFTER warmup, so NEXUS_FAULT_STEP counts served decode
+    # steps on the same zero base as the iteration counter below
+    engine.executor = wrap_executor(plan, executor)
 
     t0 = time.perf_counter()
-    for _ in range(cfg.rounds):
-        for row in np.asarray(next(prompts)):
-            engine.submit(row, cfg.gen_tokens)
+    deadline_s = cfg.deadline_s or None
     # iteration counter from 0, NOT engine.steps (warmup already advanced
     # it): NEXUS_FAULT_STEP keys off the same zero-based count as the
     # serve/train loops, so the default-step fault drill really fires
     it = 0
-    while engine.has_work:
-        maybe_inject(plan, it)
+
+    def pump() -> None:
+        nonlocal it
+        maybe_inject(plan, it, executor_faults_handled=True)
         engine.step()
         it += 1
         if cfg.heartbeat_every and it % cfg.heartbeat_every == 0:
             reporter.heartbeat(it)
+
+    for _ in range(cfg.rounds):
+        if lifecycle.cancelled:
+            break  # admission stops the moment shutdown is requested
+        for row in np.asarray(next(prompts)):
+            while not lifecycle.cancelled:
+                try:
+                    engine.submit(row, cfg.gen_tokens, deadline_s=deadline_s)
+                    break
+                except QueueFull:  # noqa: BLE001 - backpressure IS the handled outcome: every rejection is counted on serving.shed (the 429), then this closed-loop client retries after pumping the engine
+                    if not engine.has_work:
+                        break  # nothing to pump — drop rather than spin
+                    pump()
+    while engine.has_work and not lifecycle.cancelled:
+        pump()
     elapsed = time.perf_counter() - t0
-    reporter.heartbeat(it)
-    if ctx.is_coordinator:
-        reporter.completed()
+
+    drain_summary: Dict[str, Any] = {}
+    if lifecycle.cancelled:
+        # graceful drain: finish what fits in the grace budget, evict the
+        # rest with honest causes, then report PREEMPTED + per-cause counts
+        # so the supervisor sees a restartable preemption, not a hang
+        drain_summary = engine.drain(cfg.drain_grace_s)
+        # keep `it` zero-based post-warmup (same semantics as a completed
+        # run) and keep `elapsed` covering every counted token: drain steps
+        # produce tokens, so a tokens/s over the pre-drain window alone
+        # would overstate throughput of preempted runs
+        it += drain_summary["drain_steps"]
+        elapsed = time.perf_counter() - t0
+        cause = f"serving drain: {lifecycle.reason or 'shutdown requested'}"
+        logger.warning(
+            "%s — %s; retirement causes: %s",
+            cause, drain_summary, metrics.retired_causes,
+        )
+        reporter.heartbeat(it)
+        if ctx.is_coordinator:
+            import json
+
+            reporter.preempted(
+                cause=cause,
+                details=json.dumps(
+                    {
+                        "retired_states": metrics.retired,
+                        "retired_causes": metrics.retired_causes,
+                        **drain_summary,
+                    },
+                    sort_keys=True,
+                ),
+            )
+    else:
+        reporter.heartbeat(it)
+        if ctx.is_coordinator:
+            reporter.completed()
 
     done = engine.retired[n_warm:]
     finished = [r for r in done if r.state == RequestState.FINISHED]
@@ -325,5 +457,7 @@ def run_serve_engine(
         "engine_steps": it,
         "elapsed_s": elapsed,
         "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        "drained": lifecycle.cancelled,
+        **drain_summary,
         **metrics.summary(),
     }
