@@ -1,0 +1,806 @@
+"""Checkpoint durability chaos (ISSUE 5): torn saves, bit rot, preemption
+mid-save — and the recovery the restart-from-step contract promises.
+
+The drills assert the four durability invariants end to end:
+
+* a crash between the payload write and the commit marker
+  (``ckpt-crash-mid-save``) costs at most the uncommitted step: the restart
+  resumes from the last *committed* step with a loss trajectory bit-identical
+  to an uninterrupted run, and the torn directory is quarantined;
+* the ledger NEVER points at an uncommitted or corrupt URI — the publish
+  sits behind the durability barrier, so an injected commit failure leaves
+  the previous pointer in place;
+* a corrupted committed leaf (``ckpt-bitflip``) rolls the next restore back
+  exactly one step, cause recorded to metrics and the ledger;
+* SIGTERM converts to a saved step: the emergency save beats the grace
+  budget (and skips the duplicate when the preemption landed inside a save
+  window whose commit completed), and the row exits PREEMPTED with the
+  saved step in the details.
+
+Quick tier runs in tier-1; the full seed-matrix corruption fuzz and the
+every-boundary crash drill ride behind the ``slow`` marker.  Model is the
+mnist MLP throughout — the durability layer is model-agnostic and the tiny
+jit keeps the drills inside the tier-1 wall-clock budget.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import uuid
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore, SqliteCheckpointStore
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.core.telemetry import RecordingMetrics
+from tpu_nexus.models.registry import get_adapter
+from tpu_nexus.parallel import MeshSpec
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.workload import durability
+from tpu_nexus.workload.faults import (
+    FaultPlan,
+    _flip_committed_leaf,
+    checkpoint_fault_hook,
+    maybe_inject,
+)
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.workload.tensor_checkpoint import (
+    CheckpointCorrupt,
+    CheckpointMissing,
+    CheckpointUncommitted,
+    TensorCheckpointer,
+)
+
+ALGORITHM = "mnist-train"
+CTX = ProcessContext(
+    run_id="run-ckpt", algorithm=ALGORITHM, process_id=0, num_processes=1, coordinator=None
+)
+
+
+def mnist_cfg(**over):
+    base = dict(
+        model=get_adapter("mnist"),
+        mesh=MeshSpec(fsdp=-1),
+        batch_size=8,
+        seq_len=16,
+        steps=6,
+        heartbeat_every=2,
+        checkpoint_every=2,
+    )
+    base.update(over)
+    return WorkloadConfig(**base)
+
+
+def seeded_store(rid=CTX.run_id, algorithm=ALGORITHM):
+    store = InMemoryCheckpointStore()
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=algorithm, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    return store
+
+
+def tiny_state(step, scale=1.0):
+    return {
+        "params": {"w": jnp.arange(8.0) * scale, "b": jnp.ones((3,)) * step},
+        "step": jnp.int32(step),
+    }
+
+
+def committed_steps(directory, *steps):
+    tc = TensorCheckpointer(directory)
+    for s in steps:
+        tc.save(s, tiny_state(s))
+        tc.commit(s)
+    tc.close()
+
+
+# -- the durability layer itself -----------------------------------------------
+
+
+class TestDurabilityLayer:
+    def test_commit_then_verify_roundtrip(self, tmp_path):
+        d = str(tmp_path)
+        tc = TensorCheckpointer(d)
+        tc.save(2, tiny_state(2))
+        uri = tc.commit(2)
+        assert uri == f"{d}/2" and tc.last_committed_step == 2
+        manifest = tc.verify(2)
+        assert manifest["step"] == 2 and manifest["file_count"] > 0
+        assert os.path.isfile(os.path.join(d, "2", durability.MANIFEST_NAME))
+        restored = tc.restore(tiny_state(0))
+        np.testing.assert_array_equal(restored["params"]["w"], np.arange(8.0))
+        assert int(restored["step"]) == 2
+        tc.close()
+
+    def test_restore_empty_directory_classified_missing(self, tmp_path):
+        tc = TensorCheckpointer(str(tmp_path / "fresh"))
+        with pytest.raises(CheckpointMissing) as exc:
+            tc.restore_params()
+        assert exc.value.cause == "missing"
+        # back-compat: pre-durability callers caught FileNotFoundError
+        assert isinstance(exc.value, FileNotFoundError)
+        tc.close()
+
+    def test_restore_uncommitted_step_classified(self, tmp_path):
+        """Step dir present but no commit marker: a torn save, distinct from
+        both absence and corruption."""
+        d = str(tmp_path)
+        committed_steps(d, 2)
+        tc = TensorCheckpointer(d)
+        tc.save(4, tiny_state(4))
+        tc.wait()  # payload durable — but never committed
+        tc.close()
+        fresh = TensorCheckpointer(d)
+        with pytest.raises(CheckpointUncommitted) as exc:
+            fresh.restore_params(4)  # explicit step: the caller demanded it
+        assert exc.value.cause == "uncommitted"
+        # no step: rollback lands the previous committed step
+        params = fresh.restore_params()
+        np.testing.assert_array_equal(params["w"], np.arange(8.0))
+        assert fresh.rollbacks[0]["step"] == 4
+        assert fresh.rollbacks[0]["cause"] == "uncommitted"
+        fresh.close()
+
+    def test_restore_checksum_mismatch_classified(self, tmp_path):
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        _flip_committed_leaf(os.path.join(d, "4"))
+        tc = TensorCheckpointer(d)
+        with pytest.raises(CheckpointCorrupt) as exc:
+            tc.restore_params(4)
+        assert exc.value.cause == "corrupt" and "checksum mismatch" in str(exc.value)
+        tc.close()
+
+    def test_corrupt_latest_rolls_back_one_step_and_quarantines(self, tmp_path):
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        _flip_committed_leaf(os.path.join(d, "4"))
+        tc = TensorCheckpointer(d)
+        assert tc.latest_verified_step() == 2
+        assert [e["cause"] for e in tc.rollbacks] == ["corrupt"]
+        assert tc.rollbacks[0]["quarantined_to"].endswith("4" + durability.QUARANTINE_SUFFIX)
+        # the bad directory is out of the step scan but kept for postmortems
+        assert sorted(n for n in os.listdir(d) if not n.startswith(".")) == [
+            "2",
+            "4" + durability.QUARANTINE_SUFFIX,
+        ]
+        restored = tc.restore(tiny_state(0))
+        assert int(restored["step"]) == 2
+        tc.close()
+
+    def test_read_only_rollback_leaves_directories(self, tmp_path):
+        """Serving restores with quarantine=False: skip, don't mutate."""
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        os.remove(os.path.join(d, "4", durability.MANIFEST_NAME))
+        tc = TensorCheckpointer(d)
+        assert tc.latest_verified_step(quarantine=False) == 2
+        assert tc.rollbacks[0]["cause"] == "uncommitted"
+        assert "quarantined_to" not in tc.rollbacks[0]
+        assert os.path.isdir(os.path.join(d, "4"))
+        tc.close()
+
+    def test_manifest_detects_missing_and_truncated_files(self, tmp_path):
+        d = str(tmp_path)
+        committed_steps(d, 2)
+        step_dir = os.path.join(d, "2")
+        victim = os.path.join(step_dir, sorted(durability.manifest_files(step_dir))[0])
+        original = open(victim, "rb").read()
+        with open(victim, "wb") as fh:
+            fh.write(original[: max(len(original) // 2, 1)])
+        with pytest.raises(CheckpointCorrupt, match="bytes"):
+            durability.verify_step(step_dir, 2)
+        os.remove(victim)
+        with pytest.raises(CheckpointCorrupt, match="missing"):
+            durability.verify_step(step_dir, 2)
+
+    def test_adopt_unmanifested_legacy_steps(self, tmp_path):
+        """Upgrade migration (docs/CHECKPOINTS.md): pre-durability steps
+        carry no manifest and would ALL quarantine as torn saves on the
+        first post-upgrade restart; explicit adoption commits a manifest
+        from the bytes on disk, and the verifier accepts them from then
+        on."""
+        d = str(tmp_path)
+        tc = TensorCheckpointer(d)
+        for s in (2, 4):
+            tc.save(s, tiny_state(s))
+        tc.wait()
+        tc.close()  # legacy shape: orbax-finalized, never commit()ed
+        assert durability.adopt_unmanifested_steps(d) == [2, 4]
+        assert durability.adopt_unmanifested_steps(d) == []  # idempotent
+        fresh = TensorCheckpointer(d)
+        assert fresh.latest_verified_step() == 4 and fresh.rollbacks == []
+        params = fresh.restore_params()
+        np.testing.assert_array_equal(params["w"], np.arange(8.0))
+        fresh.close()
+
+    def test_scan_tolerates_step_vanishing_mid_walk(self, tmp_path, monkeypatch):
+        """Multi-host race: another host's quarantine rename can delete a
+        step directory between this host's list_steps and its verify_step.
+        The scan must record the miss and keep walking, not crash — a
+        non-coordinator dying here wedges the whole collective restore."""
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        real_verify = durability.verify_step
+
+        def racing_verify(step_dir, step=None):
+            if step == 4:  # simulate the rename landing mid-walk
+                raise durability.CheckpointMissing(f"{step_dir} vanished")
+            return real_verify(step_dir, step)
+
+        monkeypatch.setattr(durability, "verify_step", racing_verify)
+        step, rollbacks = durability.newest_verified_step(d, quarantine=True)
+        assert step == 2
+        assert [r["cause"] for r in rollbacks] == ["missing"]
+        # nothing to quarantine — the other host already renamed it
+        assert "quarantined_to" not in rollbacks[0]
+        assert sorted(os.listdir(d)) == ["2", "4"]
+
+    def test_durability_import_stays_stdlib_only(self):
+        """The supervisor wires durability.resolve_verified_uri into the
+        watchdog (service.init) — importing it must not drag jax/orbax into
+        a process that never trains (workload/__init__ is lazy, PEP 562)."""
+        probe = (
+            "import sys\n"
+            "import tpu_nexus.workload.durability\n"
+            "assert 'jax' not in sys.modules, 'jax leaked'\n"
+            "assert 'orbax' not in sys.modules, 'orbax leaked'\n"
+            "from tpu_nexus.workload import WorkloadConfig\n"  # lazy export still works
+        )
+        subprocess.run([sys.executable, "-c", probe], check=True, timeout=60)
+
+    def test_wrong_shaped_manifest_classifies_corrupt(self, tmp_path):
+        """A manifest that parses as JSON but is wrong-shaped (files as a
+        list, a file entry as a string, the whole document a list) is
+        corruption like any other — it must classify, never escape as a
+        raw TypeError/AttributeError the rollback scan can't catch."""
+        d = str(tmp_path)
+        committed_steps(d, 2)
+        marker = os.path.join(d, "2", durability.MANIFEST_NAME)
+        for bad in (
+            '{"step": 2, "files": []}',
+            '{"step": 2, "files": {"a": "junk"}}',
+            '{"step": 2, "files": {"a": {"bytes": "3.5", "sha256": "x"}}}',
+            "[1, 2]",
+        ):
+            with open(marker, "w", encoding="utf-8") as fh:
+                fh.write(bad)
+            with pytest.raises(CheckpointCorrupt, match="unreadable manifest"):
+                durability.verify_step(os.path.join(d, "2"), 2)
+        # and the rollback scan records it instead of crashing
+        step, rollbacks = durability.newest_verified_step(d, quarantine=False)
+        assert step is None
+        assert [r["cause"] for r in rollbacks] == ["corrupt"]
+
+    def test_verify_classifies_raw_oserror_as_checkpoint_error(
+        self, tmp_path, monkeypatch
+    ):
+        """A stat/read that fails RAW mid-verification (the quarantine
+        rename landing between the file checks, or an I/O error) must come
+        out classified — the rollback scan and the watchdog resolver catch
+        only CheckpointError, and a leaked FileNotFoundError would crash
+        the very scan built to tolerate the race."""
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        real = durability._sha256_file
+
+        def renaming_hash(path, chunk=1 << 20):
+            if os.sep + "4" + os.sep in path and os.path.isdir(os.path.join(d, "4")):
+                os.rename(os.path.join(d, "4"), os.path.join(d, "4.gone"))
+            return real(path, chunk)  # raises raw FileNotFoundError for step 4
+
+        monkeypatch.setattr(durability, "_sha256_file", renaming_hash)
+        step, rollbacks = durability.newest_verified_step(d, quarantine=False)
+        assert step == 2
+        assert [r["cause"] for r in rollbacks] == ["missing"]
+
+    def test_verify_classifies_unreadable_file_as_corrupt(
+        self, tmp_path, monkeypatch
+    ):
+        """An I/O error on a file whose directory is still present is
+        corruption, not absence."""
+        d = str(tmp_path)
+        committed_steps(d, 2)
+
+        def failing_hash(path, chunk=1 << 20):
+            raise OSError("injected I/O error")
+
+        monkeypatch.setattr(durability, "_sha256_file", failing_hash)
+        with pytest.raises(CheckpointCorrupt, match="unreadable"):
+            durability.verify_step(os.path.join(d, "2"), 2)
+
+    def test_caching_resolver_skips_rehash_when_marker_unchanged(
+        self, tmp_path, monkeypatch
+    ):
+        """The watchdog sweep re-checks every PREEMPTED row every interval;
+        the supervisor wires CachingUriResolver so a verified URI costs one
+        stat per sweep, not a full re-hash of the checkpoint."""
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        calls = {"n": 0}
+        real = durability._sha256_file
+
+        def counting_hash(path, chunk=1 << 20):
+            calls["n"] += 1
+            return real(path, chunk)
+
+        monkeypatch.setattr(durability, "_sha256_file", counting_hash)
+        resolver = durability.CachingUriResolver()
+        uri = f"{d}/4"
+        assert resolver(uri) == uri
+        first = calls["n"]
+        assert first > 0
+        assert resolver(uri) == uri
+        assert calls["n"] == first  # cache hit: marker stat only
+        # marker identity change invalidates the cache entry
+        marker = os.path.join(d, "4", durability.MANIFEST_NAME)
+        os.utime(marker, ns=(1, 1))
+        assert resolver(uri) == uri
+        assert calls["n"] > first
+
+    def test_caching_resolver_sees_later_commits(self, tmp_path):
+        d = str(tmp_path)
+        resolver = durability.CachingUriResolver()
+        assert resolver(f"{d}/4") is None  # nothing committed yet
+        committed_steps(d, 4)
+        assert resolver(f"{d}/4") == f"{d}/4"  # the later commit is seen
+
+    def test_caching_resolver_caches_negative_until_directory_changes(
+        self, tmp_path, monkeypatch
+    ):
+        """A parked row whose directory never verifies must not pay a full
+        re-hash of every step on every sweep — the negative verdict is
+        cached against the directory fingerprint, and any commit (or
+        adoption/quarantine) invalidates it."""
+        d = str(tmp_path)
+        committed_steps(d, 4)
+        _flip_committed_leaf(os.path.join(d, "4"))
+        calls = {"n": 0}
+        real = durability._sha256_file
+
+        def counting_hash(path, chunk=1 << 20):
+            calls["n"] += 1
+            return real(path, chunk)
+
+        monkeypatch.setattr(durability, "_sha256_file", counting_hash)
+        resolver = durability.CachingUriResolver()
+        assert resolver(f"{d}/4") is None  # only step is corrupt
+        first = calls["n"]
+        assert first > 0
+        assert resolver(f"{d}/4") is None
+        assert calls["n"] == first  # negative cached: listdir + stats only
+        committed_steps(d, 6)  # the directory changed — must be re-scanned
+        assert resolver(f"{d}/4") == f"{d}/6"
+
+    def test_resolver_maps_bad_uri_to_previous_verified(self, tmp_path):
+        d = str(tmp_path)
+        committed_steps(d, 2, 4)
+        assert durability.resolve_verified_uri(f"{d}/4") == f"{d}/4"
+        _flip_committed_leaf(os.path.join(d, "4"))
+        assert durability.resolve_verified_uri(f"{d}/4") == f"{d}/2"
+        assert durability.resolve_verified_uri("not-a-step-uri") is None
+        assert durability.resolve_verified_uri(f"{tmp_path}/none/9") is None
+        # resolver never quarantines (the watchdog is read-only)
+        assert os.path.isdir(os.path.join(d, "4"))
+
+
+# -- fault-plan plumbing -------------------------------------------------------
+
+
+def test_maybe_inject_guards_vacuous_checkpoint_drills():
+    plan = FaultPlan(mode="ckpt-bitflip", step=3)
+    # loop without a checkpointer: the drill would inject nothing — raise
+    with pytest.raises(ValueError, match="no checkpointer"):
+        maybe_inject(plan, 3)
+    # checkpointer wired: the hook owns the fault, the loop stays silent
+    maybe_inject(plan, 3, checkpoint_faults_handled=True)
+    # off-step: silent either way
+    maybe_inject(plan, 2)
+
+
+def test_checkpoint_fault_hook_only_for_checkpoint_modes():
+    assert checkpoint_fault_hook(FaultPlan(mode=None, step=0)) is None
+    assert checkpoint_fault_hook(FaultPlan(mode="hbm-oom", step=0)) is None
+    assert checkpoint_fault_hook(FaultPlan(mode="ckpt-bitflip", step=2)) is not None
+
+
+def test_vacuous_checkpoint_drill_fails_loudly(tmp_path, monkeypatch):
+    """A checkpoint fault whose NEXUS_FAULT_STEP is never a commit boundary
+    fires nothing — the run must raise, not exit 0 looking like a passed
+    drill (the checkpointer being wired silences maybe_inject, so the
+    harness itself has to check the hook actually fired)."""
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "ckpt-bitflip")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "3")  # boundaries are 2, 4, 6
+    with pytest.raises(RuntimeError, match="injected nothing"):
+        run_workload(
+            mnist_cfg(checkpoint_dir=str(tmp_path)), store=seeded_store(),
+            ctx=CTX, lifecycle=LifecycleContext(),
+        )
+
+
+# -- harness: publish-after-durability -----------------------------------------
+
+
+def test_commit_failure_never_reaches_ledger(tmp_path, monkeypatch):
+    """ISSUE 5 satellite (harness publish-before-durability regression): an
+    injected failed async save must never reach the ledger — the pointer
+    stays on the last step whose barrier completed."""
+    original = TensorCheckpointer.commit
+
+    def failing_commit(self, step):
+        if step == 4:
+            raise RuntimeError("injected async save failure at the barrier")
+        return original(self, step)
+
+    monkeypatch.setattr(TensorCheckpointer, "commit", failing_commit)
+    store = seeded_store()
+    with pytest.raises(RuntimeError, match="injected async save failure"):
+        run_workload(
+            mnist_cfg(checkpoint_dir=str(tmp_path)), store=store, ctx=CTX,
+            lifecycle=LifecycleContext(),
+        )
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.tensor_checkpoint_uri == f"{tmp_path}/2"
+    assert row.lifecycle_stage == LifecycleStage.RUNNING  # crash: supervisor's call
+    # the torn step is on disk but a fresh restore rolls back to 2
+    tc = TensorCheckpointer(str(tmp_path))
+    assert tc.latest_verified_step() == 2
+    tc.close()
+
+
+def _cancelling_data(lc, at, batch=8, seed=0):
+    """The mnist stream, cancelling the lifecycle while producing batch
+    ``at`` — an in-process preemption without real signals."""
+    src = get_adapter("mnist").data(batch, 16, seed=seed)
+    i = 0
+    while True:
+        if i == at:
+            lc.cancel("SIGTERM")
+        yield next(src)
+        i += 1
+
+
+def test_emergency_save_on_cancellation(tmp_path):
+    """Preemption converts to a saved step: the loop stops, the emergency
+    checkpoint commits inside the grace budget, and the row lands PREEMPTED
+    with the saved step in the details."""
+    d = str(tmp_path)
+    store = seeded_store()
+    lc = LifecycleContext()
+    rec = RecordingMetrics()
+    result = run_workload(
+        # checkpoint_every=50: no periodic boundary fires — the emergency
+        # save is the ONLY checkpoint this run cuts
+        mnist_cfg(steps=10, checkpoint_every=50, checkpoint_dir=d),
+        store=store, ctx=CTX, data=_cancelling_data(lc, 3), lifecycle=lc,
+        telemetry=rec,
+    )
+    assert result["preempted"] is True
+    step = result["emergency_step"]
+    assert step == result["final_step"] and 0 < step < 10
+    assert result["emergency_skipped"] is False
+    # the emergency save beats the grace deadline
+    assert result["emergency_save_s"] <= result["grace_s"]
+    assert rec.counters["train.emergency_save"] == 1
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert row.algorithm_failure_cause == "signal:SIGTERM"
+    details = json.loads(row.algorithm_failure_details)
+    assert details["emergency_step"] == step and details["reason"] == "SIGTERM"
+    # the published pointer is the emergency step, and it verifies
+    assert row.tensor_checkpoint_uri == f"{d}/{step}"
+    tc = TensorCheckpointer(d)
+    assert tc.latest_verified_step() == step
+    tc.close()
+
+    # the restart path resumes from the preemption point, not step 0
+    resumed = run_workload(
+        mnist_cfg(steps=10, checkpoint_every=50, checkpoint_dir=d),
+        store=store, ctx=CTX, lifecycle=LifecycleContext(),
+    )
+    assert resumed["resumed_from"] == step and resumed["final_step"] == 10
+    assert store.read_checkpoint(ALGORITHM, CTX.run_id).lifecycle_stage == (
+        LifecycleStage.COMPLETED
+    )
+
+
+def test_emergency_save_skips_duplicate_of_committed_step(tmp_path):
+    """A cancellation observed right after a boundary commit must not
+    double-save the same step — the durable copy already exists."""
+    store = seeded_store()
+    lc = LifecycleContext()
+    result = run_workload(
+        # cancel while producing the batch for the LAST step: the boundary
+        # commit for that step completes, then the loop drains
+        mnist_cfg(steps=2, checkpoint_every=2, checkpoint_dir=str(tmp_path)),
+        store=store, ctx=CTX, data=_cancelling_data(lc, 1), lifecycle=lc,
+        telemetry=RecordingMetrics(),
+    )
+    assert result["preempted"] is True
+    assert result["emergency_skipped"] is True and result["emergency_step"] == 2
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert json.loads(row.algorithm_failure_details)["emergency_skipped"] is True
+
+
+def test_bitflip_rollback_records_cause_everywhere(tmp_path, monkeypatch):
+    """Silent corruption of the newest committed step: the next run rolls
+    back exactly one step, quarantines the bad directory, and the cause
+    lands in the summary, the metrics, and the ledger details."""
+    d = str(tmp_path)
+    store = seeded_store()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "ckpt-bitflip")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "4")
+    run_workload(
+        mnist_cfg(steps=4, checkpoint_dir=d), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    monkeypatch.delenv("NEXUS_FAULT_MODE")
+    monkeypatch.delenv("NEXUS_FAULT_STEP")
+    # a restarted run arrives PREEMPTED (non-terminal), not COMPLETED — the
+    # IsFinished guard would rightly drop writes from a finished run's ghost
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id).deep_copy()
+    row.lifecycle_stage = LifecycleStage.PREEMPTED
+    store.upsert_checkpoint(row)
+    rec = RecordingMetrics()
+    result = run_workload(
+        mnist_cfg(steps=8, checkpoint_dir=d), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(), telemetry=rec,
+    )
+    assert result["resumed_from"] == 2  # rolled back exactly one step
+    assert result["final_step"] == 8
+    assert [e["cause"] for e in result["ckpt_rollbacks"]] == ["corrupt"]
+    assert rec.tagged_counts[("train.ckpt_rollback", ("cause:corrupt",))] == 1
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.COMPLETED
+    rollback = json.loads(row.algorithm_failure_details)["ckpt_rollback"]
+    assert rollback[0]["step"] == 4 and rollback[0]["cause"] == "corrupt"
+    assert any(n.startswith("4" + durability.QUARANTINE_SUFFIX) for n in os.listdir(d))
+    # the rerun re-committed steps 4..8; the final pointer verifies
+    assert row.tensor_checkpoint_uri == f"{d}/8"
+    tc = TensorCheckpointer(d)
+    assert tc.latest_verified_step() == 8
+    tc.close()
+
+
+def test_preemption_details_keep_rollback_evidence(tmp_path, monkeypatch):
+    """preempted() rewrites the details column wholesale — a run that rolled
+    back at restore time and is then preempted must keep BOTH stories: the
+    emergency-save record AND the ckpt_rollback evidence RUNBOOK §11 tells
+    operators to look for."""
+    d = str(tmp_path)
+    store = seeded_store()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "ckpt-bitflip")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "4")
+    run_workload(
+        mnist_cfg(steps=4, checkpoint_dir=d), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    monkeypatch.delenv("NEXUS_FAULT_MODE")
+    monkeypatch.delenv("NEXUS_FAULT_STEP")
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id).deep_copy()
+    row.lifecycle_stage = LifecycleStage.PREEMPTED
+    store.upsert_checkpoint(row)
+    lc = LifecycleContext()
+    result = run_workload(
+        mnist_cfg(steps=8, checkpoint_dir=d), store=store, ctx=CTX,
+        data=_cancelling_data(lc, 3), lifecycle=lc, telemetry=RecordingMetrics(),
+    )
+    assert result["preempted"] is True and result["resumed_from"] == 2
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+    details = json.loads(row.algorithm_failure_details)
+    assert details["emergency_step"] == result["final_step"]
+    assert details["ckpt_rollback"][0]["step"] == 4
+    assert details["ckpt_rollback"][0]["cause"] == "corrupt"
+
+
+# -- subprocess drills: real crashes, real signals -----------------------------
+
+# Shared phase-A entrypoint: the production run_workload path in a
+# subprocess, because these drills kill the process (os._exit / SIGTERM).
+_DRILL_SCRIPT = """
+import sys
+from tpu_nexus.parallel.smap import force_virtual_cpu_devices
+force_virtual_cpu_devices(8)
+from tpu_nexus.checkpoint.store import SqliteCheckpointStore
+from tpu_nexus.models.registry import get_adapter
+from tpu_nexus.parallel import MeshSpec
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+
+ledger, ckpt_dir, rid, algo, steps = sys.argv[1:6]
+run_workload(
+    WorkloadConfig(
+        model=get_adapter("mnist"), mesh=MeshSpec(fsdp=-1), batch_size=8,
+        seq_len=16, steps=int(steps), heartbeat_every=2, checkpoint_every=2,
+        checkpoint_dir=ckpt_dir,
+    ),
+    store=SqliteCheckpointStore(ledger),
+    ctx=ProcessContext(run_id=rid, algorithm=algo, process_id=0,
+                       num_processes=1, coordinator=None),
+)
+"""
+
+
+def _run_drill(tmp_path, rid, steps, fault_mode, fault_step, timeout=240):
+    env = dict(
+        os.environ, NEXUS_FAULT_MODE=fault_mode, NEXUS_FAULT_STEP=str(fault_step)
+    )
+    return subprocess.run(
+        [
+            sys.executable, "-c", _DRILL_SCRIPT,
+            str(tmp_path / "ledger.db"), str(tmp_path / "ckpt"), rid, ALGORITHM,
+            str(steps),
+        ],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+
+
+def _fresh_run(store, ckpt_dir, rid, steps=6):
+    return run_workload(
+        mnist_cfg(steps=steps, checkpoint_dir=str(ckpt_dir)),
+        store=store,
+        ctx=ProcessContext(run_id=rid, algorithm=ALGORITHM, process_id=0,
+                           num_processes=1, coordinator=None),
+        lifecycle=LifecycleContext(),
+    )
+
+
+def test_crash_mid_save_restart_resumes_bit_identical(tmp_path):
+    """The flagship torn-save drill: die between the manifest temp write and
+    the commit marker at the step-4 boundary, restart, and land a final loss
+    bit-identical to a run that was never interrupted."""
+    # uninterrupted baseline (same seeds, fresh directory)
+    base_rid = str(uuid.uuid4())
+    baseline = _fresh_run(seeded_store(rid=base_rid), tmp_path / "baseline-ckpt", base_rid)
+
+    rid = str(uuid.uuid4())
+    store = SqliteCheckpointStore(str(tmp_path / "ledger.db"))
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    proc = _run_drill(tmp_path, rid, steps=6, fault_mode="ckpt-crash-mid-save", fault_step=4)
+    assert proc.returncode == 1, (proc.returncode, proc.stderr[-2000:])
+
+    ckpt_dir = tmp_path / "ckpt"
+    row = store.read_checkpoint(ALGORITHM, rid)
+    # the ledger never saw the torn step-4 URI: publish is behind the barrier
+    assert row.tensor_checkpoint_uri == f"{ckpt_dir}/2"
+    # the torn directory exists (payload written, marker absent)
+    assert os.path.isdir(ckpt_dir / "4")
+    with pytest.raises(CheckpointUncommitted):
+        durability.verify_step(str(ckpt_dir / "4"), 4)
+
+    # restart: resume from the last GOOD step, quarantine the torn one
+    result = _fresh_run(store, ckpt_dir, rid)
+    assert result["resumed_from"] == 2 and result["final_step"] == 6
+    assert [e["cause"] for e in result["ckpt_rollbacks"]] == ["uncommitted"]
+    assert result["loss"] == baseline["loss"], (result["loss"], baseline["loss"])
+    assert os.path.isdir(str(ckpt_dir / "4") + durability.QUARANTINE_SUFFIX)
+    row = store.read_checkpoint(ALGORITHM, rid)
+    assert row.lifecycle_stage == LifecycleStage.COMPLETED
+    assert row.tensor_checkpoint_uri == f"{ckpt_dir}/6"
+    assert "ckpt_rollback" in row.algorithm_failure_details
+    store.close()
+
+
+def test_preempt_sigterm_during_save_window(tmp_path):
+    """Graceful preemption landing INSIDE a save window: the handler catches
+    the signal, the in-flight commit completes, the emergency path detects
+    the already-durable same-step save and skips the duplicate, and the run
+    exits PREEMPTED with the saved step in the ledger details."""
+    rid = str(uuid.uuid4())
+    store = SqliteCheckpointStore(str(tmp_path / "ledger.db"))
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    proc = _run_drill(tmp_path, rid, steps=8, fault_mode="preempt-sigterm", fault_step=4)
+    # the drain protocol catches the SIGTERM: clean exit, not a signal death
+    assert proc.returncode == 0, (proc.returncode, proc.stderr[-2000:])
+    row = store.read_checkpoint(ALGORITHM, rid)
+    assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+    assert row.algorithm_failure_cause == "signal:SIGTERM"
+    details = json.loads(row.algorithm_failure_details)
+    assert details["emergency_step"] == 4 and details["emergency_skipped"] is True
+    assert row.tensor_checkpoint_uri == f"{tmp_path / 'ckpt'}/4"
+    tc = TensorCheckpointer(str(tmp_path / "ckpt"))
+    assert tc.latest_verified_step() == 4
+    tc.close()
+    store.close()
+
+
+# -- slow tier: the full chaos matrix ------------------------------------------
+
+
+@pytest.mark.slow
+def test_crash_at_every_checkpoint_boundary(tmp_path):
+    """The acceptance drill: ckpt-crash-mid-save at EVERY checkpoint
+    boundary of a short run, then restart — always resumes from the last
+    committed step, final loss bit-identical to the uninterrupted baseline,
+    ledger URI always verifiable."""
+    base_rid = str(uuid.uuid4())
+    baseline = _fresh_run(seeded_store(rid=base_rid), tmp_path / "baseline-ckpt", base_rid)
+
+    for boundary in (2, 4, 6):
+        sub = tmp_path / f"boundary-{boundary}"
+        sub.mkdir()
+        rid = str(uuid.uuid4())
+        store = SqliteCheckpointStore(str(sub / "ledger.db"))
+        store.upsert_checkpoint(
+            CheckpointedRequest(algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+        )
+        proc = _run_drill(sub, rid, steps=6, fault_mode="ckpt-crash-mid-save", fault_step=boundary)
+        assert proc.returncode == 1, (boundary, proc.stderr[-2000:])
+        row = store.read_checkpoint(ALGORITHM, rid)
+        expected_resume = boundary - 2
+        if expected_resume:
+            assert row.tensor_checkpoint_uri == f"{sub / 'ckpt'}/{expected_resume}"
+            assert durability.resolve_verified_uri(row.tensor_checkpoint_uri) == (
+                row.tensor_checkpoint_uri
+            )
+        else:
+            assert not row.tensor_checkpoint_uri  # died before the first commit
+        result = _fresh_run(store, sub / "ckpt", rid)
+        assert result["resumed_from"] == (expected_resume or None), boundary
+        assert result["final_step"] == 6
+        assert result["loss"] == baseline["loss"], boundary
+        row = store.read_checkpoint(ALGORITHM, rid)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED
+        assert durability.resolve_verified_uri(row.tensor_checkpoint_uri) == (
+            row.tensor_checkpoint_uri
+        )
+        store.close()
+
+
+@pytest.mark.slow
+def test_corruption_fuzz_seed_matrix(tmp_path):
+    """≥100-seed fuzz over the verify/rollback machinery: random step
+    series, random corruption of a random step, and the invariant that
+    newest_verified_step always lands the newest step that still proves
+    itself — never a corrupted one, never an older one than necessary."""
+    import random
+
+    ops = ("none", "bitflip", "remove-marker", "truncate", "delete-file", "delete-dir")
+    for seed in range(100):
+        rng = random.Random(seed)
+        d = str(tmp_path / f"s{seed}")
+        steps = sorted(rng.sample(range(1, 20), rng.randint(1, 3)))
+        tc = TensorCheckpointer(d, max_to_keep=10)
+        for s in steps:
+            tc.save(s, tiny_state(s, scale=rng.random() + 0.5))
+            tc.commit(s)
+        tc.close()
+        victim = rng.choice(steps)
+        op = rng.choice(ops)
+        step_dir = os.path.join(d, str(victim))
+        if op == "bitflip":
+            _flip_committed_leaf(step_dir)
+        elif op == "remove-marker":
+            os.remove(os.path.join(step_dir, durability.MANIFEST_NAME))
+        elif op == "truncate":
+            target = os.path.join(step_dir, durability.manifest_files(step_dir)[0])
+            with open(target, "r+b") as fh:
+                fh.truncate(max(os.path.getsize(target) - 1, 0))
+        elif op == "delete-file":
+            os.remove(os.path.join(step_dir, durability.manifest_files(step_dir)[-1]))
+        elif op == "delete-dir":
+            import shutil
+
+            shutil.rmtree(step_dir)
+        expected = [s for s in steps if op == "none" or s != victim]
+        found, rollbacks = durability.newest_verified_step(d, quarantine=bool(seed % 2))
+        assert found == (max(expected) if expected else None), (seed, op, victim, steps)
+        if op in ("bitflip", "remove-marker", "truncate", "delete-file") and victim > (
+            found or -1
+        ):
+            assert rollbacks and rollbacks[0]["step"] == victim, (seed, op)
+        if found is not None:
+            fresh = TensorCheckpointer(d, max_to_keep=10)
+            restored = fresh.restore(tiny_state(0))
+            assert int(restored["step"]) == found, (seed, op)
+            fresh.close()

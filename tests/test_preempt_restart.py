@@ -192,3 +192,80 @@ async def test_preempt_restart_resume_loop(tmp_path):
     # heartbeats continuous across the restart: every chip advanced from the
     # preemption-time step 4 to the final step
     assert cp.per_chip_steps == {f"host0/chip{i}": STEPS for i in range(8)}
+
+
+def test_supervisor_wires_checkpoint_resolver_into_watchdog():
+    """The repoint feature must exist in PRODUCTION, not only when a test
+    injects the resolver: Supervisor.init wires a CachingUriResolver (the
+    sweep re-checks every PREEMPTED row every interval — the bare function
+    would re-hash the full checkpoint each time) and the
+    watchdog_verify_checkpoints knob turns it off."""
+    from tpu_nexus.workload import durability
+
+    def build(**over):
+        sup = Supervisor(FakeKubeClient([]), SqliteCheckpointStore(":memory:"), NS)
+        sup.init(
+            ProcessingConfig(preempted_restart_deadline=timedelta(minutes=5), **over)
+        )
+        return sup
+
+    assert isinstance(
+        build().watchdog._resolve_verified_uri, durability.CachingUriResolver
+    )
+    assert build(watchdog_verify_checkpoints=False).watchdog._resolve_verified_uri is None
+
+
+async def test_watchdog_repoints_unverifiable_checkpoint_uri(tmp_path):
+    """ISSUE 5 satellite — the restart path's checkpoint side: a PREEMPTED
+    row whose published ``tensor_checkpoint_uri`` fails manifest verification
+    is restart-from-PREVIOUS-step material, not a crash loop.  The watchdog
+    sweep repoints the ledger at the newest verified step (without touching
+    the restart fingerprint, so the rewrite never re-arms the restart
+    deadline), and the genuine restart-stalled escalation still fires."""
+    import os
+
+    import jax.numpy as jnp
+
+    from tpu_nexus.supervisor.taxonomy import DecisionAction
+    from tpu_nexus.supervisor.watchdog import HeartbeatWatchdog
+    from tpu_nexus.workload import durability
+    from tpu_nexus.workload.faults import _flip_committed_leaf
+
+    d = str(tmp_path / "ckpt")
+    tc = TensorCheckpointer(d)
+    for step in (2, 4):
+        tc.save(step, {"params": {"w": jnp.arange(4.0) * step}, "step": jnp.int32(step)})
+        tc.commit(step)
+    tc.close()
+    _flip_committed_leaf(os.path.join(d, "4"))  # silent rot on the published step
+
+    store = SqliteCheckpointStore(str(tmp_path / "ledger.db"))
+    rid = str(uuid.uuid4())
+    store.upsert_checkpoint(
+        CheckpointedRequest(
+            algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.PREEMPTED,
+            restart_count=1, tensor_checkpoint_uri=f"{d}/4",
+        )
+    )
+    flagged = []
+    dog = HeartbeatWatchdog(
+        store, flagged.append, restart_deadline=timedelta(seconds=1000),
+        resolve_verified_uri=durability.resolve_verified_uri,
+    )
+    await dog.sweep(now=0.0)
+    cp = store.read_checkpoint(ALGORITHM, rid)
+    # repointed at the newest VERIFIED step; no escalation fired
+    assert cp.tensor_checkpoint_uri == f"{d}/2"
+    assert dog.ckpt_rollbacks == 1 and flagged == []
+    # restart fingerprint untouched by the rewrite
+    assert cp.lifecycle_stage == LifecycleStage.PREEMPTED and cp.restart_count == 1
+    # idempotent: the verified pointer is left alone on the next sweep
+    await dog.sweep(now=1.0)
+    assert dog.ckpt_rollbacks == 1
+    # the rewrite is not an escalation amnesty: a genuinely stalled restart
+    # still escalates once the deadline passes
+    await dog.sweep(now=2000.0)
+    assert [r.action for r in flagged] == [DecisionAction.TO_FAIL_RESTART_STALLED]
+    # quarantine is the workload's job — the watchdog reads, never renames
+    assert sorted(n for n in os.listdir(d) if n.isdigit()) == ["2", "4"]
+    store.close()

@@ -137,7 +137,7 @@ async def test_stray_writes_do_not_rearm_the_deadline():
     # stray non-restart writes, spread across the deadline window
     store.merge_chip_steps(ALGORITHM, rid, {"host0/chip0": 101})
     await wd.sweep(now=20.0)
-    store.update_fields(
+    store.update_fields(  # nxlint: disable=NX007 simulated stray write from a dying generation
         ALGORITHM, rid,
         {"tensor_checkpoint_uri": "gs://ckpt/late-flush", "last_modified": "t+40"},
     )

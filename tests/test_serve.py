@@ -77,6 +77,25 @@ class TestServe:
                 ServeConfig(model=MnistConfig()), store=_seeded_store(), ctx=CTX
             )
 
+    def test_unverifiable_checkpoint_dir_refused(self, tmp_path):
+        """Steps present but NONE verifiable (e.g. pre-durability
+        checkpoints never adopted, or a fully rotten directory) must fail
+        loudly — silently serving the freshly-initialized weights would
+        look healthy while generating garbage."""
+        from tpu_nexus.workload.tensor_checkpoint import CheckpointError
+
+        step_dir = tmp_path / "4"
+        step_dir.mkdir()
+        (step_dir / "leaf.bin").write_bytes(b"pre-durability payload")
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=3, rounds=1, checkpoint_dir=str(tmp_path),
+        )
+        with pytest.raises(CheckpointError, match="none verify"):
+            run_serving(cfg, store=_seeded_store(), ctx=CTX)
+        # read-only restore: the bad step is refused, never quarantined
+        assert (tmp_path / "4").is_dir()
+
     def test_sampled_decode(self):
         store = _seeded_store()
         cfg = ServeConfig(

@@ -928,3 +928,192 @@ def test_nx006_tuple_with_classified_and_broad_flagged():
         continue_serving()
     """
     assert _lint_nx006(src_ok) == []
+
+
+# -- NX007 checkpoint publish durability ----------------------------------------
+
+
+def test_nx007_publish_after_bare_save_flagged():
+    """The original harness.py bug: URI published right after save() — the
+    Orbax save may still be in flight when the ledger write lands."""
+    src = """
+    def loop(ckpt, reporter, step, state):
+        uri = ckpt.save(step, state)
+        reporter.tensor_checkpoint(uri, step)
+    """
+    findings = lint_source(src, "NX007")
+    assert len(findings) == 1 and "durability barrier" in findings[0].message
+
+
+def test_nx007_commit_before_publish_passes():
+    src = """
+    def loop(ckpt, reporter, step, state):
+        ckpt.save(step, state)
+        uri = ckpt.commit(step)
+        reporter.tensor_checkpoint(uri, step)
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_verified_step_resolution_is_a_barrier():
+    src = """
+    def resume(ckpt, reporter):
+        latest = ckpt.latest_verified_step()
+        reporter.checkpoint_rollback(ckpt.uri_for(latest), latest, ckpt.rollbacks)
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_direct_column_write_flagged():
+    """Bypassing the sanctioned publishers does not bypass the rule: any
+    dict literal carrying the tensor_checkpoint_uri key is a publish."""
+    src = """
+    def sneak(store, uri):
+        store.update_fields("algo", "run", {"tensor_checkpoint_uri": uri})
+    """
+    findings = lint_source(src, "NX007")
+    assert len(findings) == 1 and "tensor_checkpoint_uri" in findings[0].message
+
+
+def test_nx007_barrier_in_other_scope_does_not_count():
+    src = """
+    def elsewhere(ckpt):
+        ckpt.commit(2)
+
+    def loop(ckpt, reporter):
+        reporter.tensor_checkpoint("uri", 2)
+    """
+    assert len(lint_source(src, "NX007")) == 1
+
+
+def test_nx007_barrier_in_nested_def_does_not_count():
+    """A commit tucked inside a nested function that may never run proves
+    nothing about the publishing scope (same discipline as NX006)."""
+    src = """
+    def loop(ckpt, reporter):
+        def later():
+            ckpt.commit(2)
+        reporter.tensor_checkpoint("uri", 2)
+    """
+    assert len(lint_source(src, "NX007")) == 1
+
+
+def test_nx007_publisher_definitions_exempt():
+    """The LedgerReporter sink methods write the column by construction;
+    the barrier obligation sits with every caller."""
+    src = """
+    class LedgerReporter:
+        def tensor_checkpoint(self, uri, step):
+            self._guarded_update({"tensor_checkpoint_uri": uri})
+            self.heartbeat(step)
+
+        def checkpoint_rollback(self, uri, step, events):
+            self._guarded_update({"tensor_checkpoint_uri": uri})
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_barrier_passed_as_reference_counts():
+    """The watchdog hands its resolver to asyncio.to_thread — a barrier
+    REFERENCE preceding the write is proof enough for this rule."""
+    src = """
+    async def repoint(self, cp):
+        resolved = await asyncio.to_thread(self._resolve_verified_uri, cp.uri)
+        self._store.update_fields(cp.algorithm, cp.id, {"tensor_checkpoint_uri": resolved})
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_wait_is_not_a_barrier():
+    """Draining the async orbax write (wait/wait_until_finished) commits no
+    manifest — save(); wait(); publish() is exactly the torn-URI bug class
+    the rule exists for, and a generic ``event.wait()`` earlier in the
+    scope must not silence it either."""
+    src = """
+    def loop(ckpt, reporter, step, state, event):
+        event.wait()
+        uri = ckpt.save(step, state)
+        ckpt.wait()
+        ckpt._mngr.wait_until_finished()
+        reporter.tensor_checkpoint(uri, step)
+    """
+    assert len(lint_source(src, "NX007")) == 1
+
+
+def test_nx007_barrier_after_publish_flagged():
+    """Lexical precedence means PRECEDENCE: a wait after the ledger write
+    does not un-publish the torn URI."""
+    src = """
+    def loop(ckpt, reporter, step, state):
+        uri = ckpt.save(step, state)
+        reporter.tensor_checkpoint(uri, step)
+        ckpt.commit(step)
+    """
+    assert len(lint_source(src, "NX007")) == 1
+
+
+def test_nx007_barrier_on_the_publish_line_counts():
+    """The barrier IS the argument — maximally safe, must not be a false
+    positive (auto-formatters join these lines)."""
+    src = """
+    def loop(ckpt, reporter, step, state):
+        ckpt.save(step, state)
+        reporter.tensor_checkpoint(ckpt.commit(step), step)
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_multiline_barrier_argument_counts():
+    """Same barrier-as-argument pattern after a formatter wraps the call:
+    the barrier's line is past the call header, but still inside the call's
+    own span — must not be a false positive."""
+    src = """
+    def loop(ckpt, reporter, step, state):
+        ckpt.save(step, state)
+        reporter.tensor_checkpoint(
+            ckpt.commit(step),
+            step,
+        )
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_suppressible_per_line():
+    src = """
+    def loop(reporter):
+        reporter.tensor_checkpoint("uri", 2)  # nxlint: disable=NX007
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_publish_inside_lambda_flagged():
+    """Fail-closed must reach lambda bodies: a publish deferred through a
+    callback is still a publish, and a barrier in the ENCLOSING scope
+    proves nothing about when the lambda eventually runs."""
+    src = """
+    def loop(ckpt, reporter, step, state):
+        uri = ckpt.save(step, state)
+        cb = lambda: reporter.tensor_checkpoint(uri, step)
+        return cb
+    """
+    findings = lint_source(src, "NX007")
+    assert len(findings) == 1 and "durability barrier" in findings[0].message
+
+
+def test_nx007_lambda_with_inline_barrier_passes():
+    src = """
+    def loop(ckpt, reporter, step):
+        cb = lambda: reporter.tensor_checkpoint(ckpt.commit(step), step)
+        return cb
+    """
+    assert lint_source(src, "NX007") == []
+
+
+def test_nx007_class_body_publish_flagged():
+    """Class bodies execute at definition time — same frame rules apply."""
+    src = """
+    class Eager:
+        reporter.tensor_checkpoint(uri, 2)
+    """
+    findings = lint_source(src, "NX007")
+    assert len(findings) == 1 and "durability barrier" in findings[0].message

@@ -27,18 +27,35 @@ EXCLUDE = [
     "tpu_nexus/workload/rehearsal.py",  # runs as jax.distributed subprocesses
 ]
 
+# modules the report must CONTAIN: per-file thresholds only bite on files
+# the report knows about, so a module dropped from collection (renamed,
+# mis-globbed --cov target) would silently stop being gated.  Safety-
+# critical modules are pinned here; absence fails the gate.
+REQUIRED = [
+    "tpu_nexus/workload/durability.py",         # checkpoint commit/verify layer
+    "tpu_nexus/workload/tensor_checkpoint.py",
+    "tpu_nexus/serving/recovery.py",
+    "tpu_nexus/supervisor/taxonomy.py",
+]
+
 
 def main(path: str) -> int:
     with open(path, "r", encoding="utf-8") as fh:
         report = json.load(fh)
     failed = []
+    seen = set()
     for fname, data in sorted(report["files"].items()):
         norm = fname.replace("\\", "/")
+        seen.add(norm)
         if any(fnmatch.fnmatch(norm, pat) for pat in EXCLUDE):
             continue
         pct = data["summary"]["percent_covered"]
         if pct < FILE_THRESHOLD:
             failed.append((norm, pct))
+    for required in REQUIRED:
+        if not any(norm.endswith(required) for norm in seen):
+            print(f"FAIL: required module {required} absent from the coverage report")
+            failed.append((f"{required} (missing from report)", 0.0))
     total = report["totals"]["percent_covered"]
     print(f"total coverage: {total:.1f}% (threshold {TOTAL_THRESHOLD}%)")
     if total < TOTAL_THRESHOLD:

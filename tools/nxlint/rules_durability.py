@@ -1,0 +1,200 @@
+"""Checkpoint-durability rules: the publish-after-durability invariant the
+restart-from-step contract depends on.
+
+NX007  tensor-checkpoint publish discipline: any code that writes
+       ``tensor_checkpoint_uri`` to the ledger must be lexically preceded,
+       in the same function scope, by a durability barrier on the
+       checkpointer (``commit()`` / ``verify()`` / a verified-step
+       resolution).  The bug class: ``harness.py`` used to publish the URI
+       right after ``ckpt.save()`` — Orbax saves may be async, so a
+       preemption mid-save stranded the watchdog's restart on a torn step
+       the ledger swore was there.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, List, Optional, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Rule, register
+
+#: ledger-publisher calls (method name, last attribute segment).  These are
+#: the ONLY sanctioned ways to write tensor_checkpoint_uri; their own
+#: definitions (on LedgerReporter) are the sinks and are exempted below —
+#: the barrier obligation sits with every CALLER.
+_PUBLISHER_CALLS = frozenset({"tensor_checkpoint", "checkpoint_rollback"})
+
+#: function definitions that ARE the publisher (LedgerReporter methods):
+#: their bodies write the column by construction; flagging them would force
+#: a vacuous barrier inside the sink
+_PUBLISHER_DEFS = frozenset(_PUBLISHER_CALLS)
+
+#: names that prove a durability barrier ran: TensorCheckpointer.commit /
+#: verify, the verified-step resolutions (latest_verified_step,
+#: durability.verify_step / newest_verified_step), and the watchdog's
+#: injected resolver (referenced through asyncio.to_thread, so bare
+#: references count, not just calls).  ``wait``/``wait_until_finished``
+#: are deliberately ABSENT: draining the async orbax write commits no
+#: manifest — ``save(); wait(); publish()`` is exactly the torn-URI bug
+#: class this rule exists to stop (and the names are too generic anyway:
+#: an unrelated ``event.wait()`` must not silence the rule)
+_BARRIER_NAMES = frozenset(
+    {
+        "commit",
+        "verify",
+        "verify_step",
+        "latest_verified_step",
+        "newest_verified_step",
+        "resolve_verified_uri",
+        "_resolve_verified_uri",
+    }
+)
+
+#: the ledger column the rule guards
+_URI_KEY = "tensor_checkpoint_uri"
+
+
+def _last_segment(node: ast.AST) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def _writes_uri_key(call: ast.Call) -> bool:
+    """True when any argument of ``call`` contains a dict literal with the
+    ``tensor_checkpoint_uri`` key — a DIRECT column write
+    (``update_fields``/``_guarded_update``/``compare_and_set``/raw upsert)
+    bypassing the sanctioned publishers."""
+    for arg in (*call.args, *(kw.value for kw in call.keywords)):
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Dict):
+                for key in sub.keys:
+                    if isinstance(key, ast.Constant) and key.value == _URI_KEY:
+                        return True
+    return False
+
+
+def _scope_statements(scope: ast.AST) -> List[ast.AST]:
+    """Nodes executing in ``scope``'s own frame: nested function/class
+    bodies excluded (a barrier inside a nested def that may never run
+    proves nothing).  A ``Lambda`` scope's frame is its single body
+    expression."""
+    out: List[ast.AST] = []
+    body = scope.body if hasattr(scope, "body") else []
+    if not isinstance(body, list):  # Lambda.body is one expression node
+        body = [body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def _publishers_and_barriers(
+    scope: ast.AST,
+) -> Tuple[List[Tuple[ast.Call, str]], Set[int]]:
+    """(publisher calls with a label, line numbers where a barrier name is
+    referenced) within the scope's own frame."""
+    publishers: List[Tuple[ast.Call, str]] = []
+    barrier_lines: Set[int] = set()
+    for node in _scope_statements(scope):
+        if isinstance(node, ast.Call):
+            name = _last_segment(node.func)
+            if name in _PUBLISHER_CALLS:
+                publishers.append((node, f"{name}()"))
+            elif _writes_uri_key(node):
+                publishers.append((node, f"direct {_URI_KEY} write via {name or 'call'}()"))
+        # barrier: a call OR reference (asyncio.to_thread(self._resolver, ...)
+        # passes the barrier as an argument) to a barrier-named attribute
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            if _last_segment(node) in _BARRIER_NAMES:
+                barrier_lines.add(node.lineno)
+    return publishers, barrier_lines
+
+
+class _DurabilityVisitor(ast.NodeVisitor):
+    def __init__(self, rule: "CheckpointPublishBarrierRule", module: Module) -> None:
+        self.rule = rule
+        self.module = module
+        self.findings: List[Finding] = []
+
+    def _check_scope(self, scope: ast.AST, scope_name: Optional[str]) -> None:
+        publishers, barrier_lines = _publishers_and_barriers(scope)
+        if not publishers:
+            return
+        if scope_name in _PUBLISHER_DEFS:
+            return  # the sink itself; the obligation sits with its callers
+        for call, label in publishers:
+            # <= end_lineno: a barrier anywhere within the publish call's
+            # own span counts — the barrier IS the argument
+            # (reporter.tensor_checkpoint(ckpt.commit(step), step)), which
+            # is maximally safe, and a formatter may wrap that argument
+            # onto a line after the call's header
+            last_line = getattr(call, "end_lineno", None) or call.lineno
+            if not any(line <= last_line for line in barrier_lines):
+                self.findings.append(
+                    self.rule.finding(
+                        self.module,
+                        call,
+                        f"{label} publishes {_URI_KEY} with no preceding "
+                        "durability barrier in this scope — call "
+                        "TensorCheckpointer.commit()/verify()/"
+                        "latest_verified_step() first so the ledger never "
+                        "points at an uncommitted or corrupt step",
+                    )
+                )
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._check_scope(node, None)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # a lambda body cannot hold statements, but it CAN hold a publish —
+        # `cb = lambda: reporter.tensor_checkpoint(uri, step)` — and the
+        # fail-closed contract must see it.  The barrier search runs over
+        # the same single expression: only an inline barrier (e.g. the uri
+        # coming straight out of ckpt.commit(step)) passes.
+        self._check_scope(node, None)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        # class bodies execute at definition time — same frame rules apply
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+
+@register
+class CheckpointPublishBarrierRule(Rule):
+    """NX007: the ledger's ``tensor_checkpoint_uri`` may only be written
+    behind a durability barrier.  Fails closed: every call spelled like a
+    publisher (``.tensor_checkpoint(...)``, ``.checkpoint_rollback(...)``,
+    or any call passing a dict literal with the ``tensor_checkpoint_uri``
+    key) is flagged unless a barrier-named call/reference lexically precedes
+    it in the same function scope.  Lexical-precedence is deliberately
+    conservative static analysis — a barrier on a dead branch passes, but
+    the repo-clean gate plus the chaos drills (tests/test_checkpoint_chaos)
+    cover the dynamic side; this rule stops the honest mistake of
+    publishing right after ``save()``."""
+
+    rule_id = "NX007"
+    description = "tensor_checkpoint_uri writes need a preceding durability barrier"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        visitor = _DurabilityVisitor(self, module)
+        visitor.visit(module.tree)
+        yield from visitor.findings

@@ -68,3 +68,8 @@ class SupervisorConfig:
     #: time — the 5-minute capacity storm of BASELINE config #5 needs
     #: a deadline well past 5m)
     preempted_restart_deadline: timedelta = timedelta(minutes=15)
+    #: PREEMPTED sweep: verify each row's tensor_checkpoint_uri manifest and
+    #: repoint an unverifiable one at the newest verified step (no-op when
+    #: the checkpoint filesystem is unreachable from the supervisor; see
+    #: docs/CHECKPOINTS.md)
+    watchdog_verify_checkpoints: bool = True

@@ -157,6 +157,7 @@ class ApplicationServices:
             heartbeat_stale_after=config.heartbeat_stale_after,
             watchdog_interval=config.watchdog_interval,
             preempted_restart_deadline=config.preempted_restart_deadline,
+            watchdog_verify_checkpoints=config.watchdog_verify_checkpoints,
         )
         try:
             self._supervisor.init(processing)

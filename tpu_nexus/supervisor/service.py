@@ -100,6 +100,14 @@ class ProcessingConfig:
     #: fan-out (suppressed); outside it they count as a NEW preemption (the
     #: replacement pod reclaimed before the workload ever heartbeated)
     preemption_dedup_window: timedelta = timedelta(seconds=30)
+    #: TPU extension: the PREEMPTED sweep verifies each row's
+    #: ``tensor_checkpoint_uri`` manifest and repoints an unverifiable one
+    #: at the newest verified step (workload.durability, docs/CHECKPOINTS.md).
+    #: No-op when the supervisor cannot reach the checkpoint filesystem
+    #: (verification classifies as missing and leaves the row alone); turn
+    #: off only to skip the per-sweep checksum cost on reachable multi-GB
+    #: checkpoints.
+    watchdog_verify_checkpoints: bool = True
 
 
 class Supervisor:
@@ -194,6 +202,16 @@ class Supervisor:
         if stale is not None or deadline is not None:
             from tpu_nexus.supervisor.watchdog import HeartbeatWatchdog
 
+            resolver = None
+            if config.watchdog_verify_checkpoints:
+                # stdlib-only import (durability's contract; workload/__init__
+                # resolves its jax-heavy exports lazily so this stays cheap).
+                # Caching wrapper, not the bare function: the sweep re-checks
+                # every PREEMPTED row every interval, and an uncached deep
+                # verify re-hashes the full checkpoint each time
+                from tpu_nexus.workload.durability import CachingUriResolver
+
+                resolver = CachingUriResolver()
             self.watchdog = HeartbeatWatchdog(
                 self._store,
                 enqueue=self._fail_actor.receive,
@@ -204,6 +222,7 @@ class Supervisor:
                 kind_resolver=self._resolve_run_kind,
                 logger=self._log,
                 metrics=self._metrics,
+                resolve_verified_uri=resolver,
             )
 
     def _is_same_preemption(self, key: tuple) -> bool:

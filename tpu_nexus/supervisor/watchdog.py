@@ -24,6 +24,21 @@ event, but "nothing happened" never emits one:
   (services/supervisor.go:283-360) — and the restart axis must not regress
   that guarantee (VERDICT r4 Missing #1).
 
+The PREEMPTED sweep additionally polices the *checkpoint* side of the
+restart bet (ISSUE 5): a row whose ``tensor_checkpoint_uri`` fails manifest
+verification (torn save at preemption time, bit rot while parked) is
+restart-from-PREVIOUS-step material, not a crash loop — the sweep repoints
+the URI at the newest step that verifies (``resolve_verified_uri``,
+tpu_nexus.workload.durability) so the restarted workload and every operator
+dashboard see a pointer that is actually restorable.  The workload's own
+restore path would roll back anyway; the rewrite makes the ledger honest
+*before* the restart.  A verify is a full re-hash of the step, paid every
+sweep per parked row — production wiring passes
+``durability.CachingUriResolver`` so a verified URI costs one ``stat`` on
+subsequent sweeps.  The rewrite
+deliberately does NOT touch the restart fingerprint columns, so it never
+re-arms the restart deadline.
+
 Staleness is judged by *fingerprint change observed by this process*
 (monotonic clock), not by comparing wall-clock columns — workload hosts and
 the supervisor need not share a clock, and ``merge_chip_steps`` deliberately
@@ -40,7 +55,7 @@ from __future__ import annotations
 import asyncio
 import time
 from dataclasses import dataclass
-from datetime import timedelta
+from datetime import datetime, timedelta, timezone
 from typing import Callable, Dict, Optional, Tuple
 
 from tpu_nexus.checkpoint.models import LifecycleStage
@@ -77,6 +92,7 @@ class HeartbeatWatchdog:
         kind_resolver: Optional[Callable[[str], str]] = None,
         logger: Optional[VLogger] = None,
         metrics: Optional[Metrics] = None,
+        resolve_verified_uri: Optional[Callable[[str], Optional[str]]] = None,
     ) -> None:
         if stale_after is None and restart_deadline is None:
             raise ValueError(
@@ -109,8 +125,17 @@ class HeartbeatWatchdog:
         self._kind_resolver = kind_resolver or (lambda request_id: "Job")
         self._log = logger or get_logger("tpu_nexus.watchdog")
         self._metrics = metrics or NullMetrics()
+        #: checkpoint-pointer verifier for the PREEMPTED sweep: maps a
+        #: ``tensor_checkpoint_uri`` to the newest VERIFIED uri under the
+        #: same directory (``durability.resolve_verified_uri`` when the
+        #: supervisor can reach the checkpoint filesystem; None disables
+        #: the rewrite).  The supervisor wires ``durability.
+        #: CachingUriResolver`` — the bare function re-checksums the step
+        #: on every sweep.
+        self._resolve_verified_uri = resolve_verified_uri
         self._observations: Dict[Tuple[str, str], _Observation] = {}
         self.flagged = 0  # observability counter (tests + metrics)
+        self.ckpt_rollbacks = 0  # URIs repointed at a previous verified step
 
     @staticmethod
     def _fingerprint(cp) -> Tuple:
@@ -202,6 +227,7 @@ class HeartbeatWatchdog:
             for cp in rows:
                 key = (cp.algorithm, cp.id)
                 live_keys.add(key)
+                await self._repoint_unverifiable_checkpoint(cp)
                 obs = self._observe(key, self._restart_fingerprint(cp), now)
                 if obs is None:
                     continue
@@ -236,6 +262,55 @@ class HeartbeatWatchdog:
         for key in list(self._observations):
             if key not in live_keys:
                 del self._observations[key]
+
+    async def _repoint_unverifiable_checkpoint(self, cp) -> None:
+        """Restart path, checkpoint side: a PREEMPTED row whose published
+        ``tensor_checkpoint_uri`` fails manifest verification gets repointed
+        at the newest step that DOES verify (restart-from-previous-step),
+        instead of letting the restart land on a pointer we already know is
+        garbage.  When nothing verifies the pointer is left alone — the
+        restarted workload starts fresh and reports its own rollback.  The
+        restart fingerprint (stage/restart_count/generation) is untouched,
+        so the rewrite never re-arms the restart deadline."""
+        if self._resolve_verified_uri is None or not cp.tensor_checkpoint_uri:
+            return
+        resolved = await asyncio.to_thread(
+            self._resolve_verified_uri, cp.tensor_checkpoint_uri
+        )
+        if resolved is None or resolved == cp.tensor_checkpoint_uri:
+            return
+        # compare-and-set, not update_fields: the verify above can take
+        # seconds on a large checkpoint, and the restarted workload may have
+        # published a NEWER verified uri meanwhile — a blind write would
+        # roll the ledger backwards.  Expecting the snapshot's uri AND the
+        # PREEMPTED stage makes a lost race a silent no-op (the next sweep
+        # re-reads fresh state).
+        applied = await asyncio.to_thread(
+            self._store.compare_and_set,
+            cp.algorithm,
+            cp.id,
+            {
+                "tensor_checkpoint_uri": cp.tensor_checkpoint_uri,
+                "lifecycle_stage": LifecycleStage.PREEMPTED,
+            },
+            {
+                "tensor_checkpoint_uri": resolved,
+                "last_modified": datetime.now(timezone.utc),
+            },
+        )
+        if not applied:
+            return
+        self._log.info(
+            "preempted run's checkpoint uri failed verification; "
+            "repointed at previous verified step",
+            algorithm=cp.algorithm,
+            request_id=cp.id,
+            bad_uri=cp.tensor_checkpoint_uri,
+            verified_uri=resolved,
+        )
+        self._metrics.count("watchdog_ckpt_rollbacks")
+        self.ckpt_rollbacks += 1
+        cp.tensor_checkpoint_uri = resolved
 
     def _observe(self, key, fp: Tuple, now: float) -> Optional[_Observation]:
         """Record/update the fingerprint observation; returns None when the

@@ -11,15 +11,36 @@ that cooperates with the supervisor through the ledger:
   axis, SURVEY.md §7.4);
 * exposes fault-injection hooks so the failure taxonomy can be exercised
   end-to-end (BASELINE.json configs #3/#5).
+
+Exports resolve lazily (PEP 562): the supervisor imports
+``tpu_nexus.workload.durability`` (deliberately stdlib-only — its module
+docstring is the contract) for checkpoint-pointer verification, and an
+eager ``from .train import …`` here would make that import pay the full
+jax/orbax tax in a process that never trains.
 """
 
-from tpu_nexus.workload.train import TrainConfig, make_train_step, init_train_state
-from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from typing import TYPE_CHECKING
 
-__all__ = [
-    "TrainConfig",
-    "make_train_step",
-    "init_train_state",
-    "WorkloadConfig",
-    "run_workload",
-]
+_EXPORTS = {
+    "TrainConfig": "tpu_nexus.workload.train",
+    "make_train_step": "tpu_nexus.workload.train",
+    "init_train_state": "tpu_nexus.workload.train",
+    "WorkloadConfig": "tpu_nexus.workload.harness",
+    "run_workload": "tpu_nexus.workload.harness",
+}
+
+__all__ = list(_EXPORTS)
+
+if TYPE_CHECKING:  # pragma: no cover - static analysis only
+    from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+    from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+
+def __getattr__(name: str):
+    if name in _EXPORTS:
+        import importlib
+
+        value = getattr(importlib.import_module(_EXPORTS[name]), name)
+        globals()[name] = value  # cache: next access skips __getattr__
+        return value
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -51,6 +51,30 @@ mode             effect / expected engine behavior
 fault can target iteration N or the Nth admitted request.
 ``NEXUS_FAULT_TIMES`` repeats the fault (default 1; how ``step-ici``
 exercises retry-then-succeed vs retries-exhausted).
+
+Checkpoint-durability fault modes (ISSUE 5 chaos harness) inject inside the
+``TensorCheckpointer`` commit protocol (:func:`checkpoint_fault_hook` wired
+as its ``fault_hook``); for these, ``NEXUS_FAULT_STEP`` names the
+**checkpoint step being committed**, not a loop iteration:
+
+====================  =========================================================
+mode                  effect / expected recovery
+====================  =========================================================
+``ckpt-crash-mid-save``  ``os._exit(1)`` between the manifest temp write and
+                      the commit-marker rename — the torn-save window.  The
+                      restart must resume from the last *committed* step and
+                      quarantine the torn directory; the ledger never saw the
+                      torn URI (publish happens only after ``commit()``).
+``ckpt-bitflip``      flips one byte of a committed leaf AFTER the marker is
+                      published — silent media corruption.  The next restore
+                      detects the checksum mismatch, quarantines the step and
+                      rolls back exactly one step, cause recorded.
+``preempt-sigterm``   SIGTERM to self during the save window (pre-commit).
+                      The harness's signal handler catches it; the commit
+                      completes, the loop drains, and the emergency-save path
+                      skips the duplicate same-step save and exits PREEMPTED
+                      with the saved step in the ledger details.
+====================  =========================================================
 """
 
 from __future__ import annotations
@@ -74,6 +98,15 @@ ENV_FAULT_SLOW_S = "NEXUS_FAULT_SLOW_S"
 #: (serve-engine only) — :func:`maybe_inject` deliberately no-ops on them
 #: so the engine's recovery layer, not the loop, sees the fault
 EXECUTOR_FAULT_MODES = frozenset({"step-hbm-oom", "step-ici", "slow-step"})
+
+#: modes injected inside the CHECKPOINT commit protocol by
+#: :func:`checkpoint_fault_hook` (train harness) — same ownership contract
+#: as the executor modes: the loop's :func:`maybe_inject` stays silent when
+#: a checkpointer carries the hook, and raises in loops that would make the
+#: drill vacuous (no checkpointer configured)
+CHECKPOINT_FAULT_MODES = frozenset(
+    {"ckpt-crash-mid-save", "ckpt-bitflip", "preempt-sigterm"}
+)
 
 #: message wordings recognized by the supervisor's classifier
 #: (tpu_nexus.supervisor.taxonomy) — injection uses the same strings so the
@@ -108,15 +141,23 @@ class FaultPlan:
         )
 
 
-def maybe_inject(plan: FaultPlan, step: int, executor_faults_handled: bool = False) -> None:
+def maybe_inject(
+    plan: FaultPlan,
+    step: int,
+    executor_faults_handled: bool = False,
+    checkpoint_faults_handled: bool = False,
+) -> None:
     """Called once per training step / engine iteration; fires the
     configured fault at its step.  Executor-boundary modes
     (:data:`EXECUTOR_FAULT_MODES`) are owned by :func:`wrap_executor` —
     the serve-engine loop passes ``executor_faults_handled=True`` and this
-    hook stays silent so the engine's recovery layer sees the fault.  A
-    loop that did NOT wrap its executor (train, lockstep serve) raises at
-    the fault step instead: a chaos drill that injects nothing and
-    reports success is worse than no drill."""
+    hook stays silent so the engine's recovery layer sees the fault;
+    checkpoint-commit modes (:data:`CHECKPOINT_FAULT_MODES`) likewise
+    belong to :func:`checkpoint_fault_hook`, and the train loop passes
+    ``checkpoint_faults_handled=True`` when its checkpointer carries the
+    hook.  A loop that did NOT wire the corresponding seam raises at the
+    fault step instead: a chaos drill that injects nothing and reports
+    success is worse than no drill."""
     if plan.mode is None or step != plan.step:
         return
     if plan.mode in EXECUTOR_FAULT_MODES:
@@ -127,12 +168,26 @@ def maybe_inject(plan: FaultPlan, step: int, executor_faults_handled: bool = Fal
             "boundary; this loop has no wrapped executor — use "
             "NEXUS_MODE=serve-engine for this drill"
         )
+    if plan.mode in CHECKPOINT_FAULT_MODES:
+        if checkpoint_faults_handled:
+            return
+        raise ValueError(
+            f"fault mode {plan.mode!r} injects inside the checkpoint commit "
+            "protocol; this loop has no checkpointer (set "
+            "NEXUS_CHECKPOINT_EVERY/NEXUS_CHECKPOINT_DIR) — the drill would "
+            "inject nothing"
+        )
     logger.warning("injecting fault %r at step %d", plan.mode, step)
     if plan.mode == "oom":
         os._exit(137)
     if plan.mode == "fatal":
         os._exit(255)
     if plan.mode == "preempt":
+        # HARD preemption: the runtime kills without grace.  Restore the
+        # default disposition first so the harness's emergency-save handler
+        # (which would turn this into a graceful drain) cannot catch it —
+        # the graceful variant is the separate 'preempt-sigterm' mode
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
         os.kill(os.getpid(), signal.SIGTERM)
         time.sleep(60)  # wait for the handler/runtime to take us down
         os._exit(143)
@@ -232,6 +287,72 @@ class FaultyExecutor:
         if self._in_window(count, self.at_step):
             self._fire()
         return self.inner.step(tokens, cursors)
+
+
+def _flip_committed_leaf(step_dir: str) -> str:
+    """Flip one byte of a committed payload file — silent media corruption
+    the manifest checksums must catch.  Prefers content-addressed leaf data
+    (orbax ocdbt ``d/`` files) over metadata so the drill corrupts an actual
+    tensor leaf; deterministic pick (first sorted candidate)."""
+    from tpu_nexus.workload import durability
+
+    files = durability.manifest_files(step_dir)
+    leaves = [f for f in files if "/d/" in f or f.startswith("d/")] or files
+    if not leaves:
+        raise ValueError(f"ckpt-bitflip: no payload files under {step_dir}")
+    target = os.path.join(step_dir, sorted(leaves)[0])
+    size = os.path.getsize(target)
+    with open(target, "r+b") as fh:
+        fh.seek(size // 2)
+        byte = fh.read(1)
+        fh.seek(size // 2)
+        fh.write(bytes([byte[0] ^ 0xFF]))
+    return target
+
+
+def checkpoint_fault_hook(plan: FaultPlan):
+    """``TensorCheckpointer.fault_hook`` wired from the fault plan; None
+    when no checkpoint-commit mode is configured (hook-free fast path).
+
+    ``NEXUS_FAULT_STEP`` names the checkpoint step being committed;
+    ``NEXUS_FAULT_TIMES`` repeats the fault for consecutive matching
+    commits (bitflip drills corrupting more than one step)."""
+    if plan.mode not in CHECKPOINT_FAULT_MODES:
+        return None
+    fired = {"count": 0}
+
+    def hook(point: str, step: int, step_dir: str) -> None:
+        if step != plan.step or fired["count"] >= plan.times:
+            return
+        if plan.mode == "ckpt-crash-mid-save" and point == "pre-commit":
+            fired["count"] += 1
+            logger.warning(
+                "injecting ckpt-crash-mid-save: dying between manifest temp "
+                "write and commit marker for step %d", step,
+            )
+            os._exit(1)
+        elif plan.mode == "preempt-sigterm" and point == "pre-commit":
+            fired["count"] += 1
+            logger.warning(
+                "injecting preempt-sigterm during the save window of step %d", step
+            )
+            # the harness's handler sets the cancellation flag; THIS commit
+            # still completes, so the emergency-save path must detect the
+            # already-durable same-step save and skip the duplicate
+            os.kill(os.getpid(), signal.SIGTERM)
+        elif plan.mode == "ckpt-bitflip" and point == "post-commit":
+            fired["count"] += 1
+            target = _flip_committed_leaf(step_dir)
+            logger.warning(
+                "injecting ckpt-bitflip: corrupted %s after commit of step %d",
+                target, step,
+            )
+
+    # exposed so the harness can tell a completed drill from a VACUOUS one
+    # (NEXUS_FAULT_STEP naming a step that is never a commit boundary fires
+    # nothing — the run must not exit 0 looking like a passed drill)
+    hook.fired = fired
+    return hook
 
 
 def wrap_executor(plan: FaultPlan, executor):

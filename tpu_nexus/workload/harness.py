@@ -8,8 +8,19 @@ configs #2-#5).  Cooperation contract with the supervisor:
 * every ``heartbeat_every`` steps: write this host's per-chip step counters
   into ``per_chip_steps`` (ledger merge, not overwrite — other hosts own
   their keys);
-* every ``checkpoint_every`` steps: Orbax-save the train state and record
-  ``tensor_checkpoint_uri`` (restart-from-step after preemption);
+* every ``checkpoint_every`` steps: Orbax-save the train state, run the
+  durability barrier (``commit()``: wait + manifest + checksum read-back,
+  docs/CHECKPOINTS.md) and only THEN record ``tensor_checkpoint_uri``
+  (restart-from-step after preemption) — the ledger never points at an
+  uncommitted or unverified step (nxlint NX007);
+* on restore: verify the manifest first; a torn/corrupt latest step rolls
+  back to the newest verifiable one, quarantined + cause recorded to
+  metrics and the ledger, instead of crashing or loading garbage;
+* on SIGTERM/SIGINT (preemption): cut an emergency checkpoint within
+  ``emergency_grace_s`` (skipped when the same step is already durable),
+  publish it, and land the row PREEMPTED with the saved step in the
+  details — the supervisor restarts from the preemption point, not the
+  last periodic save;
 * on clean exit: COMPLETED + ``result_uri`` (only if not already terminal —
   a cancelled run stays CANCELLED, the reference's IsFinished guard);
 * on crash: exit nonzero / raise — detection is the supervisor's job, via
@@ -19,6 +30,7 @@ configs #2-#5).  Cooperation contract with the supervisor:
 from __future__ import annotations
 
 import dataclasses
+import json
 import logging
 import time
 from dataclasses import dataclass, field
@@ -30,12 +42,14 @@ import numpy as np
 
 from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
 from tpu_nexus.checkpoint.store import CheckpointStore
+from tpu_nexus.core.signals import LifecycleContext, setup_signal_context
+from tpu_nexus.core.telemetry import Metrics, StatsdClient
 from tpu_nexus.models import LlamaConfig
 from tpu_nexus.models.registry import adapter_for, get_adapter
 from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
 from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
 from tpu_nexus.parallel.sharding import RuleTable
-from tpu_nexus.workload.faults import FaultPlan, maybe_inject
+from tpu_nexus.workload.faults import FaultPlan, checkpoint_fault_hook, maybe_inject
 from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
 from tpu_nexus.workload.train import (
     TrainConfig,
@@ -89,6 +103,12 @@ class WorkloadConfig:
     #: held-out stream (disjoint seed) and log/report eval_loss; 0 = off
     eval_every: int = 0
     eval_steps: int = 4
+    #: preemption grace budget (seconds) for the emergency checkpoint cut on
+    #: SIGTERM/SIGINT — sized to the infrastructure's termination grace
+    #: period minus signal-delivery slack.  The save is attempted regardless
+    #: and its duration reported honestly; the budget is what tests and the
+    #: ledger details hold it to.
+    emergency_grace_s: float = 30.0
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "WorkloadConfig":
@@ -132,7 +152,14 @@ class WorkloadConfig:
             data_path=e.get("NEXUS_DATA_PATH", ""),
             eval_every=int(e.get("NEXUS_EVAL_EVERY", "0")),
             eval_steps=int(e.get("NEXUS_EVAL_STEPS", "4")),
+            emergency_grace_s=float(e.get("NEXUS_EMERGENCY_GRACE_S", "30")),
         )
+
+
+def _rollback_record(events) -> list:
+    """Ledger-details shape of restore-time rollback events: bounded detail
+    strings (the ledger column is not a log sink)."""
+    return [dict(e, detail=str(e.get("detail", ""))[:200]) for e in events]
 
 
 class LedgerReporter:
@@ -184,7 +211,23 @@ class LedgerReporter:
         self.store.merge_chip_steps(self.ctx.algorithm, self.ctx.run_id, self._chip_steps(step))
 
     def tensor_checkpoint(self, uri: str, step: int) -> None:
+        """Publish a checkpoint pointer.  Contract (nxlint NX007): callers
+        hold the durability barrier — ``uri`` came out of
+        ``TensorCheckpointer.commit()`` / a verified-step resolution, never
+        a bare ``save()``."""
         self._guarded_update({"tensor_checkpoint_uri": uri})
+        self.heartbeat(step)
+
+    def checkpoint_rollback(self, uri: str, step: int, events) -> None:
+        """Restore-time rollback: repoint the ledger at the step actually
+        restored (``uri`` may be empty when NOTHING verified — an honest
+        empty pointer beats a corrupt one) and record why in the details
+        column.  Same NX007 contract as :meth:`tensor_checkpoint`: the
+        caller's verified-step resolution is the barrier."""
+        details = json.dumps({"ckpt_rollback": _rollback_record(events)})
+        self._guarded_update(
+            {"tensor_checkpoint_uri": uri, "algorithm_failure_details": details}
+        )
         self.heartbeat(step)
 
     def completed(self, result_uri: str = "") -> None:
@@ -240,15 +283,61 @@ def run_workload(
     store: Optional[CheckpointStore] = None,
     ctx: Optional[ProcessContext] = None,
     data: Optional[Iterator[np.ndarray]] = None,
+    lifecycle: Optional[LifecycleContext] = None,
+    telemetry: Optional[Metrics] = None,
 ) -> Dict[str, Any]:
     """Run the training loop; returns summary metrics.
 
-    ``store``/``ctx``/``data`` are injectable for tests; production wiring
-    reads env (launcher contract) and a CQL store.
-    """
+    ``store``/``ctx``/``data``/``lifecycle``/``telemetry`` are injectable
+    for tests; production wiring reads env (launcher contract) and a CQL
+    store.  ``lifecycle`` carries the preemption protocol: on SIGTERM/SIGINT
+    the loop stops, cuts an emergency checkpoint inside
+    ``cfg.emergency_grace_s`` (skipping a duplicate of an already-committed
+    step), and lands the ledger row PREEMPTED with the saved step in the
+    details.  By default signal handlers install on the main thread (and
+    are restored on exit, same contract as ``run_serve_engine``)."""
+    import threading
+
+    restore_handlers = {}
+    if lifecycle is None:
+        # signal.signal only works on the main thread; elsewhere (nested
+        # test runners, thread pools) fall back to an uninstalled context
+        import signal as _signal
+
+        on_main = threading.current_thread() is threading.main_thread()
+        if on_main:
+            restore_handlers = {
+                s: _signal.getsignal(s) for s in (_signal.SIGINT, _signal.SIGTERM)
+            }
+        lifecycle = setup_signal_context(install=on_main)
+    try:
+        return _workload_loop(cfg, store, ctx, data, lifecycle, telemetry)
+    finally:
+        if restore_handlers:
+            import signal as _signal
+
+            for sig, handler in restore_handlers.items():
+                _signal.signal(sig, handler)
+
+
+def _workload_loop(
+    cfg: WorkloadConfig,
+    store: Optional[CheckpointStore],
+    ctx: Optional[ProcessContext],
+    data: Optional[Iterator[np.ndarray]],
+    lifecycle: LifecycleContext,
+    telemetry: Optional[Metrics],
+) -> Dict[str, Any]:
     ctx = initialize_distributed(ctx)
     reporter = LedgerReporter(store, ctx)
     plan = FaultPlan.from_env()
+    if telemetry is None:
+        # live DogStatsD emission, same fire-and-forget contract as the
+        # serve-engine loop — an absent agent drops datagrams, never raises
+        telemetry = StatsdClient(
+            "tpu_nexus.workload",
+            static_tags={"algorithm": ctx.algorithm, "run_id": ctx.run_id},
+        )
     adapter = adapter_for(cfg.model)
     mesh = build_mesh(cfg.mesh)
     if mesh.shape.get("pp", 1) > 1 and not cfg.rules.get("layers"):
@@ -265,14 +354,56 @@ def run_workload(
     ckpt: Optional[TensorCheckpointer] = None
     start_step = 0
     resumed_from: Optional[int] = None
+    rollback_events: list = []
+    fault_hook = checkpoint_fault_hook(plan)
     if cfg.checkpoint_every and cfg.checkpoint_dir:
-        ckpt = TensorCheckpointer(cfg.checkpoint_dir)
-        latest = ckpt.latest_step()
+        ckpt = TensorCheckpointer(cfg.checkpoint_dir, fault_hook=fault_hook)
+        # durability barrier before anything restores or re-publishes: the
+        # newest VERIFIED step, quarantining torn/corrupt ones on the way
+        # (one quarantine writer per run — verification itself is read-only,
+        # so every host still lands on the same step)
+        latest = ckpt.latest_verified_step(quarantine=ctx.is_coordinator)
         if latest is not None:
             state = ckpt.restore(state, latest)
             start_step = latest
             resumed_from = latest
-            logger.info("restored tensor checkpoint at step %d", latest)
+            logger.info("restored verified tensor checkpoint at step %d", latest)
+        elif ctx.num_processes > 1:
+            # nothing restorable, so no collective restore will act as the
+            # rename sync point below — raise an explicit barrier instead
+            # (every host reaches this branch: verification reads the same
+            # shared directory, so `latest is None` is a uniform outcome)
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("tpu_nexus_ckpt_scan")
+        if not ctx.is_coordinator:
+            # the coordinator may have quarantined bad steps behind this
+            # host's orbax manager (even when THIS host's read-only scan saw
+            # a clean directory — the scan can race the renames), and a
+            # manager still caching a quarantined step number would silently
+            # no-op a later re-save of that step on this host's shards.  The
+            # collective restore above — or the explicit barrier when
+            # nothing restored — proves the renames landed; refresh now
+            # (cheap: one directory re-scan).
+            ckpt.reload()
+        if ckpt.rollbacks:
+            # corruption-tolerant restore: record WHY we are not at the
+            # newest on-disk step — metrics tag per cause, ledger details,
+            # and the ledger pointer repointed at the step actually restored
+            rollback_events = list(ckpt.rollbacks)
+            # coordinator-only: every host walks the same shared directory
+            # and records the same events — per-host emission would inflate
+            # the counter by the process count (no host tag to dedupe by)
+            if ctx.is_coordinator:
+                for event in rollback_events:
+                    telemetry.count(
+                        "train.ckpt_rollback", tags={"cause": event["cause"]}
+                    )
+                reporter.checkpoint_rollback(
+                    ckpt.uri_for(latest) if latest is not None else "",
+                    latest or 0,
+                    rollback_events,
+                )
 
     step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules)
     # cfg.batch_size is GLOBAL.  Two multi-process data modes:
@@ -367,15 +498,43 @@ def run_workload(
         eval_batch = cfg.batch_size if replicated_data else cfg.batch_size // ctx.num_processes
         eval_data = make_stream(eval_batch, seed=eval_seed, part="eval")
 
+    if ctx.num_processes > 1:
+        from jax.experimental import multihost_utils
+
+        def cancel_requested() -> bool:
+            # the break decision must be UNIFORM across hosts: SIGTERM
+            # delivery skews by milliseconds, and a host that breaks for
+            # the emergency save while another enters the next step's
+            # psums leaves the two sides in mismatched collectives —
+            # deadlocked until the runtime SIGKILLs, losing the very
+            # checkpoint the grace window exists for.  Every host
+            # contributes its local flag at the same loop point; any host
+            # signalled → all break together.  One tiny host allgather
+            # per step, multi-host runs only.
+            flags = multihost_utils.process_allgather(
+                np.asarray(bool(lifecycle.cancelled))
+            )
+            return bool(np.any(flags))
+
+    else:
+
+        def cancel_requested() -> bool:
+            return lifecycle.cancelled
+
     reporter.running()
     metrics: Dict[str, Any] = {}
+    m: Dict[str, Any] = {}
     t0 = time.perf_counter()
     tokens_done = 0
     step = start_step
     try:
         with mesh:
             for step in range(start_step, cfg.steps):
-                maybe_inject(plan, step)
+                if cancel_requested():
+                    # preemption: stop consuming batches NOW — the grace
+                    # window belongs to the emergency save below
+                    break
+                maybe_inject(plan, step, checkpoint_faults_handled=ckpt is not None)
                 batch = to_global(next(data))
                 state, m = step_fn(state, batch)
                 tokens_done += adapter.items_in(batch)
@@ -392,8 +551,18 @@ def run_workload(
                     eval_loss = float(sum(losses)) / max(len(losses), 1)
                     logger.info("step %d eval_loss %.4f", step + 1, eval_loss)
                 if ckpt and (step + 1) % cfg.checkpoint_every == 0:
-                    uri = ckpt.save(step + 1, state)
-                    reporter.tensor_checkpoint(uri, step + 1)
+                    # publish-after-durability: save() starts the (possibly
+                    # async) write; commit() is the barrier — wait + manifest
+                    # + checksum read-back.  The ledger must never point at a
+                    # URI that could still be torn (nxlint NX007).  One
+                    # manifest writer per run: non-coordinators only hold the
+                    # wait (the save itself is the multi-host collective).
+                    ckpt.save(step + 1, state)
+                    if ctx.is_coordinator:
+                        uri = ckpt.commit(step + 1)
+                        reporter.tensor_checkpoint(uri, step + 1)
+                    else:
+                        ckpt.wait()
     except Exception as exc:  # noqa: BLE001 - annotate, record, re-raise
         # north-star contract: failure-time trace artifact, its ref in the
         # ledger (hlo_trace_ref) AND in the raised message so the k8s event
@@ -405,10 +574,35 @@ def run_workload(
         raise
     jax.block_until_ready(state["step"])
     elapsed = time.perf_counter() - t0
+    # same uniformity rule as the loop break: every host reaches this point
+    # (loop exhausted or uniform break), so a signal that landed on only
+    # some hosts still yields one run-wide verdict — the emergency save
+    # below is a collective and must be entered by all hosts or none
+    preempted = cancel_requested()
+    emergency: Dict[str, Any] = {}
+    if preempted:
+        emergency = _emergency_save(cfg, ckpt, state, reporter, ctx, lifecycle, telemetry)
     if ckpt:
         ckpt.wait()
         ckpt.close()
-    metrics = {k: float(v) for k, v in m.items()} if cfg.steps > start_step else metrics
+    if (
+        ctx.is_coordinator
+        and fault_hook is not None
+        and not preempted
+        and fault_hook.fired["count"] == 0
+    ):
+        # vacuous-drill guard, commit-protocol flavor: a checkpoint fault
+        # was configured but its step never matched a commit boundary, so
+        # nothing was injected — exiting 0 here would read as a passed
+        # drill (the hook only runs inside the coordinator's commit(), so
+        # only the coordinator can judge; `not preempted` spares a run a
+        # REAL preemption stopped before the fault step could commit)
+        raise RuntimeError(
+            f"chaos drill injected nothing: fault mode {plan.mode!r} targets "
+            f"checkpoint step {plan.step}, but that step never committed "
+            f"(checkpoint_every={cfg.checkpoint_every}, steps={cfg.steps})"
+        )
+    metrics = {k: float(v) for k, v in m.items()} if m else metrics
     final_step = int(state["step"])
     # completion protocol: every host lands its final heartbeat, THEN a
     # cross-process barrier, THEN only the coordinator commits the terminal
@@ -421,12 +615,98 @@ def run_workload(
 
         multihost_utils.sync_global_devices("tpu_nexus_workload_done")
     if ctx.is_coordinator:
-        reporter.completed()
+        if preempted:
+            # exit PREEMPTED: non-terminal, rank-equal with RUNNING — the
+            # supervisor's restart path resumes from the emergency step in
+            # the details instead of the last periodic save
+            # details carry BOTH stories: the emergency save AND any
+            # restore-time rollback this run reported earlier — preempted()
+            # rewrites the column wholesale, and the rollback evidence
+            # (RUNBOOK §11 tells operators to look for it) must survive
+            reporter.preempted(
+                cause=f"signal:{lifecycle.reason or 'cancelled'}",
+                details=json.dumps(
+                    {
+                        **emergency,
+                        **(
+                            {"ckpt_rollback": _rollback_record(rollback_events)}
+                            if rollback_events
+                            else {}
+                        ),
+                    }
+                ),
+            )
+        else:
+            reporter.completed()
     return {
         "final_step": final_step,
         "resumed_from": resumed_from,
         "elapsed_s": elapsed,
         "tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
         **({"eval_loss": eval_loss} if eval_loss is not None else {}),
+        **({"preempted": True, **emergency} if preempted else {}),
+        **({"ckpt_rollbacks": rollback_events} if rollback_events else {}),
         **metrics,
     }
+
+
+def _emergency_save(
+    cfg: WorkloadConfig,
+    ckpt: Optional[TensorCheckpointer],
+    state: Dict[str, Any],
+    reporter: LedgerReporter,
+    ctx: ProcessContext,
+    lifecycle: LifecycleContext,
+    telemetry: Metrics,
+) -> Dict[str, Any]:
+    """Preemption → saved step: cut a final checkpoint inside the grace
+    window, skipping when the interrupted loop already committed this exact
+    step (a SIGTERM landing mid-save-window must not double-save), and
+    publish it only after the durability barrier.  Best-effort by design: a
+    failing emergency save still reports PREEMPTED honestly — the restart
+    then resumes from the last periodic commit."""
+    info: Dict[str, Any] = {
+        "reason": lifecycle.reason or "cancelled",
+        "grace_s": cfg.emergency_grace_s,
+    }
+    if ckpt is None:
+        return info
+    step = int(state["step"])
+    if step <= 0:
+        return info  # nothing trained yet — nothing worth saving
+    if ckpt.last_saved_step == step:
+        # the loop already issued this exact step's save (save is the
+        # multi-host collective, so this check is uniform across hosts);
+        # a coordinator whose barrier somehow didn't finish completes it
+        # without a fresh collective save
+        if ctx.is_coordinator and ckpt.last_committed_step != step:
+            uri = ckpt.commit(step)
+            reporter.tensor_checkpoint(uri, step)
+        logger.info("emergency save: step %d already committed; skipping", step)
+        telemetry.count("train.emergency_save", tags={"skipped": "true"})
+        info.update(emergency_step=step, emergency_skipped=True, emergency_save_s=0.0)
+        return info
+    t0 = time.perf_counter()
+    try:
+        ckpt.save(step, state)
+        if ctx.is_coordinator:
+            uri = ckpt.commit(step)  # durability barrier before publish (NX007)
+        else:
+            ckpt.wait()
+    except Exception:  # noqa: BLE001 - best-effort: a failing emergency save must not mask the preemption report; the run restarts from the last committed step
+        logger.exception("emergency save at step %d failed", step)
+        telemetry.count("train.emergency_save_failed")
+        info.update(emergency_step=None, emergency_skipped=False)
+        return info
+    save_s = time.perf_counter() - t0
+    if ctx.is_coordinator:
+        reporter.tensor_checkpoint(uri, step)
+    info.update(emergency_step=step, emergency_skipped=False, emergency_save_s=save_s)
+    if save_s > cfg.emergency_grace_s:
+        logger.warning(
+            "emergency save took %.2fs, over the %.2fs grace budget — the "
+            "runtime may have killed slower hosts mid-save",
+            save_s, cfg.emergency_grace_s,
+        )
+    telemetry.count("train.emergency_save", tags={"skipped": "false"})
+    return info

@@ -40,7 +40,7 @@ from tpu_nexus.models.registry import LlamaAdapter, MoeAdapter, adapter_for, get
 from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
 from tpu_nexus.workload.faults import FaultPlan, maybe_inject
 from tpu_nexus.workload.harness import LedgerReporter
-from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+from tpu_nexus.workload.tensor_checkpoint import CheckpointError, TensorCheckpointer
 
 logger = logging.getLogger(__name__)
 
@@ -174,13 +174,37 @@ def _load_serving_params(cfg: ServeConfig, ctx: ProcessContext):
     restored_from: Optional[int] = None
     if cfg.checkpoint_dir:
         ckpt = TensorCheckpointer(cfg.checkpoint_dir)
-        latest = ckpt.latest_step()
+        # verified restore, read-only flavor: a torn/corrupt latest step is
+        # skipped (rolled back) but NOT quarantined — the training run owns
+        # mutation of its checkpoint directory, serving only reads it
+        latest = ckpt.latest_verified_step(quarantine=False)
+        for event in ckpt.rollbacks:
+            logger.warning(
+                "serving restore rolled past unverifiable checkpoint step "
+                "%(step)s (%(cause)s): %(detail)s", event,
+            )
         if latest is not None:
             # params-only, template-free: serve must not assume the training
             # run's TrainConfig (its opt-state structure is irrelevant here)
             params = ckpt.restore_params(latest)
             restored_from = latest
-            logger.info("restored tensor checkpoint at step %d", latest)
+            logger.info("restored verified tensor checkpoint at step %d", latest)
+        elif ckpt.rollbacks:
+            # steps exist but NONE verify: falling back to the fresh
+            # adapter.init() weights would start a healthy-looking engine
+            # that serves garbage.  Fail loudly — either the directory is
+            # rotten or it predates the durability release and needs the
+            # one-time adopt migration (RUNBOOK §11).
+            ckpt.close()
+            causes = ", ".join(
+                f"step {e['step']}: {e['cause']}" for e in ckpt.rollbacks
+            )
+            raise CheckpointError(
+                f"{cfg.checkpoint_dir} has checkpoint steps but none verify "
+                f"({causes}); refusing to serve freshly-initialized weights. "
+                "Pre-durability checkpoints need `python -m "
+                "tpu_nexus.workload.durability adopt` first (RUNBOOK §11)."
+            )
         ckpt.close()
 
     if cfg.quantize:

@@ -1,28 +1,73 @@
-"""Tensor (weights/optimizer) checkpointing via Orbax.
+"""Tensor (weights/optimizer) checkpointing via Orbax, hardened by the
+durability layer in :mod:`tpu_nexus.workload.durability`.
 
 Distinct from the *ledger* checkpoint (run metadata in Scylla, SURVEY.md
 §2.5): these are the actual arrays, written to a directory/object-store path;
 the ledger row points at them via ``tensor_checkpoint_uri`` so a preempted
 run restarts from step instead of being deleted (SURVEY.md §7.4).
+
+That pointer is a promise, so saving splits in two (docs/CHECKPOINTS.md):
+
+* :meth:`TensorCheckpointer.save` starts the (possibly async) Orbax write;
+* :meth:`TensorCheckpointer.commit` is the **durability barrier** — wait for
+  the async save, checksum every byte into a manifest, publish the manifest
+  atomically (temp → fsync → rename) and structurally re-verify it (marker,
+  parse, file presence + sizes; full checksums are re-proved restore-side).
+  Only a URI returned by ``commit`` may reach the ledger (nxlint NX007).
+
+Restores go the other way: verify first, and when the newest step is torn
+or corrupt, roll back to the newest step that *proves* itself, quarantining
+the bad directory and recording why (``rollbacks``) instead of crashing or
+silently loading garbage.
 """
 
 from __future__ import annotations
 
 import logging
-from typing import Any, Dict, Optional
+import os
+from typing import Any, Callable, Dict, List, Optional
+
+from tpu_nexus.workload import durability
+from tpu_nexus.workload.durability import (  # re-exported: callers catch these
+    CheckpointCorrupt,
+    CheckpointError,
+    CheckpointMissing,
+    CheckpointUncommitted,
+)
+
+__all__ = [
+    "TensorCheckpointer",
+    "CheckpointError",
+    "CheckpointMissing",
+    "CheckpointUncommitted",
+    "CheckpointCorrupt",
+]
 
 logger = logging.getLogger(__name__)
 
+#: fault-hook points (chaos harness seam, workload/faults.py): called as
+#: ``hook(point, step, step_dir)`` around the commit protocol
+HOOK_PRE_COMMIT = "pre-commit"
+HOOK_POST_COMMIT = "post-commit"
+
 
 class TensorCheckpointer:
-    """Thin Orbax wrapper: save/restore the train-state pytree keyed by step.
+    """Orbax wrapper with an explicit commit protocol: save/restore the
+    train-state pytree keyed by step, with per-step manifests as the
+    commit marker and checksum verification on both sides.
 
     Orbax handles multi-host coordination and sharded arrays natively; the
     restore path re-shards onto the current mesh via the target pytree's
     shardings (abstract arrays from ``jax.eval_shape`` + shardings).
-    """
+    ``fault_hook`` is the chaos-injection seam
+    (:func:`tpu_nexus.workload.faults.checkpoint_fault_hook`)."""
 
-    def __init__(self, directory: str, max_to_keep: int = 3) -> None:
+    def __init__(
+        self,
+        directory: str,
+        max_to_keep: int = 3,
+        fault_hook: Optional[Callable[[str, int, str], None]] = None,
+    ) -> None:
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -31,23 +76,135 @@ class TensorCheckpointer:
             directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep, create=True),
         )
+        self._fault_hook = fault_hook
+        #: newest step whose commit barrier completed IN THIS PROCESS —
+        #: the emergency-save path uses it to skip a duplicate same-step save
+        self.last_committed_step: Optional[int] = None
+        #: newest step this process ISSUED a save for — set on every host
+        #: (save is the multi-host collective, commit is coordinator-only),
+        #: so multi-host skip decisions stay uniform
+        self.last_saved_step: Optional[int] = None
+        #: restore-time rollback events (durability.newest_verified_step
+        #: records), accumulated for metrics/ledger reporting by the caller
+        self.rollbacks: List[Dict[str, Any]] = []
+        #: steps fully checksum-verified by THIS process's verified-step
+        #: scan: restore skips the immediately-redundant re-hash (a multi-GB
+        #: checkpoint would otherwise pay 2x SHA-256 on the hot restart
+        #: path).  Process-local and scan-fed only — corruption arriving
+        #: between the scan and the restore is outside the window this
+        #: cache tolerates.
+        self._scan_verified: set = set()
+
+    def _hook(self, point: str, step: int) -> None:
+        if self._fault_hook is not None:
+            self._fault_hook(point, step, self.step_dir(step))
+
+    # -- save side -------------------------------------------------------------
 
     def save(self, step: int, state: Dict[str, Any]) -> str:
+        """Start the (possibly async) Orbax save.  The returned URI is NOT
+        durable yet — it must not be published until :meth:`commit` returns."""
         self._mngr.save(step, args=self._ocp.args.StandardSave(state))
+        self.last_saved_step = step
+        return self.uri_for(step)
+
+    def commit(self, step: int) -> str:
+        """The durability barrier: wait for the async save, manifest every
+        byte, publish the commit marker atomically, and read back the commit
+        structurally.  Returns the URI, now safe to write to the ledger
+        (nxlint NX007)."""
+        self.wait()
+        step_dir = self.step_dir(step)
+        manifest = durability.build_manifest(step_dir, step)
+        durability.write_manifest_temp(step_dir, manifest)
+        # chaos seam: ckpt-crash-mid-save kills the process HERE — payload
+        # durable, marker absent — the exact torn-save window the restore
+        # side must survive
+        self._hook(HOOK_PRE_COMMIT, step)
+        durability.commit_manifest(step_dir)
+        # structural read-back: marker landed, manifest parses, every file
+        # present at its manifested size.  build_manifest just hashed every
+        # payload byte — a second full hash pass would double commit latency
+        # on the training hot path yet still read the page cache, not the
+        # media; full checksums are enforced on the restore side instead.
+        durability.verify_step(step_dir, step, deep=False)
+        self.last_committed_step = step
+        self._hook(HOOK_POST_COMMIT, step)
         return self.uri_for(step)
 
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 
+    # -- verification / rollback ----------------------------------------------
+
+    def verify(self, step: int) -> Dict[str, Any]:
+        """Prove step ``step`` committed and checksum-clean (returns its
+        manifest); raises the classified ``Checkpoint*`` errors."""
+        return durability.verify_step(self.step_dir(step), step)
+
+    def latest_verified_step(self, quarantine: bool = True) -> Optional[int]:
+        """Newest step that passes verification, rolling back past torn or
+        corrupt ones.  Bad steps are quarantined (renamed ``<step>.corrupt``)
+        unless ``quarantine=False`` (read-only consumers: serving), and each
+        rollback is appended to :attr:`rollbacks` for the caller to report."""
+        step, rollbacks = durability.newest_verified_step(
+            self.directory, quarantine=quarantine
+        )
+        self.rollbacks.extend(rollbacks)
+        if step is not None:
+            self._scan_verified.add(step)
+        if rollbacks and quarantine:
+            # the quarantine renames happened behind the live orbax
+            # manager's back; drop its cached step list or a later
+            # re-save of a quarantined step number silently no-ops
+            # ("step already exists").  Hosts that scanned read-only
+            # (quarantine=False — non-coordinators, whose coordinator
+            # renames concurrently) must call :meth:`reload` themselves
+            # once a synchronization point guarantees the renames landed;
+            # the harness does this right after the collective restore.
+            self._mngr.reload()
+        return step
+
+    def reload(self) -> None:
+        """Drop orbax's cached step list and re-scan the directory — needed
+        after ANOTHER process/host quarantined steps behind this manager's
+        back (see :meth:`latest_verified_step`)."""
+        self._mngr.reload()
+
     def latest_step(self) -> Optional[int]:
+        """Orbax's UNVERIFIED view of the newest step — prefer
+        :meth:`latest_verified_step` anywhere the result gets restored or
+        published."""
         return self._mngr.latest_step()
+
+    # -- restore side ----------------------------------------------------------
+
+    def _resolve_step(self, step: Optional[int]) -> int:
+        """Explicit step: verify it (the caller demanded THAT step — a
+        classified raise beats restoring garbage), unless this process's
+        verified-step scan already checksummed it.  No step: newest
+        verified, with rollback + quarantine."""
+        if step is not None:
+            if step not in self._scan_verified:
+                self.verify(step)
+            return step
+        found = self.latest_verified_step()
+        if found is None:
+            detail = (
+                f" ({len(self.rollbacks)} unverifiable step(s) quarantined)"
+                if self.rollbacks
+                else ""
+            )
+            raise CheckpointMissing(
+                f"no verifiable checkpoint under {self.directory}{detail}"
+            )
+        return found
 
     def restore(self, state_like: Dict[str, Any], step: Optional[int] = None) -> Dict[str, Any]:
         """``state_like``: pytree of arrays OR jax.ShapeDtypeStruct with
-        .sharding set — restored arrays land sharded accordingly."""
-        step = self._mngr.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        .sharding set — restored arrays land sharded accordingly.  The step
+        is verified (manifest + checksums) before Orbax touches it."""
+        step = self._resolve_step(step)
         return self._mngr.restore(step, args=self._ocp.args.StandardRestore(state_like))
 
     def restore_params(self, step: Optional[int] = None) -> Dict[str, Any]:
@@ -60,15 +217,17 @@ class TensorCheckpointer:
         reads the saved structure from checkpoint metadata; the optimizer
         moments are deserialized and discarded (acceptable IO cost at serve
         startup; Orbax's partial-restore API does not compose with
-        StandardSave through the CheckpointManager)."""
-        step = self._mngr.latest_step() if step is None else step
-        if step is None:
-            raise FileNotFoundError(f"no checkpoint under {self.directory}")
+        StandardSave through the CheckpointManager).  Same verify-first
+        contract as :meth:`restore`."""
+        step = self._resolve_step(step)
         # template-free StandardRestore: a FRESH manager (serve startup) has
         # no handler registry primed by a prior save, so a bare restore(step)
         # raises KeyError on orbax <= 0.7
         restored = self._mngr.restore(step, args=self._ocp.args.StandardRestore())
         return restored["params"]
+
+    def step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
 
     def uri_for(self, step: int) -> str:
         return f"{self.directory}/{step}"
