@@ -15,6 +15,17 @@ do NOT shell-redirect stdout onto the same file).  Pure CPU, tiny config,
 fixed seeds, finishes in seconds (CI hygiene like bench_latency.py).
 Knobs: ``NEXUS_SERVING_REQUESTS`` / ``NEXUS_SERVING_SLOTS`` /
 ``NEXUS_SERVING_ARRIVAL_RPS``.
+
+``--shared-prefix`` (ISSUE 6) instead benches the PAGED engine on the
+millions-of-users workload: one long system prompt, high fan-out, short
+unique tails.  Both engines get the SAME KV HBM budget (``slots ×
+max_len`` cache rows); the slot-granular engine spends it on
+``NUM_SLOTS`` whole rows while the paged engine spends it on
+``page_size``-token blocks — shared prompt blocks are prefilled ONCE and
+referenced by every request, so the same bytes host several times more
+concurrent requests.  Artifact: ``NEXUS_SERVING_PREFIX_OUT``, default
+BENCH_SERVING_PREFIX_r07.json.  Knobs: ``NEXUS_PREFIX_FANOUT`` /
+``NEXUS_PREFIX_SHARED_LEN`` / ``NEXUS_PREFIX_PAGE``.
 """
 
 from __future__ import annotations
@@ -22,6 +33,7 @@ from __future__ import annotations
 import functools
 import json
 import os
+import sys
 import time
 
 import jax
@@ -31,7 +43,13 @@ import numpy as np
 from tpu_nexus.models import LlamaConfig
 from tpu_nexus.models.generate import generate
 from tpu_nexus.models.llama import llama_init
-from tpu_nexus.serving import ModelExecutor, RequestState, ServingEngine, ServingMetrics
+from tpu_nexus.serving import (
+    ModelExecutor,
+    PagedModelExecutor,
+    RequestState,
+    ServingEngine,
+    ServingMetrics,
+)
 
 SEED = 0
 N_REQUESTS = int(os.environ.get("NEXUS_SERVING_REQUESTS", "48"))
@@ -158,6 +176,160 @@ def run_lockstep(params, cfg, requests):
     return useful, time.perf_counter() - t0
 
 
+# -- shared-prefix workload (ISSUE 6) ------------------------------------------
+
+FANOUT = int(os.environ.get("NEXUS_PREFIX_FANOUT", "48"))
+SHARED_LEN = int(os.environ.get("NEXUS_PREFIX_SHARED_LEN", "48"))
+TAIL_LEN = 4
+PREFIX_GEN = 8
+PAGE_SIZE = int(os.environ.get("NEXUS_PREFIX_PAGE", "4"))
+PREFIX_MAX_LEN = SHARED_LEN + TAIL_LEN + PREFIX_GEN
+
+
+def make_prefix_requests(rng):
+    """One system prompt, ``FANOUT`` users: every prompt is the shared
+    prefix + a short unique tail (tokens 256.. so warmup prompts, drawn
+    below 256, can never alias a measured prefix)."""
+    shared = rng.integers(256, 512, size=SHARED_LEN).astype(np.int32)
+    return [
+        np.concatenate([shared, rng.integers(256, 512, size=TAIL_LEN).astype(np.int32)])
+        for _ in range(FANOUT)
+    ]
+
+
+def _drain_tracking_peak(engine, requests):
+    """Submit everything at t=0, pump to drain, return (useful_tokens,
+    elapsed_s, peak concurrently-resident requests)."""
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(requests):
+        engine.submit(prompt, PREFIX_GEN, request_id=f"fan-{i}")
+    peak = 0
+    steps = 0
+    while engine.has_work:
+        engine.step()
+        steps += 1
+        peak = max(peak, engine.slots.used_count)
+        if steps > 100_000:
+            raise RuntimeError("shared-prefix bench failed to drain")
+    elapsed = time.perf_counter() - t0
+    tokens = sum(
+        len(r.output_tokens)
+        for r in engine.retired
+        if r.state == RequestState.FINISHED and r.request_id.startswith("fan-")
+    )
+    return tokens, elapsed, peak
+
+
+def run_prefix_paged(params, cfg, requests):
+    """Paged engine at the SAME KV HBM budget as the slot baseline:
+    ``NUM_SLOTS × max_len`` cache rows re-cut into blocks.  Decode lanes
+    are raised to the block-pool's theoretical concurrency — lanes are
+    host bookkeeping + batch rows, not KV memory."""
+    budget_rows = NUM_SLOTS * PREFIX_MAX_LEN
+    num_blocks = 1 + budget_rows // PAGE_SIZE
+    lanes = int(os.environ.get("NEXUS_PREFIX_LANES", str(4 * NUM_SLOTS)))
+    executor = PagedModelExecutor(
+        params, cfg, num_slots=lanes, max_len=PREFIX_MAX_LEN,
+        page_size=PAGE_SIZE, num_blocks=num_blocks, seed=SEED,
+    )
+    engine = ServingEngine(executor)
+    # warmup compiles: full-prefill bucket, extend bucket (prefix hit),
+    # COW copy, decode step — warmup tokens < 256, measured >= 256, so no
+    # warmup prefix can leak into the measured lookups
+    warm = np.arange(1, SHARED_LEN + TAIL_LEN + 1, dtype=np.int32)
+    engine.submit(warm, 2, request_id="warm-full")
+    engine.run_until_drained()
+    engine.submit(np.concatenate([warm[:-1], [255]]).astype(np.int32), 2, request_id="warm-ext")
+    engine.run_until_drained()
+    engine.metrics = metrics = ServingMetrics()
+    prefilled_before = executor.prefilled_tokens
+
+    tokens, elapsed, peak = _drain_tracking_peak(engine, requests)
+    summary = metrics.summary()
+    return {
+        "tokens": tokens,
+        "elapsed_s": elapsed,
+        "peak_concurrent": peak,
+        "prefilled_tokens": executor.prefilled_tokens - prefilled_before,
+        "prefix_hits": summary["prefix_hits"],
+        "prefix_shared_tokens": summary["prefix_shared_tokens"],
+        "blocks_cow": summary["blocks_cow"],
+        "num_blocks": num_blocks,
+        "page_size": PAGE_SIZE,
+        "lanes": lanes,
+    }
+
+
+def run_prefix_slot_granular(params, cfg, requests):
+    """The baseline: same workload, same KV bytes, whole-row slots — the
+    shared prompt is prefilled and stored once PER REQUEST."""
+    executor = ModelExecutor(
+        params, cfg, num_slots=NUM_SLOTS, max_len=PREFIX_MAX_LEN, seed=SEED
+    )
+    engine = ServingEngine(executor)
+    warm = np.arange(1, SHARED_LEN + TAIL_LEN + 1, dtype=np.int32)
+    engine.submit(warm, 2, request_id="warm-full")
+    engine.run_until_drained()
+    engine.metrics = ServingMetrics()
+
+    tokens, elapsed, peak = _drain_tracking_peak(engine, requests)
+    return {
+        "tokens": tokens,
+        "elapsed_s": elapsed,
+        "peak_concurrent": peak,
+        "prefilled_tokens": sum(len(p) for p in requests),
+        "slots": NUM_SLOTS,
+    }
+
+
+def main_shared_prefix():
+    rng = np.random.default_rng(SEED)
+    cfg = bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+    requests = make_prefix_requests(rng)
+
+    paged = run_prefix_paged(params, cfg, requests)
+    slot = run_prefix_slot_granular(params, cfg, requests)
+
+    paged_tps = paged["tokens"] / paged["elapsed_s"] if paged["elapsed_s"] else 0.0
+    slot_tps = slot["tokens"] / slot["elapsed_s"] if slot["elapsed_s"] else 0.0
+    result = {
+        "metric": "shared_prefix_concurrent_capacity_ratio",
+        # the headline: concurrent requests the SAME KV HBM hosts
+        "value": round(paged["peak_concurrent"] / max(1, slot["peak_concurrent"]), 3),
+        "unit": "x_concurrent_requests_at_equal_kv_hbm",
+        "kv_budget_rows": NUM_SLOTS * PREFIX_MAX_LEN,
+        "workload": {
+            "fanout": FANOUT,
+            "shared_prompt_len": SHARED_LEN,
+            "tail_len": TAIL_LEN,
+            "gen_tokens": PREFIX_GEN,
+            "max_len": PREFIX_MAX_LEN,
+        },
+        "paged": {
+            **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in paged.items()},
+            "tokens_per_second": round(paged_tps, 2),
+        },
+        "slot_granular": {
+            **{k: (round(v, 4) if isinstance(v, float) else v) for k, v in slot.items()},
+            "tokens_per_second": round(slot_tps, 2),
+        },
+        "speedup_tokens_per_second": round(paged_tps / slot_tps, 3) if slot_tps else None,
+        "prefill_reduction": (
+            round(slot["prefilled_tokens"] / paged["prefilled_tokens"], 3)
+            if paged["prefilled_tokens"]
+            else None
+        ),
+        "seed": SEED,
+        "model": "llama-bench-4L-h256",
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_SERVING_PREFIX_OUT", "BENCH_SERVING_PREFIX_r07.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     rng = np.random.default_rng(SEED)
     cfg = bench_model()
@@ -201,4 +373,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--shared-prefix" in sys.argv[1:]:
+        main_shared_prefix()
+    else:
+        main()

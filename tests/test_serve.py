@@ -147,6 +147,29 @@ class TestServeConfigValidation:
             "int8", "int8", "xla",
         )
 
+    def test_kv_blocks_without_page_size_fails(self):
+        with pytest.raises(ValueError, match="kv_blocks .* requires page_size"):
+            ServeConfig(kv_blocks=64)
+
+    def test_kv_blocks_one_fails_at_parse(self):
+        # init_paged_cache would reject it mid-run; the config must reject
+        # it at env parse like every other bad value
+        with pytest.raises(ValueError, match="kv_blocks must be 0 .* or >= 2"):
+            ServeConfig(page_size=4, kv_blocks=1)
+
+    def test_negative_page_size_fails(self):
+        with pytest.raises(ValueError, match="page_size must be >= 0"):
+            ServeConfig(page_size=-1)
+
+    def test_paging_env_parsed(self):
+        env = {
+            "NEXUS_MODEL_PRESET": "tiny",
+            "NEXUS_PAGE_SIZE": "16",
+            "NEXUS_KV_BLOCKS": "64",
+        }
+        cfg = ServeConfig.from_env(env)
+        assert (cfg.page_size, cfg.kv_blocks) == (16, 64)
+
 
 class TestServeEngine:
     """NEXUS_MODE=serve-engine: the continuous-batching loop under the
@@ -173,6 +196,21 @@ class TestServeEngine:
             run_serve_engine(
                 ServeConfig(model=MnistConfig()), store=_seeded_store(), ctx=CTX
             )
+
+    def test_paged_engine_ledger_protocol(self):
+        """NEXUS_PAGE_SIZE > 0 routes the engine loop through the paged
+        executor (ISSUE 6) under the identical ledger contract."""
+        store = _seeded_store()
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=4, rounds=2, heartbeat_every=2, page_size=4,
+        )
+        summary = run_serve_engine(cfg, store=store, ctx=CTX)
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED
+        assert summary["requests"] == 4
+        assert summary["finished"] == 4
+        assert summary["decoded_tokens_per_second"] > 0
 
     def test_serves_trained_checkpoint(self, tmp_path):
         from tpu_nexus.parallel import MeshSpec

@@ -34,6 +34,8 @@ EXCLUDE = [
 REQUIRED = [
     "tpu_nexus/workload/durability.py",         # checkpoint commit/verify layer
     "tpu_nexus/workload/tensor_checkpoint.py",
+    "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
+    "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/recovery.py",
     "tpu_nexus/supervisor/taxonomy.py",
 ]

@@ -125,6 +125,8 @@ def cached_attention(
     prompt_width: Optional[int] = None,
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
+    block_tables: Optional[jax.Array] = None,
+    logical_limit: Optional[int] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """GQA attention of a short query block against a fixed-size cache.
@@ -146,6 +148,25 @@ def cached_attention(
     ``k8*s`` multiply instead re-materializes a bf16 slab, measured
     SLOWER than the bf16 cache on the unrolled decode path.
 
+    Paged mode (``block_tables`` [B, n_log] int32): ``k``/``v`` arrive in
+    the POOLED block layout ``[num_blocks, page_size, Hkv, D]`` (the
+    serving engine's paged cache) and row ``b``'s logical slot ``s`` lives
+    at physical ``(block_tables[b, s // page_size], s % page_size)``.
+    The pallas kernel walks the table natively (the block-id row rides the
+    scalar prefetch); the XLA fallback GATHERS each row's blocks into the
+    contiguous ``[B, n_log*page_size, Hkv, D]`` view through the SAME
+    table and reuses the masked einsum below, so both paths stay
+    token-identical.  All position semantics (``kv_len``,
+    ``prompt_lengths``) are logical.  ``logical_limit`` truncates the
+    gathered view to the caller's true logical length (the serving
+    engine's ``max_len``): without it the einsum reduces over the
+    block-rounded ``n_log*page_size`` columns, whose different reduction
+    order can flip a near-tied argmax vs a ``max_len``-wide contiguous
+    cache — with it, the XLA paged path is BIT-identical to the
+    contiguous path at equal ``max_len``.  (The pallas kernel needs no
+    limit: fully-dead tail blocks are skipped exactly by the
+    ``pl.when`` clamp, contributing nothing to the online softmax.)
+
     Dispatch (``impl``): ``"auto"`` routes supported shapes on TPU to the
     fused split-KV pallas kernel (ops/decode_attention.py) and everything
     else to the masked XLA einsum below; ``"pallas"`` forces the kernel
@@ -165,12 +186,33 @@ def cached_attention(
         impl = os.environ.get("NEXUS_DECODE_KERNEL", "") or impl
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"unknown decode impl {impl!r}; use auto, pallas, or xla")
-    if impl == "pallas" or (impl == "auto" and decode_supported(q, k, k_scale, v_scale)):
+    if impl == "pallas" or (
+        impl == "auto" and decode_supported(q, k, k_scale, v_scale, block_tables)
+    ):
         return decode_attention(
             q, k, v, kv_len,
             prompt_lengths=prompt_lengths, prompt_width=prompt_width,
-            k_scale=k_scale, v_scale=v_scale,
+            k_scale=k_scale, v_scale=v_scale, block_tables=block_tables,
         )
+
+    if block_tables is not None:
+        # XLA paged fallback: gather each row's physical blocks into the
+        # contiguous logical view [B, n_log*page_size, Hkv, X] through the
+        # SAME table the kernel prefetches, then fall through to the masked
+        # einsum unchanged — the gathered rows at live logical slots are
+        # bit-identical to a contiguous cache's, so the two layouts decode
+        # token-identically.
+        bt = block_tables.astype(jnp.int32)
+        n_log, page = bt.shape[1], k.shape[1]
+        limit = n_log * page if logical_limit is None else int(logical_limit)
+
+        def _gather(pool):
+            g = pool[bt]  # [B, n_log, page, Hkv, X]
+            return g.reshape(bt.shape[0], n_log * page, *pool.shape[2:])[:, :limit]
+
+        k, v = _gather(k), _gather(v)
+        if k_scale is not None:
+            k_scale, v_scale = _gather(k_scale), _gather(v_scale)
 
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
@@ -261,6 +303,8 @@ def decode_step(
     prompt_width: Optional[int] = None,
     unroll_layers: Optional[bool] = None,
     decode_kernel: str = "auto",
+    block_tables: Optional[jax.Array] = None,
+    logical_limit: Optional[int] = None,
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step: ``token`` [B] at scalar WRITE position
     ``pos`` → (logits [B, vocab], updated cache).  Mirrors the training
@@ -284,6 +328,20 @@ def decode_step(
     per-row live lengths ``pos+1`` and the generated-tail window pushed
     past the cache end, in both the XLA and pallas kernels.
 
+    Paged mode (``block_tables`` [B, n_log] int32, per-slot ``pos`` only):
+    the cache is the POOLED block layout ``[L, num_blocks, page_size, Hkv,
+    D]`` (serving's paged cache) and row ``b``'s logical slot ``s`` lives
+    at physical ``(block_tables[b, s // page_size], s % page_size)`` — the
+    per-row write is a scatter through the table, attention reads through
+    :func:`cached_attention`'s paged mode, and every position semantic
+    (``pos``, live lengths) stays logical.  Dead lanes (``pos`` 0, table
+    row all scratch) write block 0, the garbage sink nothing reads
+    unmasked.  Copy-on-write is the CALLER's job: the serving engine COWs
+    any shared block BEFORE the step, so every block a write lands in here
+    is exclusively owned.  ``logical_limit`` (the engine's ``max_len``)
+    keeps the XLA fallback bit-identical to a contiguous cache of that
+    length — see :func:`cached_attention`.
+
     ``decode_kernel``: attention dispatch — ``"auto"`` (fused pallas
     decode kernel on TPU, XLA fallback elsewhere), ``"pallas"``,
     ``"xla"``; the ``NEXUS_DECODE_KERNEL`` env var replaces the ``auto``
@@ -302,7 +360,22 @@ def decode_step(
     ct = cfg.dtype
     b = token.shape[0]
     per_slot = jnp.ndim(pos) == 1
-    max_len = cache["k"].shape[2]
+    paged = block_tables is not None
+    if paged and not per_slot:
+        raise ValueError("paged decode (block_tables) requires per-slot vector pos")
+    bt = block_tables.astype(jnp.int32) if paged else None
+    if paged:
+        # pooled cache [L, num_blocks, page_size, Hkv, D]: the logical slot
+        # axis is virtual, its width is the table row length * page_size
+        page_size = cache["k"].shape[2]
+        logical_len = bt.shape[1] * page_size
+        # per-row write address: logical cursor -> (physical block, offset).
+        # Dead lanes (pos 0, scratch-only table row) resolve to block 0.
+        _phys = jnp.take_along_axis(bt, (pos // page_size)[:, None], axis=1)[:, 0]
+        _off = pos % page_size
+        max_len = logical_len
+    else:
+        max_len = cache["k"].shape[2]
     x = params["embed"]["tokens"].astype(ct)[token][:, None, :]  # [B,1,E]
     if per_slot:
         if prompt_lengths is not None or prompt_width is not None:
@@ -336,7 +409,11 @@ def decode_step(
         # position.  Scalar pos: one dynamic-slice update shared by the
         # batch.  Vector pos (per-slot): a batched scatter — each row lands
         # at its own cursor (out-of-bounds rows are dropped by XLA scatter
-        # semantics; the serving engine never issues them)
+        # semantics; the serving engine never issues them).  Paged: the
+        # scatter goes through the block table — dead lanes all target the
+        # scratch block, whose write order is irrelevant (never read).
+        if paged:
+            return arr.at[li, _phys, _off].set(update[:, 0])
         if per_slot:
             return arr.at[li, jnp.arange(b), pos].set(update[:, 0])
         return jax.lax.dynamic_update_slice(arr, update[None], (li, 0, pos, 0, 0))
@@ -386,6 +463,7 @@ def decode_step(
         o = cached_attention(
             q, ck, cv, att_kv_len,
             prompt_lengths=att_lens, prompt_width=att_width,
+            block_tables=bt, logical_limit=logical_limit,
             impl=decode_kernel, **scales,
         )
         x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
@@ -413,6 +491,148 @@ def decode_step(
         )
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))
+    return logits, cache
+
+
+def extend_step(
+    params: Dict[str, Any],
+    cache: Cache,
+    tokens: jax.Array,
+    start: jax.Array,
+    length: jax.Array,
+    block_tables: jax.Array,
+    cfg: ModelConfig,
+    unroll_layers: Optional[bool] = None,
+    decode_kernel: str = "auto",
+    logical_limit: Optional[int] = None,
+) -> Tuple[jax.Array, Cache]:
+    """Partial prefill through the PAGED cache: the tail half of
+    prefix-sharing admission (tpu_nexus/serving).
+
+    ``tokens`` [B, W] right-padded (``length`` [B] real per row) run at
+    logical positions ``start + [0, W)`` — ``start`` is the shared-prefix
+    length, a traced scalar common to the batch.  Each row's new K/V rows
+    scatter through its ``block_tables`` [B, n_log] row exactly like the
+    paged :func:`decode_step` write (pad rows past ``length`` divert to
+    the scratch block), and attention sees the ALREADY-CACHED prefix
+    ``[0, start)`` — prefilled once by an earlier request and shared by
+    reference — plus the causal window inside the tail: query row ``j``
+    attends logical slots ``<= start + j``, which is exactly
+    :func:`cached_attention`'s multi-query clamp at ``kv_len = start +
+    W``.  Returns each row's LAST-REAL-token logits [B, vocab] (the
+    first-output-token sample, same contract as :func:`prefill`) and the
+    updated pooled cache.
+
+    With ``start = 0`` this IS a paged full prefill; the serving engine
+    still routes no-hit admissions through :func:`prefill` + block scatter
+    because the training forward's flash path beats W sequential-window
+    attention for long prompts — this function's job is the tail, which
+    prefix sharing keeps short.  The pallas kernel serves ``W <= 8``
+    (``MAX_DECODE_Q_LEN``); wider tails take the XLA gather fallback
+    under ``"auto"``.
+
+    COW is the CALLER's job, as in paged :func:`decode_step`: every block
+    a tail row lands in must already be exclusively owned."""
+    cfg = _decode_cfg(cfg)
+    ct = cfg.dtype
+    b, w = tokens.shape
+    bt = block_tables.astype(jnp.int32)
+    page_size = cache["k"].shape[2]
+    start = jnp.asarray(start, jnp.int32).reshape(())
+    length = jnp.asarray(length, jnp.int32).reshape(b)
+    idx = jnp.arange(w, dtype=jnp.int32)  # tail-local position
+    logical = start + idx  # [W], shared across rows
+    # pad rows (i >= length[b]) divert to the scratch block: their KV is
+    # garbage and their logical slots belong to this row's FUTURE decode
+    # tokens — writing them would not corrupt (nothing reads past the live
+    # length), but scratch keeps the owned blocks bit-clean for tests
+    phys = jnp.where(
+        idx[None, :] < length[:, None],
+        jnp.take_along_axis(bt, jnp.broadcast_to((logical // page_size)[None, :], (b, w)), axis=1),
+        0,
+    )  # [B, W]
+    off = jnp.broadcast_to((logical % page_size)[None, :], (b, w))
+    positions = jnp.broadcast_to(logical[None, :], (b, w))
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    kv_quant = "k_s" in cache
+    att_kv_len = start + w  # rows occupy logical [start, start+W)
+    n_layers = cache["k"].shape[0]
+    if unroll_layers is None:
+        unroll_layers = n_layers <= 32
+    x = params["embed"]["tokens"].astype(ct)[tokens]  # [B, W, E]
+
+    def _cache_write(arr, update, li):
+        # update [B, W, Hkv|1, D|1] -> scatter each row's W tail slots
+        # through its block-table row
+        return arr.at[li, phys, off].set(update)
+
+    def _cache_read(arr, li):
+        if isinstance(li, int):
+            return arr[li]
+        return jax.lax.dynamic_index_in_dim(arr, li, 0, keepdims=False)
+
+    def layer_body(x, c, layer, li):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = _rope(q, cos, sin)
+        k = _rope(k, cos, sin)
+        if kv_quant:
+            (k, k_s), (v, v_s) = _quantize_kv(k), _quantize_kv(v)
+            c = dict(
+                c,
+                k_s=_cache_write(c["k_s"], k_s, li),
+                v_s=_cache_write(c["v_s"], v_s, li),
+            )
+        c = dict(
+            c,
+            k=_cache_write(c["k"], k, li),
+            v=_cache_write(c["v"], v, li),
+        )
+        ck = _cache_read(c["k"], li)
+        cv = _cache_read(c["v"], li)
+        scales = (
+            dict(k_scale=_cache_read(c["k_s"], li), v_scale=_cache_read(c["v_s"], li))
+            if kv_quant
+            else {}
+        )
+        o = cached_attention(
+            q, ck, cv, att_kv_len,
+            block_tables=bt, logical_limit=logical_limit,
+            impl=decode_kernel, **scales,
+        )
+        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = _ffn_block(x, layer, cfg)
+        return x, c
+
+    if unroll_layers:
+        c = cache
+        for li in range(n_layers):
+            layer = jax.tree.map(lambda a, _li=li: a[_li], params["layers"])
+            x, c = layer_body(x, c, layer, li)
+        cache = c
+    else:
+
+        def body(carry, xs):
+            x, c = carry
+            layer, li = xs
+            x, c = layer_body(x, c, layer, li)
+            return (x, c), None
+
+        (x, cache), _ = jax.lax.scan(
+            body,
+            (x, cache),
+            (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+        )
+    hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    # each row's last REAL token produces the first output logits (clamp
+    # at 0: a buggy zero length must not wrap to the last pad row)
+    last = jnp.maximum(length - 1, 0)[:, None, None]
+    hid = jnp.take_along_axis(
+        hidden, jnp.broadcast_to(last, (b, 1, hidden.shape[-1])), axis=1
+    )[:, 0]
+    logits = jnp.einsum("be,ev->bv", hid, _head(params, cfg))
     return logits, cache
 
 

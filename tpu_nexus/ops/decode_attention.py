@@ -51,6 +51,18 @@ free ``[B, max_len, Hkv*D]`` reshape, so no per-step cache transpose or
 slab copy is ever materialized.  Scales ``[B, max_len, Hkv, 1]`` are
 transposed to ``[B, Hkv, max_len]`` in XLA (<1% of cache bytes).
 
+**Paged mode** (``block_tables`` [B, n_log] int32): the cache is the
+POOLED block layout ``[num_blocks, page_size, Hkv, D]`` (serving's paged
+cache, the PagedAttention layout) and the KV grid axis walks LOGICAL
+blocks — the per-slot block-table row is the THIRD scalar-prefetch
+operand, and the KV/scale index maps dereference it, so each grid step
+DMAs the physical block its slot actually owns.  Same mask formula (slot
+positions are logical), same dead-block clamping (logical blocks past the
+live length re-fetch the last live PHYSICAL block and the revisit
+optimization elides the DMA), same int8 deferred dequant.  Blocks are
+exactly ``page_size`` rows, so the padded-tail lane case of the
+contiguous path never arises.
+
 Dispatch lives in ``models/generate.py::cached_attention`` (auto with an
 XLA fallback, ``NEXUS_DECODE_KERNEL`` escape hatch); this module only
 validates and runs the kernel.
@@ -89,10 +101,14 @@ def _on_tpu() -> bool:
         return False
 
 
-def decode_supported(q, k, k_scale=None, v_scale=None) -> bool:
+def decode_supported(q, k, k_scale=None, v_scale=None, block_tables=None) -> bool:
     """Shapes the decode kernel handles; callers fall back to XLA
-    otherwise.  No ``max_len`` alignment clause: the KV grid axis masks
-    the tail block, so any cache length works."""
+    otherwise.  No ``max_len`` alignment clause for the CONTIGUOUS cache:
+    the KV grid axis masks the tail block, so any cache length works.
+    Paged mode (``block_tables`` set, ``k`` = the block pool) tiles KV at
+    ``page_size`` per grid step, so the page must satisfy Mosaic's
+    second-minor tiling (32 covers every cache dtype) — tiny test pages
+    (4) route to the XLA gather instead of dying in the Mosaic compiler."""
     b, sq, hq, d = q.shape
     hkv = k.shape[2]
     return (
@@ -100,21 +116,28 @@ def decode_supported(q, k, k_scale=None, v_scale=None) -> bool:
         and d % 128 == 0
         and 1 <= sq <= MAX_DECODE_Q_LEN
         and hq % hkv == 0
+        and (block_tables is None or k.shape[1] % 32 == 0)
         # int8 mode needs both scales; mixed configurations are a caller bug
         and (k_scale is None) == (v_scale is None)
     )
 
 
 def _decode_kernel(
-    lens_ref, meta_ref, q_ref, k_ref, v_ref, *rest,
-    quant: bool, sq: int, group: int, block_k: int, n_kv: int, s_k: int,
-    scale: float,
+    lens_ref, meta_ref, *refs,
+    quant: bool, paged: bool, sq: int, group: int, block_k: int, n_kv: int,
+    s_k: int, scale: float,
 ):
     """One (batch, KV head, KV block) grid step of the online softmax.
 
-    ``rest`` is ``[ks_ref, vs_ref,] o_ref, acc_ref, m_ref, l_ref`` —
-    scale refs present only in int8 mode.  The carry (acc/m/l) persists
-    across the minor-most KV axis; o flushes once on the final KV step."""
+    ``refs`` is ``[bt_ref,] q_ref, k_ref, v_ref, [ks_ref, vs_ref,] o_ref,
+    acc_ref, m_ref, l_ref`` — the block-table prefetch ref present only in
+    paged mode (consumed by the index maps, not the body: slot positions
+    are logical either way), scale refs only in int8 mode.  The carry
+    (acc/m/l) persists across the minor-most KV axis; o flushes once on
+    the final KV step."""
+    if paged:
+        refs = refs[1:]  # bt_ref: index-map-only
+    q_ref, k_ref, v_ref, *rest = refs
     if quant:
         ks_ref, vs_ref, o_ref, acc_ref, m_ref, l_ref = rest
     else:
@@ -200,6 +223,7 @@ def decode_attention(
     k_scale: Optional[jax.Array] = None,
     v_scale: Optional[jax.Array] = None,
     *,
+    block_tables: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
@@ -213,11 +237,34 @@ def decode_attention(
     [B, q_len, Hq, D] in q's dtype.  Contract-identical to the XLA path
     in ``models/generate.py::cached_attention``.
 
+    Paged mode: ``block_tables`` [B, n_log] int32 + the POOLED cache
+    layout ``k``/``v`` [num_blocks, page_size, Hkv, D] (scales
+    [num_blocks, page_size, Hkv, 1]) — row ``b``'s logical slot ``s``
+    lives at physical ``(block_tables[b, s // page_size], s % page_size)``
+    and all position semantics (``kv_len``, ``prompt_lengths``) stay
+    logical.
+
     ``interpret`` defaults to True off-TPU so the kernel is testable on
     the CPU mesh (pallas interpreter mode)."""
     b, sq, hq, d = q.shape
-    s_k, hkv = k.shape[1], k.shape[2]
+    paged = block_tables is not None
+    if paged:
+        page_size, hkv = k.shape[1], k.shape[2]
+        n_log = block_tables.shape[1]
+        s_k = n_log * page_size
+    else:
+        s_k, hkv = k.shape[1], k.shape[2]
     problems = []
+    if paged and block_tables.shape[0] != b:
+        problems.append(
+            f"block_tables rows {block_tables.shape[0]} != batch {b}"
+        )
+    if paged and page_size % 32 and not (interpret or not _on_tpu()):
+        # a page IS the KV tile in paged mode; a misaligned one dies deep
+        # in the Mosaic compiler — name the constraint here instead
+        problems.append(
+            f"page_size {page_size} % 32 != 0 (Mosaic second-minor tiling)"
+        )
     if d % 128 and not (interpret or not _on_tpu()):
         problems.append(f"head_dim {d} % 128 != 0")
     if hq % hkv:
@@ -242,8 +289,13 @@ def decode_attention(
     # handled by Mosaic's internal block padding (the tile is tiny either
     # way — rows <= 64)
     r_pad = max(8, -(-rows // 8) * 8)
-    block_k = min(BLOCK_K, max(32, -(-s_k // 32) * 32))
-    n_kv = -(-s_k // block_k)
+    if paged:
+        # one grid step per LOGICAL block: the physical page is the DMA unit
+        block_k = page_size
+        n_kv = n_log
+    else:
+        block_k = min(BLOCK_K, max(32, -(-s_k // 32) * 32))
+        n_kv = -(-s_k // block_k)
 
     # [B, sq, Hq, D] -> [B, Hkv, sq*group, D]: row = j*group + gi, matching
     # the (hkv, group) head split of the XLA path's reshape
@@ -251,9 +303,14 @@ def decode_attention(
     if r_pad != rows:
         qt = jnp.pad(qt, ((0, 0), (0, 0), (0, r_pad - rows), (0, 0)))
     # the cache is read through a FREE reshape — storage layout untouched,
-    # no per-step transpose/slab copy
-    kf = k.reshape(b, s_k, hkv * d)
-    vf = v.reshape(b, s_k, hkv * d)
+    # no per-step transpose/slab copy.  Paged mode reshapes the POOL the
+    # same way; the batch axis is gone (block tables do the addressing).
+    if paged:
+        kf = k.reshape(k.shape[0], page_size, hkv * d)
+        vf = v.reshape(v.shape[0], page_size, hkv * d)
+    else:
+        kf = k.reshape(b, s_k, hkv * d)
+        vf = v.reshape(b, s_k, hkv * d)
 
     last_pos = (jnp.asarray(kv_len, jnp.int32) - 1).reshape(())
     if prompt_lengths is None:
@@ -267,14 +324,30 @@ def decode_attention(
 
     # dead KV blocks clamp to the last live block: the revisit optimization
     # elides their DMA, so cache traffic tracks kv_len, not max_len
-    def _kv_index(bi, h, ki, lens_ref, meta_ref):
-        return (bi, jnp.minimum(ki, meta_ref[0] // block_k), h)
+    if paged:
+        # dereference the prefetched block-table row: logical grid step ki
+        # of batch row bi fetches its own physical page.  Dead logical
+        # blocks clamp to the last GLOBALLY live logical index — rows past
+        # their own live length hit their scratch-padded table entries,
+        # which is masked compute over an elided (revisited) DMA.
+        def _kv_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
+            return (bt_ref[bi * n_log + jnp.minimum(ki, meta_ref[0] // block_k)], 0, h)
 
-    def _scale_index(bi, h, ki, lens_ref, meta_ref):
-        return (bi, h, jnp.minimum(ki, meta_ref[0] // block_k))
+        def _scale_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
+            return (bt_ref[bi * n_log + jnp.minimum(ki, meta_ref[0] // block_k)], h, 0)
 
-    def _q_index(bi, h, ki, lens_ref, meta_ref):
-        return (bi, h, 0, 0)
+        def _q_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
+            return (bi, h, 0, 0)
+
+    else:
+        def _kv_index(bi, h, ki, lens_ref, meta_ref):
+            return (bi, jnp.minimum(ki, meta_ref[0] // block_k), h)
+
+        def _scale_index(bi, h, ki, lens_ref, meta_ref):
+            return (bi, h, jnp.minimum(ki, meta_ref[0] // block_k))
+
+        def _q_index(bi, h, ki, lens_ref, meta_ref):
+            return (bi, h, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, 1, r_pad, d), _q_index),
@@ -283,8 +356,9 @@ def decode_attention(
     ]
     operands = [qt, kf, vf]
     if quant:
-        # [B, max_len, Hkv, 1] -> [B, Hkv, max_len]: the only non-free
-        # relayout, <1% of the cache bytes (D=128x smaller than values)
+        # [B, max_len, Hkv, 1] -> [B, Hkv, max_len] (paged: [NB, page, Hkv,
+        # 1] -> [NB, Hkv, page]): the only non-free relayout, <1% of the
+        # cache bytes (D=128x smaller than values)
         in_specs += [
             pl.BlockSpec((1, 1, block_k), _scale_index),
             pl.BlockSpec((1, 1, block_k), _scale_index),
@@ -294,14 +368,18 @@ def decode_attention(
             jnp.swapaxes(v_scale[..., 0], 1, 2),
         ]
 
+    prefetch = [lens, meta]
+    if paged:
+        prefetch.append(block_tables.astype(jnp.int32).reshape(-1))
+
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, quant=quant, sq=sq, group=group,
+            _decode_kernel, quant=quant, paged=paged, sq=sq, group=group,
             block_k=block_k, n_kv=n_kv, s_k=s_k, scale=float(scale),
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, r_pad, d), q.dtype),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
+            num_scalar_prefetch=len(prefetch),
             grid=(b, hkv, n_kv),
             in_specs=in_specs,
             out_specs=pl.BlockSpec((1, 1, r_pad, d), _q_index),
@@ -314,12 +392,12 @@ def decode_attention(
         cost_estimate=pl.CostEstimate(
             flops=4 * b * hq * sq * s_k * d,
             # the bandwidth story: K+V live bytes dominate; q/out are noise
-            bytes_accessed=kf.size * kf.dtype.itemsize * 2
+            bytes_accessed=b * s_k * hkv * d * kf.dtype.itemsize * 2
             + qt.size * qt.dtype.itemsize * 2,
             transcendentals=b * hq * sq * s_k,
         ),
         interpret=interpret,
-    )(lens, meta, *operands)
+    )(*prefetch, *operands)
 
     out = out[:, :, :rows].reshape(b, hkv, sq, group, d)
     return out.transpose(0, 2, 1, 3, 4).reshape(b, sq, hq, d)

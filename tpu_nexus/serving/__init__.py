@@ -10,19 +10,36 @@ contract: docs/SERVING.md; launcher wiring: ``NEXUS_MODE=serve-engine``.
 Layering (each module imports only downward):
 
 * ``request``        — Request + the total lifecycle state machine
-* ``cache_manager``  — slot free-list + int8-aware cache buffers
+* ``cache_manager``  — slot free-list, int8-aware cache buffers, and the
+                       paged layer (ISSUE 6): ref-counted KV block
+                       allocator, radix-style prefix index, copy-on-write
+                       composed by PagedCacheManager
 * ``scheduler``      — FIFO admission, prefill-token budget, starvation
-                       guard, bounded queue, deadline sweep
+                       guard, bounded queue, deadline sweep, block gate
 * ``metrics``        — TTFT/TPOT/queue-depth/occupancy/shed/fault counters
+                       + token-occupancy / prefix-hit / COW telemetry
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
-* ``engine``         — ModelExecutor (jitted compute) + ServingEngine (host
-                       loop: fault isolation, deadlines, graceful drain)
+* ``engine``         — ModelExecutor / PagedModelExecutor (jitted compute)
+                       + ServingEngine (host loop: fault isolation,
+                       deadlines, graceful drain, block-table admission)
 """
 
-from tpu_nexus.serving.cache_manager import KVSlotManager, SlotError, init_cache
+from tpu_nexus.serving.cache_manager import (
+    SCRATCH_BLOCK,
+    AdmitPlan,
+    BlockError,
+    KVBlockManager,
+    KVSlotManager,
+    PagedCacheManager,
+    PrefixIndex,
+    SlotError,
+    init_cache,
+    init_paged_cache,
+)
 from tpu_nexus.serving.engine import (
     RETIREMENT_ACTIONS,
     ModelExecutor,
+    PagedModelExecutor,
     ServingEngine,
 )
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
@@ -39,15 +56,22 @@ from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfi
 
 __all__ = [
     "ACTIVE_STATES",
+    "AdmitPlan",
+    "BlockError",
     "DeviceStateLost",
     "FifoScheduler",
     "IllegalTransition",
+    "KVBlockManager",
     "KVSlotManager",
     "ModelExecutor",
+    "PagedCacheManager",
+    "PagedModelExecutor",
+    "PrefixIndex",
     "QueueFull",
     "RETIREMENT_ACTIONS",
     "Request",
     "RequestState",
+    "SCRATCH_BLOCK",
     "SchedulerConfig",
     "ServingEngine",
     "ServingMetrics",
@@ -57,5 +81,6 @@ __all__ = [
     "TERMINAL_STATES",
     "TRANSITIONS",
     "init_cache",
+    "init_paged_cache",
     "percentile",
 ]

@@ -41,11 +41,18 @@ from __future__ import annotations
 import itertools
 import logging
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from tpu_nexus.serving.cache_manager import KVSlotManager, init_cache
+from tpu_nexus.serving.cache_manager import (
+    SCRATCH_BLOCK,
+    AdmitPlan,
+    KVSlotManager,
+    PagedCacheManager,
+    init_cache,
+    init_paged_cache,
+)
 from tpu_nexus.serving.metrics import ServingMetrics
 from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
@@ -91,7 +98,97 @@ def _prefill_buckets(max_len: int) -> List[int]:
     return buckets
 
 
-class ModelExecutor:
+class _ExecutorCommon:
+    """Shared device-side plumbing of the two executors: sampling setup,
+    PRNG key stream, prefill-width bucketing, and the donated-cache fault
+    guard.  Subclasses install ``self.cache`` and implement
+    :meth:`_fresh_cache` (what to reinstall after a fault consumed the
+    donated buffer)."""
+
+    def _init_common(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        num_slots: int,
+        max_len: int,
+        kv_quant: str,
+        decode_kernel: str,
+        temperature: float,
+        top_k: int,
+        top_p: float,
+        seed: int,
+    ):
+        import functools
+
+        import jax
+
+        from tpu_nexus.models.generate import sample_logits
+
+        if decode_kernel not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"unknown decode_kernel mode {decode_kernel!r}; use auto, pallas, or xla"
+            )
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if (top_k or top_p < 1.0) and temperature == 0.0:
+            raise ValueError("top_k/top_p truncation requires temperature > 0")
+        self.params = params
+        self.cfg = cfg
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.kv_quant = kv_quant
+        self.temperature = temperature
+        self._buckets = _prefill_buckets(max_len)
+        self._key = jax.random.PRNGKey(seed)
+        self._jax = jax
+        self._sample = functools.partial(
+            sample_logits,
+            temperature=temperature,
+            top_k=top_k,
+            top_p=top_p,
+        )
+        # donate the cache buffer (arg 1) so XLA updates it in place
+        # instead of copying it every token — the train-step donation
+        # pattern (workload/train.py).  CPU donation is an unimplemented
+        # no-op that only logs warnings, so gate on accelerator backends.
+        self._donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
+        return jax
+
+    def _next_key(self):
+        if self.temperature == 0.0:
+            return self._key  # greedy ignores it; skip the split dispatch
+        self._key, sub = self._jax.random.split(self._key)
+        return sub
+
+    def _bucket(self, prompt_len: int) -> int:
+        for w in self._buckets:
+            if w >= prompt_len:
+                return w
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds cache max_len {self.max_len}"
+        )
+
+    def _fresh_cache(self):
+        raise NotImplementedError  # pragma: no cover - subclass contract
+
+    def _guard_cache(self, exc: RuntimeError) -> None:
+        """After a faulted jitted call: if the DONATED cache buffer was
+        consumed by the failed execution (TPU backends donate it for
+        in-place updates), every retry would die on "Array has been
+        deleted" — an unclassified error that would unwind the whole
+        engine.  Reinitialize a fresh cache (so the engine can keep
+        serving NEW admissions) and raise the non-retryable
+        :class:`DeviceStateLost` signal instead; with the state intact
+        (CPU, or fault before dispatch) re-raise for normal recovery."""
+        leaves = self._jax.tree.leaves(self.cache)
+        if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in leaves):
+            self.cache = self._fresh_cache()
+            raise DeviceStateLost(exc) from exc
+        raise exc
+
+
+class ModelExecutor(_ExecutorCommon):
     """Device half of the engine: cache + params + three jitted fns.
 
     ``begin(slot, prompt)`` prefills one request (prompt right-padded to a
@@ -120,37 +217,14 @@ class ModelExecutor:
         top_p: float = 1.0,
         seed: int = 0,
     ) -> None:
-        import functools
+        from tpu_nexus.models.generate import decode_step, prefill
 
-        import jax
-
-        from tpu_nexus.models.generate import decode_step, prefill, sample_logits
-
-        if decode_kernel not in ("auto", "pallas", "xla"):
-            raise ValueError(
-                f"unknown decode_kernel mode {decode_kernel!r}; use auto, pallas, or xla"
-            )
-        if temperature < 0.0:
-            raise ValueError(f"temperature must be >= 0, got {temperature}")
-        if (top_k or top_p < 1.0) and temperature == 0.0:
-            raise ValueError("top_k/top_p truncation requires temperature > 0")
-        self.params = params
-        self.cfg = cfg
-        self.num_slots = num_slots
-        self.max_len = max_len
-        self.kv_quant = kv_quant
-        self.temperature = temperature
-        self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
-        self._buckets = _prefill_buckets(max_len)
-        self._key = jax.random.PRNGKey(seed)
-        self._jax = jax
-
-        self._sample = functools.partial(
-            sample_logits,
-            temperature=temperature,
-            top_k=top_k,
-            top_p=top_p,
+        jax = self._init_common(
+            params, cfg, num_slots=num_slots, max_len=max_len,
+            kv_quant=kv_quant, decode_kernel=decode_kernel,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
         )
+        self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
 
         def _begin(params, cache, padded, lengths, slot, key):
             # prefill + slot insert + first-token sample in ONE jitted call
@@ -170,13 +244,7 @@ class ModelExecutor:
             )
             return cache, self._sample(logits, key)
 
-        # donate the cache buffer (arg 1) so XLA updates the [L, slots,
-        # max_len, Hkv, D] stack in place instead of copying it every
-        # token — the train-step donation pattern (workload/train.py).
-        # CPU donation is an unimplemented no-op that only logs warnings,
-        # so gate on the accelerator backends.
-        donate = (1,) if jax.default_backend() in ("tpu", "axon") else ()
-        self._begin = jax.jit(_begin, donate_argnums=donate)
+        self._begin = jax.jit(_begin, donate_argnums=self._donate)
 
         def _step(params, cache, tokens, cursors, key):
             logits, cache = decode_step(
@@ -184,38 +252,10 @@ class ModelExecutor:
             )
             return self._sample(logits, key), cache
 
-        self._step = jax.jit(_step, donate_argnums=donate)
+        self._step = jax.jit(_step, donate_argnums=self._donate)
 
-    def _next_key(self):
-        if self.temperature == 0.0:
-            return self._key  # greedy ignores it; skip the split dispatch
-        self._key, sub = self._jax.random.split(self._key)
-        return sub
-
-    def _guard_cache(self, exc: RuntimeError) -> None:
-        """After a faulted jitted call: if the DONATED cache buffer was
-        consumed by the failed execution (TPU backends donate it for
-        in-place updates), every retry would die on "Array has been
-        deleted" — an unclassified error that would unwind the whole
-        engine.  Reinitialize a fresh cache (so the engine can keep
-        serving NEW admissions) and raise the non-retryable
-        :class:`DeviceStateLost` signal instead; with the state intact
-        (CPU, or fault before dispatch) re-raise for normal recovery."""
-        leaves = self._jax.tree.leaves(self.cache)
-        if any(getattr(leaf, "is_deleted", lambda: False)() for leaf in leaves):
-            self.cache = init_cache(
-                self.cfg, self.num_slots, self.max_len, self.kv_quant
-            )
-            raise DeviceStateLost(exc) from exc
-        raise exc
-
-    def _bucket(self, prompt_len: int) -> int:
-        for w in self._buckets:
-            if w >= prompt_len:
-                return w
-        raise ValueError(
-            f"prompt length {prompt_len} exceeds cache max_len {self.max_len}"
-        )
+    def _fresh_cache(self):
+        return init_cache(self.cfg, self.num_slots, self.max_len, self.kv_quant)
 
     def begin(self, slot: int, prompt: np.ndarray) -> int:
         """Prefill ``prompt`` into ``slot``; returns the first token."""
@@ -253,12 +293,223 @@ class ModelExecutor:
         return np.asarray(next_tokens)
 
 
+class PagedModelExecutor(_ExecutorCommon):
+    """Device half of the PAGED engine (ISSUE 6): the KV cache is a pool
+    of ``page_size``-token blocks ``[L, num_blocks, page_size, Hkv, D]``
+    and each slot reaches its rows through a per-slot block-table row —
+    HBM occupancy tracks ACTUAL tokens, not ``slots × max_len``, and
+    shared-prefix admissions reuse already-prefilled blocks by reference
+    (the host-side accounting lives in
+    :class:`~tpu_nexus.serving.cache_manager.PagedCacheManager`, owned by
+    the engine).
+
+    Entry points (all presenting the same executor contract the fault
+    wrapper and recovery policy already speak):
+
+    * ``begin(slot, prompt, table_row=..., tail_start=..., copies=...)``
+      — apply the admission's COW block copies, then prefill ONLY the
+      non-shared tail: ``tail_start == 0`` routes through the fused flash
+      prefill + block scatter (one jit per prompt bucket), a prefix hit
+      through :func:`~tpu_nexus.models.generate.extend_step` (one jit per
+      tail bucket) which attends to the shared blocks in place.  Returns
+      the first sampled token.
+    * ``step(tokens, cursors, tables)`` — one decode iteration over all
+      slots through the paged :func:`decode_step` (table-walking pallas
+      kernel on TPU, gather fallback elsewhere).
+
+    ``prefilled_tokens`` audits how many prompt tokens actually ran
+    through a forward pass — the shared-prefix bench's "prefill shared
+    tokens exactly once" evidence."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        num_slots: int,
+        max_len: int,
+        page_size: int,
+        num_blocks: int = 0,
+        kv_quant: str = "",
+        decode_kernel: str = "auto",
+        temperature: float = 0.0,
+        top_k: int = 0,
+        top_p: float = 1.0,
+        seed: int = 0,
+    ) -> None:
+        from tpu_nexus.models.generate import decode_step, extend_step, prefill
+        from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
+
+        jax = self._init_common(
+            params, cfg, num_slots=num_slots, max_len=max_len,
+            kv_quant=kv_quant, decode_kernel=decode_kernel,
+            temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+        )
+        jnp = jax.numpy
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.page_size = page_size
+        self.blocks_per_slot = -(-max_len // page_size)
+        if num_blocks == 0:
+            # full-occupancy default: every slot can hold max_len tokens
+            # simultaneously (+ the scratch block) — the like-for-like
+            # HBM budget of the contiguous cache.  Overcommit (fewer
+            # blocks than slots×max_len) is the paging win: pass an
+            # explicit num_blocks sized to the HBM you actually have.
+            num_blocks = 1 + num_slots * self.blocks_per_slot
+        self.num_blocks = num_blocks
+        self.cache = init_paged_cache(cfg, num_blocks, page_size, kv_quant)
+        #: prompt tokens that actually ran through a prefill/extend
+        #: forward; shared-prefix tokens never count here
+        self.prefilled_tokens = 0
+
+        def _begin(params, cache, padded, lengths, bt_row, key):
+            # no prefix hit: the fused flash prefill at the BUCKET width,
+            # then one scatter of the rows through the block-table row
+            # (pad rows divert to the scratch block)
+            row_cache, logits = prefill(
+                params, padded, cfg, max_len=padded.shape[1],
+                prompt_lengths=lengths, kv_quant=kv_quant,
+            )
+            idx = jnp.arange(padded.shape[1], dtype=jnp.int32)
+            phys = jnp.where(
+                idx < lengths[0], bt_row[idx // page_size], SCRATCH_BLOCK
+            )
+            off = idx % page_size
+            cache = {
+                name: arr.at[:, phys, off].set(row_cache[name][:, 0])
+                for name, arr in cache.items()
+            }
+            return cache, self._sample(logits, key)
+
+        self._begin = jax.jit(_begin, donate_argnums=self._donate)
+
+        def _extend(params, cache, padded, start, lengths, bt_row, key):
+            # prefix hit: run only the tail, attending to the shared
+            # blocks through the table.  The pallas kernel serves tails
+            # <= MAX_DECODE_Q_LEN; a pinned "pallas" falls back to the
+            # XLA gather for wider tails instead of failing validation.
+            kern = decode_kernel
+            if padded.shape[1] > MAX_DECODE_Q_LEN and kern == "pallas":
+                kern = "xla"
+            logits, cache = extend_step(
+                params, cache, padded, start, lengths, bt_row[None], cfg,
+                decode_kernel=kern, logical_limit=max_len,
+            )
+            return cache, self._sample(logits, key)
+
+        self._extend = jax.jit(_extend, donate_argnums=self._donate)
+
+        def _step(params, cache, tokens, cursors, tables, key):
+            logits, cache = decode_step(
+                params, cache, tokens, cursors, cfg,
+                decode_kernel=decode_kernel, block_tables=tables,
+                logical_limit=max_len,
+            )
+            return self._sample(logits, key), cache
+
+        self._step = jax.jit(_step, donate_argnums=self._donate)
+
+        def _cow(cache, src, dst):
+            # copy-on-write block copy: one whole-block slice per leaf
+            return {
+                name: arr.at[:, dst].set(arr[:, src])
+                for name, arr in cache.items()
+            }
+
+        self._cow = jax.jit(
+            _cow, donate_argnums=(0,) if self._donate else ()
+        )
+
+    def _fresh_cache(self):
+        return init_paged_cache(
+            self.cfg, self.num_blocks, self.page_size, self.kv_quant
+        )
+
+    def begin(
+        self,
+        slot: int,
+        prompt: np.ndarray,
+        *,
+        table_row: Optional[np.ndarray] = None,
+        tail_start: int = 0,
+        copies: Sequence[Tuple[int, int, int]] = (),
+    ) -> int:
+        """Prefill ``prompt``'s non-shared tail through ``table_row``;
+        returns the first token.  ``copies`` are the admission's COW
+        ``(src, dst, logical)`` block copies, applied before any write.
+        ``slot`` is accepted for executor-contract compatibility — the
+        paged cache addresses rows by block, not by slot."""
+        del slot  # the block table, not the lane id, addresses the cache
+        jnp = self._jax.numpy
+        if table_row is None:
+            raise ValueError("paged begin requires the admission's table_row")
+        try:
+            for src, dst, _logical in copies:
+                self.cache = self._cow(
+                    self.cache,
+                    jnp.asarray(src, jnp.int32),
+                    jnp.asarray(dst, jnp.int32),
+                )
+            tail = np.asarray(prompt, np.int32).reshape(-1)[tail_start:]
+            t = int(tail.shape[0])
+            width = self._bucket(max(t, 1))
+            padded = np.zeros((1, width), np.int32)
+            padded[0, :t] = tail
+            row = jnp.asarray(np.asarray(table_row, np.int32))
+            if tail_start == 0:
+                self.cache, first = self._begin(
+                    self.params, self.cache, jnp.asarray(padded),
+                    jnp.asarray([t], jnp.int32), row, self._next_key(),
+                )
+            else:
+                self.cache, first = self._extend(
+                    self.params, self.cache, jnp.asarray(padded),
+                    jnp.asarray(tail_start, jnp.int32),
+                    jnp.asarray([t], jnp.int32), row, self._next_key(),
+                )
+            self.prefilled_tokens += t
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return int(first[0])
+
+    def step(
+        self, tokens: np.ndarray, cursors: np.ndarray, tables: np.ndarray
+    ) -> np.ndarray:
+        """One decode iteration over all slots -> next token per slot."""
+        jnp = self._jax.numpy
+        try:
+            next_tokens, self.cache = self._step(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(cursors, jnp.int32),
+                jnp.asarray(tables, jnp.int32),
+                self._next_key(),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return np.asarray(next_tokens)
+
+
 class ServingEngine:
     """Host half: the continuous-batching state machine (see module doc).
 
     ``executor`` must expose ``num_slots``, ``max_len``, ``begin(slot,
     prompt) -> first_token`` and ``step(tokens, cursors) -> tokens`` —
     :class:`ModelExecutor` in production, a fake in the invariant tests.
+
+    PAGED mode (ISSUE 6): an executor additionally exposing ``page_size``
+    and ``num_blocks`` (:class:`PagedModelExecutor`) flips the engine to
+    block-granular admission — it owns a
+    :class:`~tpu_nexus.serving.cache_manager.PagedCacheManager`, gates the
+    scheduler on block availability instead of slot count, builds each
+    admission's block-table row (sharing cached prefix blocks by
+    reference, reserving + applying copy-on-write for a partial match),
+    registers successful prompts in the prefix index, and releases block
+    references at retirement.  ``begin``/``step`` then carry the table
+    operands (``table_row``/``tail_start``/``copies`` kwargs and the
+    ``tables`` step argument).
     """
 
     def __init__(
@@ -273,6 +524,36 @@ class ServingEngine:
     ) -> None:
         self.executor = executor
         self.slots = KVSlotManager(executor.num_slots, executor.max_len)
+        #: block-granular accounting when the executor is paged (exposes
+        #: page_size/num_blocks); None keeps the slot-granular contract
+        page_size = int(getattr(executor, "page_size", 0) or 0)
+        self.paged: Optional[PagedCacheManager] = (
+            PagedCacheManager(executor.num_blocks, page_size, executor.max_len)
+            if page_size
+            else None
+        )
+        #: per-slot logical->physical block rows (scratch-padded), the
+        #: decode step's table operand; all-scratch for inactive slots
+        self._tables = (
+            np.full(
+                (executor.num_slots, self.paged.blocks_per_slot),
+                SCRATCH_BLOCK,
+                np.int32,
+            )
+            if self.paged is not None
+            else None
+        )
+        #: admission plans built by the scheduler gate, consumed by
+        #: _admit; the generation snapshot detects plans that straddled a
+        #: DeviceStateLost reset (their shared blocks' device content is
+        #: gone, so they re-plan against the cleared index)
+        self._plans: Dict[str, Tuple[AdmitPlan, int]] = {}
+        #: (cow copies, shared tokens) per prepared admission, emitted to
+        #: metrics only after its begin SUCCEEDS
+        self._pending_stats: Dict[str, Tuple[int, int]] = {}
+        #: (request_id, probe) handed from _paged_cost to _paged_gate so
+        #: one head's budget pricing and admission share a single trie walk
+        self._gate_probe: Optional[Tuple[str, Any]] = None
         self.scheduler = scheduler or FifoScheduler()
         self.metrics = metrics or ServingMetrics()
         self._clock = clock
@@ -327,6 +608,12 @@ class ServingEngine:
             raise ValueError(
                 f"request {rid}: prompt {req.prompt_len} + max_new_tokens "
                 f"{max_new_tokens} exceeds cache max_len {self.slots.max_len}"
+            )
+        if self.paged is not None and not self.paged.fits(req.total_len):
+            raise ValueError(
+                f"request {rid}: {self.paged.blocks_needed(req.total_len)} KV "
+                f"blocks needed exceeds the pool's {self.paged.usable_blocks} "
+                "usable blocks — it could never be admitted"
             )
         if self.draining:
             self.metrics.shed("draining")
@@ -394,7 +681,7 @@ class ServingEngine:
         if (
             not self.draining
             and self.scheduler.head_starving()
-            and self.slots.free_count == 0
+            and self._admission_blocked()
         ):
             victim_slot = self.slots.eviction_candidate()
             if victim_slot is not None:
@@ -416,9 +703,7 @@ class ServingEngine:
         next_tokens = None
         while self._active:
             try:
-                next_tokens = self._dispatch(
-                    lambda: self.executor.step(self._tokens, self._cursors)
-                )
+                next_tokens = self._dispatch(self._step_thunk)
                 break
             except DeviceStateLost as lost:
                 self._fail_batch(lost)
@@ -450,8 +735,19 @@ class ServingEngine:
                     self._retire(req, RequestState.EVICTED, cause=CAUSE_OVERFLOW)
 
         self.scheduler.tick()
+        if self.paged is not None:
+            # HBM actually held: blocks in use (live requests + cached
+            # prefixes), block-granular — the number paging shrinks
+            live_tokens = self.paged.used_blocks * self.paged.page_size
+            token_capacity = self.paged.token_capacity
+        else:
+            # rows actually written vs the slots × max_len the slot-
+            # granular cache RESERVES — the gap is the paging headroom
+            live_tokens = int(self._cursors.sum())
+            token_capacity = self.slots.num_slots * self.slots.max_len
         self.metrics.step_gauges(
-            self.scheduler.pending, self.slots.used_count, self.slots.num_slots
+            self.scheduler.pending, self.slots.used_count, self.slots.num_slots,
+            live_tokens=live_tokens, token_capacity=token_capacity,
         )
         return {
             "admitted": admitted,
@@ -538,21 +834,129 @@ class ServingEngine:
             self.metrics.step_recovered(recovered)
         return result
 
+    def _step_thunk(self):
+        """The decode dispatch the fault policy retries — paged mode adds
+        the per-slot block tables as the third step operand."""
+        if self.paged is None:
+            return self.executor.step(self._tokens, self._cursors)
+        return self.executor.step(self._tokens, self._cursors, self._tables)
+
+    def _admission_blocked(self) -> bool:
+        """Why is the starving queue head not getting in — no free slot,
+        or (paged) not enough free/reclaimable blocks for it?  Gates the
+        starvation guard: reclaiming the youngest running request frees
+        both its slot and its block references."""
+        if self.slots.free_count == 0:
+            return True
+        if self.paged is None:
+            return False
+        head = self.scheduler.head()
+        assert head is not None  # head_starving() => nonempty
+        return not self.paged.can_admit(head.prompt, head.total_len)
+
+    def _paged_gate(self, req: Request) -> bool:
+        """Scheduler admission gate in paged mode: admit iff the block
+        pool can host the request, EAGERLY building its admission plan
+        (pinning shared prefix blocks, reserving the COW copy, allocating
+        the exclusive tail) so consecutive admissions of one batch see
+        each other's allocations.  Safe to be side-effectful: a True
+        return guarantees the scheduler pops the request this call
+        (scheduler.admit contract), and _prepare_begin consumes the plan."""
+        assert self.paged is not None
+        if self._gate_probe is not None and self._gate_probe[0] == req.request_id:
+            probe = self._gate_probe[1]  # _paged_cost already walked the trie
+        else:
+            probe = self.paged.index.lookup(req.prompt)
+        if not self.paged.can_admit(req.prompt, req.total_len, probe=probe):
+            return False
+        plan = self.paged.admit(req.request_id, req.prompt, req.total_len, probe=probe)
+        self._plans[req.request_id] = (plan, self.paged.generation)
+        return True
+
+    def _paged_cost(self, req: Request) -> int:
+        """Budget price of one head = the prefill work it would ACTUALLY
+        run: its prompt minus the cached shared prefix (shared tokens are
+        served by block reference, not prefill).  The probe is cached for
+        :meth:`_paged_gate`, which the scheduler calls immediately after
+        for the same head — nothing touches the trie in between."""
+        assert self.paged is not None
+        probe = self.paged.index.lookup(req.prompt)
+        self._gate_probe = (req.request_id, probe)
+        return req.prompt_len - probe.shared_len
+
+    def _prepare_begin(self, slot: int, req: Request) -> Optional[Callable[[], int]]:
+        """Build the executor.begin thunk for one admission.  Slot-
+        granular: the classic (slot, prompt) call.  Paged: consume the
+        gate's plan — re-planning first if a DeviceStateLost reset
+        invalidated it (shared device content is gone; None when even a
+        shareless re-plan no longer fits, the caller retires) — install
+        the slot's block-table row, copy-on-write any shared block the
+        tail prefill will land in, and hand the executor the table
+        operands.  The COW copies re-apply idempotently under the fault
+        policy's retries.
+
+        The plan is also RE-PROBED against the prefix index here: gate
+        plans for one admission batch are all built before any prefill
+        runs, so when an earlier admission of the SAME batch registered
+        this prompt's prefix (the burst fan-out case — N copies of one
+        system prompt submitted together), the stale plan would prefill
+        tokens that are now cached.  A strictly longer match releases the
+        plan and re-admits: the re-plan shares more and owns less, so it
+        can only need FEWER blocks than the ones just released."""
+        if self.paged is None:
+            return lambda: self.executor.begin(slot, req.prompt)
+        plan, generation = self._plans.pop(req.request_id)
+        if generation != self.paged.generation:
+            self.paged.release(req.request_id)
+            if not self.paged.can_admit(req.prompt, req.total_len):
+                return None
+            plan = self.paged.admit(req.request_id, req.prompt, req.total_len)
+        else:
+            probe = self.paged.index.lookup(req.prompt)
+            if probe.shared_len > plan.shared_tokens:
+                # release only touches the allocator, never the trie, so
+                # the probe stays current across it
+                self.paged.release(req.request_id)
+                plan = self.paged.admit(
+                    req.request_id, req.prompt, req.total_len, probe=probe
+                )
+        copies = self.paged.prepare_write(
+            req.request_id,
+            plan.block_row,
+            range(plan.tail_start // self.paged.page_size, plan.n_blocks),
+        )
+        self._tables[slot] = plan.block_row
+        # reuse metrics are emitted by _admit only AFTER the begin
+        # succeeds (same discipline as register_prompt): a FAILED prefill
+        # must not count shared tokens that were never served
+        self._pending_stats[req.request_id] = (len(copies), plan.shared_tokens)
+        row = plan.block_row
+        return lambda: self.executor.begin(
+            slot, req.prompt,
+            table_row=row, tail_start=plan.tail_start, copies=copies,
+        )
+
     def _admit(self) -> int:
-        admitted = self.scheduler.admit(self.slots.free_count)
+        gate = self._paged_gate if self.paged is not None else None
+        cost = self._paged_cost if self.paged is not None else None
+        admitted = self.scheduler.admit(self.slots.free_count, gate, cost)
         for req in admitted:
             slot = self.slots.allocate(req.request_id)
             assert slot is not None, "scheduler admitted beyond free slots"
             req.slot = slot
             req.transition(RequestState.PREFILLING)
             self.metrics.queue_wait(self._clock() - req.submitted_at)
+            begin = self._prepare_begin(slot, req)
+            if begin is None:
+                # the admission plan straddled a device reset and the
+                # shareless re-plan no longer fits the pool
+                self._retire(req, RequestState.FAILED, cause="device-state-lost")
+                continue
             try:
                 # same recovery policy as the decode step; a prefill fault
                 # implicates exactly ONE request — this one.  Transient
                 # causes re-run the begin itself (backoff + jitter inside).
-                first_token = self._dispatch(
-                    lambda slot=slot, req=req: self.executor.begin(slot, req.prompt)
-                )
+                first_token = self._dispatch(begin)
             except DeviceStateLost as lost:
                 self._fail_batch(lost, extra=req)
                 continue
@@ -564,6 +968,18 @@ class ServingEngine:
                 )
                 self._retire(req, RequestState.FAILED, cause=fault.cause)
                 continue
+            if self.paged is not None:
+                # cache the prompt's full blocks for future admissions —
+                # only now, after the prefill that filled them succeeded —
+                # and only now count the admission's reuse telemetry
+                self.paged.register_prompt(
+                    req.request_id, req.prompt, self._tables[slot]
+                )
+                n_cow, shared = self._pending_stats.pop(req.request_id, (0, 0))
+                if n_cow:
+                    self.metrics.blocks_cow(n_cow)
+                if shared:
+                    self.metrics.prefix_hit(shared)
             req.emit(first_token, self._clock())
             self.metrics.first_token(req)
             if req.done:  # max_new_tokens == 1: prefill produced everything
@@ -593,6 +1009,12 @@ class ServingEngine:
         self.metrics.step_fault(cause, 0)
         for req in victims:
             self._retire(req, RequestState.FAILED, cause=cause)
+        if self.paged is not None:
+            # the executor reinstalled a ZEROED cache: every cached prefix
+            # is garbage now — drop the whole index and invalidate any
+            # outstanding admission plan (generation bump), or the next
+            # prefix hit would serve zeros as a shared prompt
+            self.paged.reset()
 
     def _retire(self, req: Request, terminal_state: str, cause: str = "") -> None:
         """Retire ``req`` into ``terminal_state``: transition, release the
@@ -610,6 +1032,15 @@ class ServingEngine:
             self.slots.free(req.slot)
             self._tokens[req.slot] = 0
             self._cursors[req.slot] = 0
+            if self._tables is not None:
+                self._tables[req.slot] = SCRATCH_BLOCK
+        if self.paged is not None:
+            self._plans.pop(req.request_id, None)  # un-begun admission
+            self._pending_stats.pop(req.request_id, None)  # failed begin
+            if self.paged.owns(req.request_id):
+                # drop every block reference: exclusive blocks free now,
+                # index-cached prefix blocks stay for future admissions
+                self.paged.release(req.request_id)
         self.metrics.retired_request(req, action)
         self.requests.pop(req.request_id, None)  # bound live-request memory
         self.retired.append(req)

@@ -32,7 +32,7 @@ from __future__ import annotations
 
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, List, Optional
+from typing import Callable, Deque, List, Optional
 
 from tpu_nexus.serving.request import Request, RequestState
 
@@ -113,6 +113,12 @@ class FifoScheduler:
             self._queue = deque(r for r in self._queue if not r.past_deadline(now))
         return expired
 
+    def head(self) -> Optional[Request]:
+        """O(1) peek at the queue head (the paged engine's per-step
+        starvation probe reads it; ``queued_requests`` would copy the
+        whole queue on the decode hot path)."""
+        return self._queue[0] if self._queue else None
+
     def queued_requests(self) -> List[Request]:
         """Snapshot of the queue, FIFO order — diagnostics only (the
         not-drained failure message names who is stuck where)."""
@@ -126,19 +132,43 @@ class FifoScheduler:
         self._queue.clear()
         return drained
 
-    def admit(self, free_slots: int) -> List[Request]:
+    def admit(
+        self,
+        free_slots: int,
+        gate: Optional[Callable[[Request], bool]] = None,
+        cost: Optional[Callable[[Request], int]] = None,
+    ) -> List[Request]:
         """Pop up to ``free_slots`` requests FIFO, stopping once the
         prefill-token budget is spent — except the first admission, which
-        is unconditional (the budget floor)."""
+        is unconditional (the budget floor).
+
+        ``gate`` is the paged engine's block-availability check (ISSUE 6):
+        admission stops at the first head the gate rejects — strict FIFO,
+        no skip-ahead, so a big request can never be starved by small ones
+        slipping past it.  The gate is consulted exactly once per POPPED
+        request (a True return means the head is admitted in this call),
+        so a resource-reserving gate observes every prior admission of the
+        same batch.
+
+        ``cost`` prices a head against the budget (default: its full
+        prompt length).  The paged engine charges only the NON-SHARED
+        prefill tail — the budget bounds actual prefill work interleaved
+        per step, and a prefix hit's shared tokens are served by block
+        reference, so a long shared prompt must not serialize a fan-out
+        burst to one admission per step.  ``cost`` runs BEFORE ``gate``
+        for each head."""
         admitted: List[Request] = []
         budget = self.cfg.prefill_token_budget
         while self._queue and len(admitted) < free_slots:
             head = self._queue[0]
-            if admitted and head.prompt_len > budget:
+            head_cost = cost(head) if cost is not None else head.prompt_len
+            if admitted and head_cost > budget:
+                break
+            if gate is not None and not gate(head):
                 break
             self._queue.popleft()
             admitted.append(head)
-            budget -= head.prompt_len
+            budget -= head_cost
         self.admitted_order.extend(r.request_id for r in admitted)
         return admitted
 

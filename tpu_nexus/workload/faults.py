@@ -258,6 +258,14 @@ class FaultyExecutor:
     def max_len(self):
         return self.inner.max_len
 
+    def __getattr__(self, name):
+        # everything else falls through to the wrapped executor so the
+        # wrapper stays transparent to executor-surface growth — the paged
+        # engine reads page_size/num_blocks/prefilled_tokens through it
+        if name == "inner":  # guard: never recurse during __init__
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
     def _in_window(self, count: int, target: Optional[int]) -> bool:
         if target is None:
             return False
@@ -274,19 +282,22 @@ class FaultyExecutor:
         # slow-step: delay, then proceed normally
         self._sleep(self.slow_s)
 
-    def begin(self, slot, prompt):
+    def begin(self, slot, prompt, **kwargs):
+        # kwargs pass through untouched: the paged executor's table_row/
+        # tail_start/copies ride the same fault-injection boundary
         count = self.begin_calls
         self.begin_calls += 1
         if self._in_window(count, self.at_begin):
             self._fire()
-        return self.inner.begin(slot, prompt)
+        return self.inner.begin(slot, prompt, **kwargs)
 
-    def step(self, tokens, cursors):
+    def step(self, tokens, cursors, *args):
+        # *args pass through untouched: the paged engine's block tables
         count = self.step_calls
         self.step_calls += 1
         if self._in_window(count, self.at_step):
             self._fire()
-        return self.inner.step(tokens, cursors)
+        return self.inner.step(tokens, cursors, *args)
 
 
 def _flip_committed_leaf(step_dir: str) -> str:

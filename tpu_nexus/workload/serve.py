@@ -90,6 +90,17 @@ class ServeConfig:
     #: preemption: in-flight requests get this many seconds to finish
     #: before being evicted with an honest cause (NEXUS_DRAIN_GRACE_S)
     drain_grace_s: float = 5.0
+    #: engine mode only — KV paging (ISSUE 6): > 0 switches the engine to
+    #: the paged executor with this many tokens per KV block (block-table
+    #: decode, ref-counted shared-prefix reuse, copy-on-write; see
+    #: docs/SERVING.md).  0 = contiguous whole-row slots (NEXUS_PAGE_SIZE)
+    page_size: int = 0
+    #: engine mode only, paged only — physical KV block count (the HBM
+    #: budget: ``kv_blocks × page_size`` cache rows + 1 scratch block).
+    #: 0 = full occupancy (every slot can hold max_len, no overcommit —
+    #: the like-for-like budget of the contiguous cache); set it BELOW
+    #: that to overcommit on prefix sharing (NEXUS_KV_BLOCKS)
+    kv_blocks: int = 0
 
     def __post_init__(self) -> None:
         # value validation lives HERE, not in the run loops: a bad env
@@ -125,11 +136,24 @@ class ServeConfig:
                 raise ValueError(
                     f"{field_name} must be >= 1, got {getattr(self, field_name)}"
                 )
-        for field_name in ("deadline_s", "queue_limit", "drain_grace_s"):
+        for field_name in ("deadline_s", "queue_limit", "drain_grace_s", "page_size", "kv_blocks"):
             if getattr(self, field_name) < 0:
                 raise ValueError(
                     f"{field_name} must be >= 0, got {getattr(self, field_name)}"
                 )
+        if self.kv_blocks and not self.page_size:
+            raise ValueError(
+                "kv_blocks (NEXUS_KV_BLOCKS) requires page_size "
+                "(NEXUS_PAGE_SIZE) > 0 — the block budget is meaningless "
+                "without paging"
+            )
+        if self.kv_blocks == 1:
+            # init_paged_cache needs scratch block 0 + >= 1 usable; fail at
+            # parse like every other bad env value, not mid-run
+            raise ValueError(
+                "kv_blocks must be 0 (full occupancy) or >= 2 "
+                "(scratch block 0 + one usable), got 1"
+            )
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
@@ -154,6 +178,8 @@ class ServeConfig:
             deadline_s=float(e.get("NEXUS_DEADLINE_S", "0")),
             queue_limit=int(e.get("NEXUS_QUEUE_LIMIT", "0")),
             drain_grace_s=float(e.get("NEXUS_DRAIN_GRACE_S", "5.0")),
+            page_size=int(e.get("NEXUS_PAGE_SIZE", "0")),
+            kv_blocks=int(e.get("NEXUS_KV_BLOCKS", "0")),
         )
 
 
@@ -355,6 +381,7 @@ def _serve_engine_loop(
     from tpu_nexus.core.telemetry import StatsdClient
     from tpu_nexus.serving import (
         ModelExecutor,
+        PagedModelExecutor,
         QueueFull,
         RequestState,
         ServingEngine,
@@ -374,9 +401,7 @@ def _serve_engine_loop(
 
     from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
 
-    executor = ModelExecutor(
-        params,
-        mcfg,
+    executor_kwargs = dict(
         num_slots=cfg.batch_size,
         max_len=cfg.prompt_len + cfg.gen_tokens,
         kv_quant=cfg.quantize_kv,
@@ -386,6 +411,15 @@ def _serve_engine_loop(
         top_p=cfg.top_p,
         seed=cfg.seed,
     )
+    if cfg.page_size:
+        # paged KV (NEXUS_PAGE_SIZE > 0): block-table decode + ref-counted
+        # shared-prefix reuse; NEXUS_KV_BLOCKS caps the physical pool
+        executor = PagedModelExecutor(
+            params, mcfg, page_size=cfg.page_size,
+            num_blocks=cfg.kv_blocks, **executor_kwargs,
+        )
+    else:
+        executor = ModelExecutor(params, mcfg, **executor_kwargs)
     engine = ServingEngine(
         executor,
         scheduler=FifoScheduler(SchedulerConfig(max_queue=cfg.queue_limit)),
