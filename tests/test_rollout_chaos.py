@@ -1,0 +1,1181 @@
+"""Rollout chaos harness (ISSUE 9): the supervisor's control loop closed
+over the serving fleet.
+
+Tiers, cheapest first:
+
+* engine reload seams — pause/quiesce/abandon/swap_params unit behavior
+  against a fake executor (no device);
+* weight-swap atomicity — REAL executors (bf16 + int8-KV × contiguous +
+  paged): in-flight requests finish token-identical on the OLD weights,
+  the first post-swap admission serves the NEW ones, and the paged prefix
+  index forgets old-weight KV;
+* fleet + controller drills against the fake cluster and REAL verified
+  checkpoints: a full rolling update drops zero requests; a pod killed
+  mid-rollout is recreated (event path AND the absence-driven watchdog
+  sweep) with a taxonomy cause in the ledger; a corrupt candidate
+  checkpoint is quarantined (pre-poll) or aborts the rollout at its
+  load-time verification (post-poll race) and is NEVER loaded; a replica
+  SIGTERM'd mid-drain leaves every request terminal with an honest cause
+  and the fleet still converges to the newest verified step.
+"""
+
+import asyncio
+import os
+import uuid
+from datetime import timedelta
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.checkpoint.models import (
+    JOB_LABEL_SERVING_FLEET,
+    JOB_TEMPLATE_NAME_KEY,
+    NEXUS_COMPONENT_LABEL,
+    LifecycleStage,
+)
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.k8s.fake import FakeKubeClient
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.serving import (
+    CAUSE_REPLICA_LOST,
+    CheckpointWatcher,
+    FleetSupervisor,
+    ModelExecutor,
+    PagedModelExecutor,
+    QueueFull,
+    RequestState,
+    ServingEngine,
+    ServingFleet,
+)
+from tpu_nexus.serving.engine import CAUSE_RELOAD_GRACE
+from tpu_nexus.serving.fleet import MSG_POD_MISSING, REPLICA_DOWN
+from tpu_nexus.supervisor.taxonomy import (
+    ACTION_MESSAGES,
+    DecisionAction,
+    FleetRecovery,
+    MSG_HBM_OOM,
+    MSG_PREEMPTED,
+    MSG_STUCK_IN_PENDING,
+    SERVING_POD_RECOVERY,
+)
+from tpu_nexus.workload import durability
+from tpu_nexus.workload.faults import MSG_HBM_OOM as FAULT_HBM_OOM_TEXT
+from tpu_nexus.workload.faults import flip_committed_leaf
+from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+
+NS = "nexus"
+FLEET_JS = "svc"
+ALGO = "svc-algo"
+
+
+# -- shared fakes ---------------------------------------------------------------
+
+
+class FleetFakeExecutor:
+    """Deterministic device stand-in with a swappable ``params`` handle:
+    first token = last prompt token + 1, decode increments — enough to
+    drive every host-side fleet/rollout path without compiling anything."""
+
+    def __init__(self, num_slots=2, max_len=64, params="v0"):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.params = params
+        self.swaps = 0
+
+    def begin(self, slot, prompt):
+        return (int(prompt[-1]) + 1) % 1000
+
+    def step(self, tokens, cursors):
+        return np.asarray(tokens) + 1
+
+    def swap_params(self, params):
+        self.params = params
+        self.swaps += 1
+
+
+def fake_engine(params="v0", slots=2):
+    return ServingEngine(FleetFakeExecutor(num_slots=slots, params=params))
+
+
+class FakeSource:
+    """``restore_params``-shaped checkpoint source for host-only fleet
+    tests; optionally fails like a rotten candidate."""
+
+    def __init__(self, fail=False):
+        self.calls = []
+        self.fail = fail
+
+    def restore_params(self, step):
+        self.calls.append(step)
+        if self.fail:
+            raise durability.CheckpointCorrupt(f"step {step}: injected rot")
+        return f"params@{step}"
+
+
+def _submit_all(fleet, n, prompt_tail=7, max_new=4):
+    return [
+        fleet.submit(np.array([1, 2, prompt_tail]), max_new) for _ in range(n)
+    ]
+
+
+# -- taxonomy totality ----------------------------------------------------------
+
+
+def test_serving_pod_recovery_total_at_runtime():
+    """The NX001 invariant, checked dynamically too: every decision action
+    declares a fleet recovery, and every recovery is a known constant."""
+    assert set(SERVING_POD_RECOVERY) == set(ACTION_MESSAGES)
+    legal = {
+        FleetRecovery.RECREATE,
+        FleetRecovery.RECREATE_REDUCED_KV,
+        FleetRecovery.ESCALATE,
+        FleetRecovery.NONE,
+    }
+    assert set(SERVING_POD_RECOVERY.values()) <= legal
+    # the ISSUE's named rows
+    assert SERVING_POD_RECOVERY[DecisionAction.TO_FAIL_HBM_OOM] == FleetRecovery.RECREATE_REDUCED_KV
+    assert SERVING_POD_RECOVERY[DecisionAction.TO_FAIL_STUCK_IN_PENDING] == FleetRecovery.ESCALATE
+    assert SERVING_POD_RECOVERY[DecisionAction.TO_FAIL_FATAL_ERROR] == FleetRecovery.RECREATE
+
+
+# -- engine reload seams --------------------------------------------------------
+
+
+class TestEngineReloadSeam:
+    def test_pause_sheds_new_submits_and_queue_waits_through_swap(self):
+        """Only IN-FLIGHT requests gate the swap: queued requests carry no
+        KV, so a quiesce leaves them queued (never drops them) and they run
+        entirely on the post-swap weights — a deep queue costs a reload
+        nothing."""
+        eng = fake_engine(slots=2)
+        inflight = [eng.submit(np.array([1, 2, 3]), 4) for _ in range(2)]
+        eng.step()  # both admitted, still mid-decode
+        assert eng.in_flight == 2
+        queued = [eng.submit(np.array([4, 5, 6]), 2) for _ in range(3)]
+        eng.pause_admission()
+        with pytest.raises(QueueFull, match="weight reload"):
+            eng.submit(np.array([1, 2, 3]), 2)
+        assert eng.metrics.shed_total == 1
+        summary = eng.quiesce(grace_s=60.0)
+        # in-flight finished on the old weights; the queue is intact
+        assert all(r.state == RequestState.FINISHED for r in inflight)
+        assert all(r.state == RequestState.QUEUED for r in queued)
+        assert summary["quiesce_finished"] == 2 and summary["quiesce_evicted"] == 0
+        assert eng.admission_paused  # caller resumes AFTER the swap
+        eng.swap_params("v1")
+        eng.resume_admission()
+        eng.run_until_drained(max_steps=100)
+        assert all(r.state == RequestState.FINISHED for r in queued)
+
+    def test_quiesce_grace_exhaustion_evicts_with_honest_cause(self):
+        eng = fake_engine()
+        req = eng.submit(np.array([1, 2, 3]), 50)
+        eng.step()
+        summary = eng.quiesce(grace_s=0.0)
+        assert summary["quiesce_evicted"] == 1
+        assert req.state == RequestState.EVICTED
+        assert req.cause == CAUSE_RELOAD_GRACE
+
+    def test_swap_refuses_in_flight_requests(self):
+        eng = fake_engine()
+        eng.submit(np.array([1, 2, 3]), 8)
+        eng.step()  # admitted + decoding
+        with pytest.raises(RuntimeError, match="quiesce"):
+            eng.swap_params("v1")
+
+    def test_swap_counts_and_installs(self):
+        eng = fake_engine()
+        eng.quiesce(grace_s=0.0)
+        eng.swap_params("v1")
+        eng.resume_admission()
+        assert eng.executor.params == "v1"
+        assert eng.weight_swaps == 1
+        assert eng.metrics.summary()["weight_swaps"] == 1
+
+    def test_abandon_accounts_queued_and_decoding_differently(self):
+        eng = fake_engine(slots=1)
+        decoding = eng.submit(np.array([1, 2, 3]), 8)
+        eng.step()
+        queued = eng.submit(np.array([4, 5, 6]), 8)  # no free slot: stays queued
+        n = eng.abandon(f"{CAUSE_REPLICA_LOST}:TestKill")
+        assert n == 2
+        assert decoding.state == RequestState.FAILED
+        assert queued.state == RequestState.EVICTED
+        assert decoding.cause == queued.cause == f"{CAUSE_REPLICA_LOST}:TestKill"
+        assert not eng.has_work
+
+
+# -- weight-swap atomicity (real executors) -------------------------------------
+
+
+CFG = LlamaConfig.tiny()
+PARAMS_OLD = llama_init(jax.random.PRNGKey(0), CFG)
+PARAMS_NEW = llama_init(jax.random.PRNGKey(1), CFG)
+
+
+def _ref(params, prompt, T, kv_quant=""):
+    return np.asarray(
+        generate(
+            params,
+            jnp.asarray(prompt[None, :]),
+            CFG,
+            max_new_tokens=T,
+            max_len=prompt.shape[0] + T,
+            kv_quant=kv_quant,
+        )
+    )[0]
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("paged", [False, True])
+def test_weight_swap_atomicity(kv_quant, paged):
+    """ISSUE 9 satellite: in-flight requests finish token-identical on the
+    OLD weights, the first post-swap admission serves the NEW weights —
+    bf16 + int8-KV, contiguous + paged executors."""
+    S, T, B = 8, 5, 2
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, CFG.vocab_size, size=(B, S)).astype(np.int32)
+    kwargs = dict(num_slots=B, max_len=S + T, kv_quant=kv_quant)
+    if paged:
+        executor = PagedModelExecutor(PARAMS_OLD, CFG, page_size=4, **kwargs)
+    else:
+        executor = ModelExecutor(PARAMS_OLD, CFG, **kwargs)
+    eng = ServingEngine(executor)
+    inflight = [eng.submit(prompts[i], T) for i in range(B)]
+    for _ in range(2):
+        eng.step()  # mid-generation when the reload arrives
+    assert any(not r.is_terminal() for r in inflight)
+    # a request still QUEUED at swap time (slots full) must survive the
+    # quiesce untouched and serve entirely on the NEW weights
+    straddler_prompt = rng.integers(1, CFG.vocab_size, size=(S,)).astype(np.int32)
+    straddler = eng.submit(straddler_prompt, T)
+
+    eng.quiesce(grace_s=60.0)
+    assert straddler.state == RequestState.QUEUED  # kept, not dropped
+    eng.swap_params(PARAMS_NEW)
+    eng.resume_admission()
+
+    # 1. everything in flight at reload time finished on the OLD weights
+    for i, req in enumerate(inflight):
+        assert req.state == RequestState.FINISHED
+        np.testing.assert_array_equal(
+            np.asarray(req.output_tokens), _ref(PARAMS_OLD, prompts[i], T, kv_quant)
+        )
+    if paged:
+        # the prefix index forgot old-weight KV: a repeat of prompt 0 must
+        # not share blocks prefilled under the old params
+        assert eng.paged.index.lookup(prompts[0]).shared_len == 0
+    # 2. the first post-swap admission — the SAME prompt — uses NEW weights
+    post = eng.submit(prompts[0], T)
+    eng.run_until_drained(max_steps=1000)
+    assert post.state == RequestState.FINISHED
+    np.testing.assert_array_equal(
+        np.asarray(post.output_tokens), _ref(PARAMS_NEW, prompts[0], T, kv_quant)
+    )
+    # 3. the queued straddler served entirely on the NEW weights
+    assert straddler.state == RequestState.FINISHED
+    np.testing.assert_array_equal(
+        np.asarray(straddler.output_tokens),
+        _ref(PARAMS_NEW, straddler_prompt, T, kv_quant),
+    )
+    assert eng.weight_swaps == 1
+
+
+def test_swap_rejects_mismatched_structure():
+    executor = ModelExecutor(PARAMS_OLD, CFG, num_slots=1, max_len=16)
+    eng = ServingEngine(executor)
+    with pytest.raises(ValueError, match="structure"):
+        eng.swap_params({"not": "llama"})
+    # same treedef but different leaf SHAPES must also be refused — the
+    # same-architecture-different-width checkpoint is the realistic mistake
+    truncated = jax.tree.map(lambda leaf: leaf[..., :1], PARAMS_OLD)
+    with pytest.raises(ValueError, match="shapes"):
+        eng.swap_params(truncated)
+
+
+# -- host-side fleet + rollout state machine ------------------------------------
+
+
+class TestServingFleet:
+    def _fleet(self, n=3):
+        fleet = ServingFleet()
+        for i in range(n):
+            fleet.add_replica(f"rep-{i}", fake_engine(params="v1"), step=1)
+        return fleet
+
+    def test_round_robin_skips_down_and_reloading(self):
+        fleet = self._fleet(3)
+        fleet.kill_replica("rep-0", "replica-lost:test")
+        fleet.replicas["rep-1"].state = "reloading"
+        reqs = _submit_all(fleet, 4)
+        fleet.run_until_drained()
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+        assert len(fleet.replicas["rep-2"].engine.retired) == 4
+
+    def test_all_unavailable_sheds(self):
+        fleet = self._fleet(2)
+        fleet.kill_replica("rep-0", "x")
+        fleet.kill_replica("rep-1", "y")
+        with pytest.raises(QueueFull, match="no serving replica"):
+            fleet.submit(np.array([1]), 1)
+
+    def test_rolling_update_zero_drop(self):
+        fleet = self._fleet(3)
+        src = FakeSource()
+        reqs = []
+        assert fleet.start_rollout(src, 2, grace_s=30.0)
+        assert not fleet.start_rollout(src, 3, grace_s=30.0)  # one at a time
+        for _ in range(200):
+            if len(reqs) < 24:
+                reqs.append(fleet.submit(np.array([1, 2, 5]), 3))
+            fleet.tick()
+            if fleet.converged(2) and not fleet.has_work and len(reqs) >= 24:
+                break
+        fleet.run_until_drained()
+        assert fleet.converged(2)
+        assert fleet.rollouts_completed == 1
+        assert src.calls == [2]  # one verified restore serves the whole fleet
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+        assert all(
+            rep.engine.executor.params == "params@2"
+            for rep in fleet.replicas.values()
+        )
+        # zero drop, by the book: every submitted request reached FINISHED
+        summary = fleet.summary()
+        assert summary["retired_states"] == {RequestState.FINISHED: len(reqs) }
+
+    def test_rollout_aborts_on_rotten_candidate_and_resumes_serving(self):
+        fleet = self._fleet(2)
+        fleet.start_rollout(FakeSource(fail=True), 2, grace_s=0.0)
+        for _ in range(20):
+            fleet.tick()
+            if not fleet.rollout_active:
+                break
+        assert fleet.rollout_error is not None and "injected rot" in fleet.rollout_error[1]
+        assert fleet.rollouts_completed == 0
+        # nothing swapped, nobody wedged: all replicas serve the OLD weights
+        for rep in fleet.replicas.values():
+            assert rep.state == "serving" and rep.deployed_step == 1
+            assert rep.engine.executor.params == "v1"
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        fleet.run_until_drained()
+        assert req.state == RequestState.FINISHED
+
+    def test_rollout_spec_mismatch_in_transform_aborts_before_any_pause(self):
+        """A candidate that loads but does not FIT (missing quantization
+        transform, changed width) must cost one failed load — no replica
+        paused, no request evicted, nobody wedged in RELOADING."""
+        fleet = self._fleet(2)
+
+        def bad_transform(params):
+            raise ValueError("missing quantization transform")
+
+        fleet.start_rollout(FakeSource(), 2, grace_s=30.0, transform=bad_transform)
+        fleet.tick()
+        assert not fleet.rollout_active
+        assert fleet.rollout_error[0] == 2
+        assert "ValueError" in fleet.rollout_error[1]
+        for rep in fleet.replicas.values():
+            assert rep.state == "serving"
+            assert not rep.engine.admission_paused
+            assert rep.deployed_step == 1
+
+    def test_rollout_swap_failure_resumes_replica(self):
+        """A swap that raises (executor spec guard) must abort the rollout
+        and RESUME the replica on its old weights — the uncaught-raise
+        alternative left it paused in RELOADING forever."""
+        fleet = ServingFleet()
+
+        class RefusingExecutor(FleetFakeExecutor):
+            def swap_params(self, params):
+                raise ValueError("params do not fit this engine")
+
+        eng = ServingEngine(RefusingExecutor(num_slots=2))
+        fleet.add_replica("rep-0", eng, 1)
+        fleet.start_rollout(FakeSource(), 2, grace_s=0.0)
+        for _ in range(5):
+            fleet.tick()
+            if not fleet.rollout_active:
+                break
+        assert not fleet.rollout_active
+        assert fleet.rollout_error[0] == 2
+        assert not eng.admission_paused
+        assert fleet.replicas["rep-0"].state == "serving"
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        fleet.run_until_drained()
+        assert req.state == RequestState.FINISHED
+
+    def test_history_is_bounded_across_revives(self):
+        fleet = self._fleet(1)
+        rep = fleet.replicas["rep-0"]
+        rep.history_limit = 5
+        for generation in range(4):
+            for _ in range(3):
+                fleet.submit(np.array([1, 2, 3]), 1)
+            fleet.run_until_drained()
+            fleet.kill_replica("rep-0", "replica-lost:test")
+            fleet.revive_replica("rep-0", fake_engine(), 1)
+        assert len(rep.history) <= 5
+
+    def test_rollout_skips_down_replica_and_completes(self):
+        fleet = self._fleet(3)
+        fleet.start_rollout(FakeSource(), 2, grace_s=30.0)
+        fleet.kill_replica("rep-1", "replica-lost:test")
+        for _ in range(100):
+            fleet.tick()
+            if not fleet.rollout_active:
+                break
+        assert fleet.rollouts_completed == 1
+        assert fleet.replicas["rep-0"].deployed_step == 2
+        assert fleet.replicas["rep-2"].deployed_step == 2
+        assert fleet.replicas["rep-1"].state == REPLICA_DOWN
+        # a revive at the target step completes convergence
+        fleet.revive_replica("rep-1", fake_engine(params="params@2"), 2)
+        assert fleet.converged(2)
+
+    def test_rollout_grace_exhaustion_evicts_stragglers(self):
+        fleet = self._fleet(1)
+        req = fleet.submit(np.array([1, 2, 3]), 50)  # outlives a zero grace
+        fleet.tick()
+        fleet.start_rollout(FakeSource(), 2, grace_s=0.0)
+        for _ in range(10):
+            fleet.tick()
+            if not fleet.rollout_active:
+                break
+        assert req.state == RequestState.EVICTED
+        assert req.cause == CAUSE_RELOAD_GRACE
+        assert fleet.converged(2)
+
+    def test_kill_is_idempotent_and_history_survives_revive(self):
+        fleet = self._fleet(1)
+        req = fleet.submit(np.array([1, 2, 3]), 8)
+        fleet.tick()
+        assert fleet.kill_replica("rep-0", "replica-lost:test") == 1
+        assert fleet.kill_replica("rep-0", "replica-lost:test") == 0
+        fleet.revive_replica("rep-0", fake_engine(), 2)
+        retired = fleet.all_retired()
+        assert [r.request_id for r in retired] == [req.request_id]
+        assert retired[0].cause == "replica-lost:test"
+
+
+# -- verified-step poller + watcher ---------------------------------------------
+
+
+def _make_step(d, step, content=b"payload"):
+    sd = os.path.join(d, str(step))
+    os.makedirs(sd, exist_ok=True)
+    with open(os.path.join(sd, "data.bin"), "wb") as fh:
+        fh.write(content)
+    durability.write_manifest_temp(sd, durability.build_manifest(sd, step))
+    durability.commit_manifest(sd)
+    return sd
+
+
+class TestVerifiedStepPoller:
+    def test_cached_until_directory_changes(self, tmp_path):
+        d = str(tmp_path)
+        _make_step(d, 1)
+        _make_step(d, 2)
+        poller = durability.VerifiedStepPoller(d)
+        assert poller.latest_verified_step() == 2
+        assert poller.latest_verified_step() == 2
+        assert poller.scans == 1  # second poll was the fingerprint cache
+        _make_step(d, 3)
+        assert poller.latest_verified_step() == 3
+        assert poller.scans == 2
+
+    def test_torn_save_is_invisible(self, tmp_path):
+        """Commit-marker presence is the trust anchor: a step directory
+        without its manifest does not exist to the poller."""
+        d = str(tmp_path)
+        _make_step(d, 1)
+        torn = os.path.join(d, "2")
+        os.makedirs(torn)
+        with open(os.path.join(torn, "data.bin"), "wb") as fh:
+            fh.write(b"half a save")
+        poller = durability.VerifiedStepPoller(d)
+        assert poller.latest_verified_step() == 1
+        assert poller.rollbacks and poller.rollbacks[0]["cause"] == "uncommitted"
+
+    def test_quarantine_mode_renames_corrupt_steps(self, tmp_path):
+        d = str(tmp_path)
+        _make_step(d, 1)
+        sd = _make_step(d, 2)
+        flip_committed_leaf(sd)
+        poller = durability.VerifiedStepPoller(d, quarantine=True)
+        assert poller.latest_verified_step() == 1
+        assert os.path.exists(os.path.join(d, "2.corrupt"))
+        # the quarantine rename changed the dir: one redundant re-scan,
+        # then the verdict is cached
+        assert poller.latest_verified_step() == 1
+        assert poller.latest_verified_step() == 1
+        assert poller.scans == 2
+
+
+class TestCheckpointWatcher:
+    def test_interval_gating(self, tmp_path):
+        d = str(tmp_path)
+        _make_step(d, 1)
+        watcher = CheckpointWatcher(d, interval_s=10.0)
+        assert watcher.check(now=0.0) == 1  # first check immediate
+        assert watcher.check(now=5.0) is None  # inside the interval
+        assert watcher.check(now=10.1) == 1
+        with pytest.raises(ValueError, match="interval"):
+            CheckpointWatcher(d, interval_s=0.0)
+
+
+# -- fake-cluster pod lifecycle events (satellite) ------------------------------
+
+
+def serving_jobset(name=FLEET_JS, replicas=3, kv=64, ns=NS):
+    return {
+        "kind": "JobSet",
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "metadata": {
+            "name": name,
+            "namespace": ns,
+            "uid": f"js-{uuid.uuid4()}",
+            "labels": {
+                NEXUS_COMPONENT_LABEL: JOB_LABEL_SERVING_FLEET,
+                JOB_TEMPLATE_NAME_KEY: ALGO,
+            },
+        },
+        "spec": {
+            "replicatedJobs": [
+                {
+                    "name": "replica",
+                    "replicas": replicas,
+                    "template": {
+                        "spec": {
+                            "parallelism": 1,
+                            "template": {
+                                "spec": {
+                                    "containers": [
+                                        {
+                                            "name": "main",
+                                            "env": [
+                                                {
+                                                    "name": "NEXUS_KV_BLOCKS",
+                                                    "value": str(kv),
+                                                }
+                                            ],
+                                        }
+                                    ]
+                                }
+                            },
+                        }
+                    },
+                }
+            ]
+        },
+        "status": {},
+    }
+
+
+def pod_name(i):
+    return f"{FLEET_JS}-replica-{i}-0"
+
+
+class TestFakePodEvents:
+    def _events(self, client):
+        return list(client._objects.get("Event", {}).values())
+
+    async def test_no_events_by_default(self):
+        client = FakeKubeClient(jobset_controller=True)
+        client.inject("ADDED", "JobSet", serving_jobset())
+        await client.delete_object("Pod", NS, pod_name(0))
+        assert self._events(client) == []
+
+    async def test_deletion_emits_namespaced_killing_event(self):
+        client = FakeKubeClient(jobset_controller=True, emit_pod_events=True)
+        client.inject("ADDED", "JobSet", serving_jobset())
+        client.inject("ADDED", "JobSet", serving_jobset(ns="other"))
+        await client.delete_object("Pod", NS, pod_name(0))
+        events = self._events(client)
+        assert len(events) == 1
+        evt = events[0]
+        assert evt["reason"] == "Killing"
+        assert evt["metadata"]["namespace"] == NS  # the pod's ns, not other
+        assert evt["involvedObject"] == {
+            "kind": "Pod",
+            "name": pod_name(0),
+            "namespace": NS,
+            "uid": evt["involvedObject"]["uid"],
+        }
+
+    async def test_fail_pod_emits_failed_event_with_termination_text(self):
+        client = FakeKubeClient(jobset_controller=True, emit_pod_events=True)
+        client.inject("ADDED", "JobSet", serving_jobset())
+        client.fail_pod(NS, pod_name(1), message=FAULT_HBM_OOM_TEXT, exit_code=137)
+        events = self._events(client)
+        assert len(events) == 1
+        assert events[0]["reason"] == "Failed"
+        assert FAULT_HBM_OOM_TEXT in events[0]["message"]
+
+    async def test_crash_loop_emits_backoff_event(self):
+        client = FakeKubeClient(jobset_controller=True, emit_pod_events=True)
+        client.inject("ADDED", "JobSet", serving_jobset())
+        client.fail_pod(NS, pod_name(2), message="panic: nil deref", crash_loop=True)
+        events = self._events(client)
+        assert len(events) == 1
+        assert events[0]["reason"] == "BackOff"
+        assert "panic: nil deref" in events[0]["message"]
+        # crash-looping pod is still Running, like real kubelet reporting
+        pod = client._objects["Pod"][(NS, pod_name(2))]
+        assert pod["status"]["phase"] == "Running"
+
+
+# -- run supervisor delegates serving-fleet events ------------------------------
+
+
+async def test_run_supervisor_delegates_serving_fleet_events():
+    """Division of labor: the run supervisor must count serving-fleet pod
+    events on ``events_delegated`` and never classify them into run
+    decisions (one pod, one owner — acting too would double-supervise)."""
+    from tpu_nexus.checkpoint.models import JOBSET_NAME_LABEL
+    from tests.test_supervisor import Fixture, event_obj
+
+    pod = {
+        "kind": "Pod",
+        "metadata": {
+            "name": pod_name(0),
+            "namespace": NS,
+            "uid": str(uuid.uuid4()),
+            "labels": {JOBSET_NAME_LABEL: FLEET_JS},
+        },
+        "status": {"phase": "Failed"},
+    }
+    objects = {
+        "JobSet": [serving_jobset()],
+        "Pod": [pod],
+        "Event": [event_obj("Failed", "boom", "Pod", pod_name(0))],
+    }
+    fx = Fixture(objects)
+    await fx.run_until_idle()
+    assert fx.supervisor.events_delegated == 1
+    assert fx.supervisor.decisions_enqueued == 0
+    assert fx.client.deleted("JobSet") == [] and fx.client.deleted("Pod") == []
+
+
+# -- the fleet controller against the fake cluster ------------------------------
+
+
+async def _settle():
+    for _ in range(6):
+        await asyncio.sleep(0.02)
+
+
+class _Fixture:
+    def __init__(self, client, store, fleet, sup, ctx, made):
+        self.client = client
+        self.store = store
+        self.fleet = fleet
+        self.sup = sup
+        self.ctx = ctx
+        self.made = made
+
+    async def close(self):
+        self.ctx.cancel()
+        await self.sup._factory.shutdown()
+
+    def ledger(self):
+        return self.store.read_checkpoint(ALGO, FLEET_JS)
+
+
+async def fleet_fixture(
+    emit_pod_events=True,
+    source=None,
+    watcher=None,
+    kv=64,
+    missing_after_s=0.0,
+    adopt_step=1,
+):
+    client = FakeKubeClient(jobset_controller=True, emit_pod_events=emit_pod_events)
+    client.inject("ADDED", "JobSet", serving_jobset(kv=kv))
+    store = InMemoryCheckpointStore()
+    fleet = ServingFleet()
+    made = []
+
+    def factory(name, step, kv_blocks):
+        made.append((name, step, kv_blocks))
+        return fake_engine(params=f"params@{step}")
+
+    sup = FleetSupervisor(
+        client,
+        store,
+        NS,
+        fleet,
+        FLEET_JS,
+        ALGO,
+        factory,
+        source=source,
+        watcher=watcher,
+        grace_s=30.0,
+        kv_blocks=kv,
+        missing_after_s=missing_after_s,
+        resync_period=timedelta(0),
+    )
+    ctx = LifecycleContext()
+    sup._factory.start(ctx)
+    assert await sup._factory.wait_for_cache_sync(timeout=10.0)
+    adopted = await sup.adopt_pods(step=adopt_step)
+    assert adopted == sorted(pod_name(i) for i in range(3))
+    return _Fixture(client, store, fleet, sup, ctx, made)
+
+
+class TestFleetSupervisor:
+    async def test_pod_deletion_recreated_with_taxonomy_cause(self):
+        fx = await fleet_fixture()
+        try:
+            reqs = _submit_all(fx.fleet, 3)
+            fx.fleet.tick()  # everyone decoding
+            await fx.client.delete_object("Pod", NS, pod_name(0))
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1
+            rep = fx.fleet.replicas[pod_name(0)]
+            assert rep.state == "serving"
+            # the killed replica's in-flight requests are accounted, never lost
+            lost = [
+                r
+                for r in fx.fleet.all_retired()
+                if r.cause == f"{CAUSE_REPLICA_LOST}:{DecisionAction.TO_PREEMPT_RESTARTABLE}"
+            ]
+            assert len(lost) == 1 and lost[0].state == RequestState.FAILED
+            # honest cause in the ledger, row still RUNNING (fleet is alive)
+            row = fx.ledger()
+            assert row.lifecycle_stage == LifecycleStage.RUNNING
+            assert row.algorithm_failure_cause == MSG_PREEMPTED
+            assert pod_name(0) in row.algorithm_failure_details
+            # a REPLACEMENT pod exists with a fresh uid
+            pod = fx.client._objects["Pod"][(NS, pod_name(0))]
+            assert pod["metadata"]["uid"].startswith("fleet-recreate-")
+            # the untouched replicas finish their work
+            fx.fleet.run_until_drained()
+            assert sum(r.state == RequestState.FINISHED for r in reqs) == 2
+        finally:
+            await fx.close()
+
+    async def test_hbm_oom_recreates_with_halved_kv_blocks(self):
+        fx = await fleet_fixture(kv=64)
+        try:
+            fx.client.fail_pod(NS, pod_name(1), message=FAULT_HBM_OOM_TEXT, exit_code=137)
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1
+            assert fx.made[-1] == (pod_name(1), 1, 32)  # halved budget
+            pod = fx.client._objects["Pod"][(NS, pod_name(1))]
+            env = pod["spec"]["containers"][0]["env"]
+            assert {"name": "NEXUS_KV_BLOCKS", "value": "32"} in env
+            assert fx.ledger().algorithm_failure_cause == MSG_HBM_OOM
+            # a second OOM halves again, floored at min_kv_blocks
+            fx.client.fail_pod(NS, pod_name(1), message=FAULT_HBM_OOM_TEXT, exit_code=137)
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.made[-1] == (pod_name(1), 1, 16)
+        finally:
+            await fx.close()
+
+    async def test_crash_loop_recreates(self):
+        fx = await fleet_fixture()
+        try:
+            fx.client.fail_pod(NS, pod_name(2), message="segfault", crash_loop=True)
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1 and fx.sup.escalated == 0
+            assert fx.sup.incidents[-1]["action"] == DecisionAction.TO_FAIL_FATAL_ERROR
+            # our OWN recreate deletion must not echo as a second incident
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1
+        finally:
+            await fx.close()
+
+    async def test_generic_pod_crash_recreates_via_quirk_remap(self):
+        """The reference's Pod-'Failed' quirk maps a dead pod to the
+        stuck-in-pending class for whole-RUN semantics; for a stateless
+        serving replica a dead pod is a crash, so the fleet remaps it to
+        the fatal-error class and RECREATES — one transient segfault must
+        never permanently shrink the fleet."""
+        fx = await fleet_fixture()
+        try:
+            req = fx.fleet.submit(np.array([1, 2, 3]), 8)
+            fx.fleet.tick()
+            fx.client.fail_pod(NS, pod_name(0), message="segfault in userland")
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1 and fx.sup.escalated == 0
+            record = fx.sup.incidents[-1]
+            assert record["action"] == DecisionAction.TO_FAIL_FATAL_ERROR
+            assert record["recovery"] == FleetRecovery.RECREATE
+            assert fx.fleet.replicas[pod_name(0)].state == "serving"
+            # whichever replica held it, the request is terminal + accounted
+            routed_to_dead = req.cause.startswith(CAUSE_REPLICA_LOST)
+            fx.fleet.run_until_drained()
+            assert req.is_terminal()
+            assert routed_to_dead or req.state == RequestState.FINISHED
+        finally:
+            await fx.close()
+
+    async def test_jobset_scheduling_failure_escalates_without_phantom_replica(self):
+        """JobSet-level conditions (FailedCreate: quota gone, bad spec)
+        name no pod: they must escalate with the cause recorded — NOT mint
+        a phantom replica named after the JobSet that the missing-pod
+        sweep would then recreate forever."""
+        from tests.test_supervisor import event_obj
+
+        fx = await fleet_fixture()
+        try:
+            fx.client.inject(
+                "ADDED", "Event",
+                event_obj("FailedCreate", "quota exceeded", "JobSet", FLEET_JS),
+            )
+            await _settle()
+            await fx.sup.reconcile(now=50.0)
+            assert fx.sup.escalated == 1 and fx.sup.recreated == 0
+            record = fx.sup.incidents[-1]
+            assert record["action"] == DecisionAction.TO_FAIL_STUCK_IN_PENDING
+            assert record["recovery"] == FleetRecovery.ESCALATE
+            assert record["pod"] == ""
+            assert fx.ledger().algorithm_failure_cause == MSG_STUCK_IN_PENDING
+            # no phantom: the replica set is exactly the 3 adopted pods,
+            # and further sweeps recreate nothing
+            assert sorted(fx.fleet.replicas) == sorted(pod_name(i) for i in range(3))
+            await fx.sup.reconcile(now=100.0)
+            await fx.sup.reconcile(now=200.0)
+            assert fx.sup.recreated == 0
+        finally:
+            await fx.close()
+
+    async def test_ledger_heartbeats_per_reconcile(self):
+        """An incident-free fleet must still look ALIVE to the run
+        supervisor's RUNNING sweep — without per-reconcile heartbeats the
+        sweep would 'rescue' a healthy fleet by deleting its JobSet."""
+        fx = await fleet_fixture()
+        try:
+            await fx.sup.reconcile(now=1.0)
+            first = fx.ledger().per_chip_steps.get("fleet/reconciles", 0)
+            await fx.sup.reconcile(now=2.0)
+            second = fx.ledger().per_chip_steps.get("fleet/reconciles", 0)
+            assert second > first >= 1
+            assert fx.ledger().lifecycle_stage == LifecycleStage.RUNNING
+        finally:
+            await fx.close()
+
+    async def test_watchdog_sweep_recreates_silently_missing_pod(self):
+        """Absence-driven backstop: the pod vanishes with NO watch event
+        (controller down / event dropped) — the sweep recreates it."""
+        fx = await fleet_fixture(emit_pod_events=False, missing_after_s=10.0)
+        try:
+            # vanish without any event reaching the informers
+            fx.client._objects["Pod"].pop((NS, pod_name(1)))
+            fx.sup._factory.informers["Pod"]._cache.pop((NS, pod_name(1)))
+            fx.sup._pending.clear()
+            await fx.sup.reconcile(now=100.0)  # first observation only
+            assert fx.sup.recreated == 0
+            await fx.sup.reconcile(now=105.0)  # inside the deadline
+            assert fx.sup.recreated == 0
+            await fx.sup.reconcile(now=111.0)  # past missing_after_s
+            assert fx.sup.recreated == 1
+            record = fx.sup.incidents[-1]
+            assert record["action"] == DecisionAction.TO_PREEMPT_RESTARTABLE
+            assert MSG_POD_MISSING in record["trace"]
+            assert (NS, pod_name(1)) in fx.client._objects["Pod"]
+        finally:
+            await fx.close()
+
+
+# -- end-to-end rollout drills (real verified checkpoints) ----------------------
+
+
+def _commit_params(d, step, value):
+    ck = TensorCheckpointer(d)
+    ck.save(step, {"params": {"w": np.full((4,), float(value), np.float32)}})
+    ck.commit(step)
+    ck.close()
+
+
+async def _drive(fx, reqs, target, total=24, bound=400):
+    """Closed-loop client: keep submitting while reconciling until the
+    fleet converges on ``target`` — fleet.submit must NEVER shed (zero
+    drop is fleet-wide, not per-replica)."""
+    t = 0.0
+    for _ in range(bound):
+        if len(reqs) < total:
+            reqs.append(fx.fleet.submit(np.array([1, 2, 5]), 3))
+        t += 2.0
+        await fx.sup.reconcile(now=t)
+        if fx.fleet.converged(target) and len(reqs) >= total and not fx.fleet.has_work:
+            return t
+    raise AssertionError(
+        f"fleet did not converge on step {target}: {fx.fleet.summary()}"
+    )
+
+
+class TestRolloutDrills:
+    async def test_full_rolling_update_zero_drop(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _commit_params(d, 1, 1.0)
+        fx = await fleet_fixture(
+            source=TensorCheckpointer(d),
+            watcher=CheckpointWatcher(d, interval_s=1.0),
+        )
+        try:
+            _commit_params(d, 2, 2.0)
+            reqs = []
+            await _drive(fx, reqs, target=2)
+            assert fx.fleet.rollouts_completed == 1
+            assert fx.fleet.deployed_steps() == {pod_name(i): 2 for i in range(3)}
+            # the restored weights really landed in every replica
+            for rep in fx.fleet.replicas.values():
+                np.testing.assert_array_equal(
+                    rep.engine.executor.params["w"], np.full((4,), 2.0, np.float32)
+                )
+                assert rep.engine.weight_swaps == 1
+            # ZERO dropped requests: every submitted request FINISHED
+            states = fx.fleet.summary()["retired_states"]
+            assert states == {RequestState.FINISHED: len(reqs)}
+        finally:
+            fx.sup.source.close()
+            await fx.close()
+
+    async def test_pod_kill_mid_rollout_converges_with_causes(self, tmp_path):
+        d = str(tmp_path / "ckpt")
+        _commit_params(d, 1, 1.0)
+        fx = await fleet_fixture(
+            source=TensorCheckpointer(d),
+            watcher=CheckpointWatcher(d, interval_s=1.0),
+        )
+        try:
+            _commit_params(d, 2, 2.0)
+            reqs = _submit_all(fx.fleet, 6, max_new=6)
+            # start the rollout, then kill a pod while it is in flight
+            await fx.sup.reconcile(now=0.5)
+            assert fx.fleet.rollout_active
+            await fx.client.delete_object("Pod", NS, pod_name(1))
+            await _settle()
+            await _drive(fx, reqs, target=2, total=len(reqs) + 6)
+            # recreated by the controller with the taxonomy cause recorded
+            assert fx.sup.recreated == 1
+            assert fx.ledger().algorithm_failure_cause == MSG_PREEMPTED
+            # revived ON the rollout's target step (factory got step=2)
+            assert (pod_name(1), 2, 64) in fx.made
+            # every request terminal; non-finished ones carry honest causes
+            for req in [*reqs, *fx.fleet.all_retired()]:
+                assert req.is_terminal()
+                if req.state != RequestState.FINISHED:
+                    assert req.cause, f"{req.request_id} dropped without a cause"
+            assert fx.fleet.converged(2)
+        finally:
+            fx.sup.source.close()
+            await fx.close()
+
+    async def test_corrupt_candidate_quarantined_never_loaded(self, tmp_path):
+        """Corruption BEFORE the poll: the watcher's verified scan
+        quarantines the candidate and the fleet never even starts a
+        rollout — zero swaps, zero drops."""
+        d = str(tmp_path / "ckpt")
+        _commit_params(d, 1, 1.0)
+        fx = await fleet_fixture(
+            source=TensorCheckpointer(d),
+            watcher=CheckpointWatcher(d, interval_s=1.0, quarantine=True),
+        )
+        try:
+            _commit_params(d, 2, 2.0)
+            flip_committed_leaf(os.path.join(d, "2"))
+            reqs = []
+            await _drive(fx, reqs, target=1, total=12)
+            assert os.path.exists(os.path.join(d, "2.corrupt"))
+            assert fx.fleet.rollouts_completed == 0
+            assert all(
+                rep.engine.weight_swaps == 0 for rep in fx.fleet.replicas.values()
+            )
+            states = fx.fleet.summary()["retired_states"]
+            assert states == {RequestState.FINISHED: len(reqs)}
+        finally:
+            fx.sup.source.close()
+            await fx.close()
+
+    async def test_corruption_after_poll_aborts_at_load_verification(self, tmp_path):
+        """Corruption mid-poll (the marker-cache race): the watcher already
+        vouched for the step, so the rollout starts — and dies at
+        restore_params's deep verification, with every replica resumed on
+        the OLD weights.  The corrupt candidate is never served."""
+        d = str(tmp_path / "ckpt")
+        _commit_params(d, 1, 1.0)
+        fx = await fleet_fixture(
+            source=TensorCheckpointer(d),
+            watcher=CheckpointWatcher(d, interval_s=1.0),
+        )
+        try:
+            _commit_params(d, 2, 2.0)
+            # the poll that vouches for step 2 happens while it is GOOD...
+            assert fx.sup.watcher.poller.latest_verified_step() == 2
+            flip_committed_leaf(os.path.join(d, "2"))  # ...then it rots
+            # count every load attempt: a known-bad candidate must cost ONE
+            # failed load total, not one per watcher poll
+            restores = []
+            orig_restore = fx.sup.source.restore_params
+            fx.sup.source.restore_params = lambda s: (
+                restores.append(s), orig_restore(s)
+            )[1]
+            # the commit marker is untouched, so the poller's cached verdict
+            # still offers step 2 — the rollout starts and must die at the
+            # load-time deep verification instead of serving the rot
+            reqs = []
+            await _drive(fx, reqs, target=1, total=12)
+            assert fx.fleet.rollout_error is not None
+            assert fx.fleet.rollout_error[0] == 2
+            assert "corrupt" in fx.fleet.rollout_error[1]
+            assert restores == [2]  # one attempt, then the bad step is shunned
+            for rep in fx.fleet.replicas.values():
+                assert rep.engine.weight_swaps == 0
+                assert rep.deployed_step == 1
+                assert rep.state == "serving"
+            states = fx.fleet.summary()["retired_states"]
+            assert states == {RequestState.FINISHED: len(reqs)}
+            # REPAIR: quarantine the rot and re-commit a VALID step 2 — the
+            # shun is keyed by directory state, so the re-committed step
+            # earns a fresh attempt and the rollout completes this time
+            durability.quarantine_step(d, 2)
+            _commit_params(d, 2, 2.0)
+            fx.sup.source.reload()  # external quarantine: drop orbax's cache
+            await _drive(fx, reqs, target=2, total=len(reqs) + 6)
+            assert restores == [2, 2]
+            assert fx.fleet.converged(2)
+        finally:
+            fx.sup.source.close()
+            await fx.close()
+
+    async def test_sigterm_replica_mid_drain_converges(self, tmp_path):
+        """A replica SIGTERM'd while quiescing for the rollout: its drain
+        protocol evicts with honest causes, the pod dies, the controller
+        recreates it on the TARGET step, and the rollout completes."""
+        d = str(tmp_path / "ckpt")
+        _commit_params(d, 1, 1.0)
+        fx = await fleet_fixture(
+            source=TensorCheckpointer(d),
+            watcher=CheckpointWatcher(d, interval_s=1.0),
+        )
+        try:
+            _commit_params(d, 2, 2.0)
+            # long generations so the first replica is mid-quiesce with work
+            reqs = _submit_all(fx.fleet, 6, max_new=50)
+            fx.fleet.tick()
+            await fx.sup.reconcile(now=0.5)
+            assert fx.fleet.rollout_active
+            reloading = [
+                name
+                for name, rep in fx.fleet.replicas.items()
+                if rep.state == "reloading"
+            ]
+            assert len(reloading) == 1
+            victim = fx.fleet.replicas[reloading[0]]
+            assert victim.engine.has_work  # mid-drain, by construction
+            # the SIGTERM path: run_serve_engine drains (grace 0 here) and
+            # the process exits -> the pod is deleted out from under us
+            victim.engine.drain(0.0)
+            await fx.client.delete_object("Pod", NS, reloading[0])
+            await _settle()
+            # cancel the long generations still decoding on OTHER replicas
+            # so the drill converges quickly — CANCELLED is terminal and
+            # honest, and the zero-drop audit below still covers them
+            for req in reqs:
+                if not req.is_terminal():
+                    req.cancel_requested = True
+            t = 1.0
+            for _ in range(200):
+                t += 2.0
+                await fx.sup.reconcile(now=t)
+                if fx.fleet.converged(2) and not fx.fleet.has_work:
+                    break
+            assert fx.fleet.converged(2)
+            assert fx.sup.recreated == 1
+            assert (reloading[0], 2, 64) in fx.made
+            # EVERY request is terminal with an honest cause
+            for req in reqs:
+                assert req.is_terminal()
+                if req.state not in (RequestState.FINISHED, RequestState.CANCELLED):
+                    assert req.cause, f"{req.request_id} dropped without a cause"
+            # the drained replica's evictions carry the drain wording
+            drained = [r for r in fx.fleet.all_retired() if r.cause.startswith("drain:")]
+            assert drained, "the mid-drain SIGTERM left no drain-cause evidence"
+        finally:
+            fx.sup.source.close()
+            await fx.close()
+
+
+# -- serve.py reload satellites -------------------------------------------------
+
+
+class TestServeReloadConfig:
+    def test_interval_requires_checkpoint_dir(self):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        with pytest.raises(ValueError, match="NEXUS_CHECKPOINT_DIR"):
+            ServeConfig(reload_check_interval_s=5.0)
+
+    def test_negative_interval_rejected(self):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        with pytest.raises(ValueError, match="reload_check_interval_s"):
+            ServeConfig(reload_check_interval_s=-1.0, checkpoint_dir="/tmp/x")
+
+    def test_env_parse(self):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        cfg = ServeConfig.from_env(
+            {"NEXUS_RELOAD_CHECK_S": "7.5", "NEXUS_CHECKPOINT_DIR": "/tmp/x"}
+        )
+        assert cfg.reload_check_interval_s == 7.5
+        assert ServeConfig.from_env({}).reload_check_interval_s == 0.0
+
+
+class TestServeReloadHelper:
+    def test_reload_if_newer_swaps_real_engine(self, tmp_path):
+        from tpu_nexus.workload.serve import _reload_if_newer
+
+        d = str(tmp_path / "ckpt")
+        ck = TensorCheckpointer(d)
+        ck.save(1, {"params": PARAMS_OLD})
+        ck.commit(1)
+        poller = durability.VerifiedStepPoller(d)
+        executor = ModelExecutor(PARAMS_OLD, CFG, num_slots=2, max_len=16)
+        eng = ServingEngine(executor)
+        # no newer step: a no-op that never touches the engine
+        assert _reload_if_newer(eng, poller.latest_verified_step(), d, 1, "", 5.0) == 1
+        assert eng.weight_swaps == 0
+        ck.save(2, {"params": PARAMS_NEW})
+        ck.commit(2)
+        assert _reload_if_newer(eng, poller.latest_verified_step(), d, 1, "", 5.0) == 2
+        assert eng.weight_swaps == 1 and not eng.admission_paused
+        prompt = np.arange(1, 9, dtype=np.int32)
+        req = eng.submit(prompt, 5)
+        eng.run_until_drained(max_steps=500)
+        np.testing.assert_array_equal(
+            np.asarray(req.output_tokens), _ref(PARAMS_NEW, prompt, 5)
+        )
+        ck.close()
+
+    def test_reload_skips_corrupt_candidate(self, tmp_path):
+        from tpu_nexus.workload.serve import _reload_if_newer
+
+        d = str(tmp_path / "ckpt")
+        ck = TensorCheckpointer(d)
+        ck.save(1, {"params": PARAMS_OLD})
+        ck.commit(1)
+        ck.save(2, {"params": PARAMS_NEW})
+        ck.commit(2)
+        poller = durability.VerifiedStepPoller(d)
+        assert poller.latest_verified_step() == 2  # marker cached as good
+        flip_committed_leaf(os.path.join(d, "2"))  # ...then silent rot
+        eng = ServingEngine(FleetFakeExecutor(params="old"))
+        assert _reload_if_newer(eng, poller.latest_verified_step(), d, 1, "", 5.0) == 1
+        assert eng.weight_swaps == 0 and eng.executor.params == "old"
+        assert not eng.admission_paused
+        ck.close()

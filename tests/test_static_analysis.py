@@ -1117,3 +1117,151 @@ def test_nx007_class_body_publish_flagged():
     """
     findings = lint_source(src, "NX007")
     assert len(findings) == 1 and "durability barrier" in findings[0].message
+
+
+# -- NX008 params hot-swap discipline -------------------------------------------
+
+
+def test_nx008_swap_after_unverified_load_flagged():
+    """The bug class: swap whatever latest_step() said — a torn/rotten
+    candidate would be served to every post-swap request."""
+    src = """
+    def rollout(engine, ckpt):
+        step = ckpt.latest_step()
+        engine.swap_params(ckpt._mngr.restore(step))
+    """
+    findings = lint_source(src, "NX008")
+    assert len(findings) == 1 and "verified-step resolution" in findings[0].message
+
+
+def test_nx008_restore_params_is_a_barrier():
+    """restore_params IS verify-first by contract (TensorCheckpointer
+    deep-verifies before Orbax touches a byte)."""
+    src = """
+    def rollout(engine, ckpt, step):
+        params = ckpt.restore_params(step)
+        engine.swap_params(params)
+    """
+    assert lint_source(src, "NX008") == []
+
+
+def test_nx008_latest_verified_step_is_a_barrier():
+    src = """
+    def reload(engine, poller, loader):
+        step = poller.latest_verified_step()
+        engine.swap_params(loader(step))
+    """
+    assert lint_source(src, "NX008") == []
+
+
+def test_nx008_barrier_as_argument_counts():
+    src = """
+    def rollout(engine, ckpt, step):
+        engine.swap_params(ckpt.restore_params(step))
+    """
+    assert lint_source(src, "NX008") == []
+
+
+def test_nx008_commit_is_not_a_barrier():
+    """Committing step N proves nothing about the step being swapped in."""
+    src = """
+    def rollout(engine, ckpt, step, state):
+        ckpt.commit(step)
+        engine.swap_params(state)
+    """
+    assert len(lint_source(src, "NX008")) == 1
+
+
+def test_nx008_barrier_in_other_scope_does_not_count():
+    src = """
+    def verify_it(ckpt, step):
+        ckpt.verify_step(step)
+
+    def rollout(engine, params):
+        engine.swap_params(params)
+    """
+    assert len(lint_source(src, "NX008")) == 1
+
+
+def test_nx008_barrier_after_swap_flagged():
+    """Lexical precedence means PRECEDENCE: verifying after the swap does
+    not un-serve the unverified weights."""
+    src = """
+    def rollout(engine, ckpt, step, params):
+        engine.swap_params(params)
+        ckpt.verify_step(step)
+    """
+    assert len(lint_source(src, "NX008")) == 1
+
+
+def test_nx008_sink_definition_exempt():
+    """The engine method calling the executor method is the sink chain,
+    not a call site needing its own barrier."""
+    src = """
+    class ServingEngine:
+        def swap_params(self, params):
+            self.executor.swap_params(params)
+    """
+    assert lint_source(src, "NX008") == []
+
+
+def test_nx008_swap_inside_lambda_flagged():
+    src = """
+    def rollout(engine, params):
+        cb = lambda: engine.swap_params(params)
+        return cb
+    """
+    assert len(lint_source(src, "NX008")) == 1
+
+
+def test_nx008_suppressible_per_line():
+    src = """
+    def rollout(engine, params):
+        engine.swap_params(params)  # nxlint: disable=NX008
+    """
+    assert lint_source(src, "NX008") == []
+
+
+# -- NX001 serving-fleet recovery table (optional-but-total) --------------------
+
+
+def test_nx001_serving_pod_recovery_absent_is_fine():
+    """Not every taxonomy grows every consumer: the fixture taxonomies
+    without the fleet table stay clean."""
+    assert lint_source(TAXONOMY_OK, "NX001", rel_path="supervisor/taxonomy.py") == []
+
+
+def test_nx001_serving_pod_recovery_must_be_total_when_present():
+    src = TAXONOMY_OK + """
+SERVING_POD_RECOVERY = {
+    DecisionAction.TO_RUNNING: "none",
+}
+"""
+    messages = [
+        f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")
+    ]
+    assert any("TO_FAIL has no SERVING_POD_RECOVERY row" in m for m in messages)
+
+
+def test_nx001_serving_pod_recovery_total_passes():
+    src = TAXONOMY_OK + """
+SERVING_POD_RECOVERY = {
+    DecisionAction.TO_RUNNING: "none",
+    DecisionAction.TO_FAIL: "recreate",
+}
+"""
+    assert lint_source(src, "NX001", rel_path="supervisor/taxonomy.py") == []
+
+
+def test_nx001_serving_pod_recovery_stale_row_flagged():
+    src = TAXONOMY_OK + """
+SERVING_POD_RECOVERY = {
+    DecisionAction.TO_RUNNING: "none",
+    DecisionAction.TO_FAIL: "recreate",
+    DecisionAction.TO_GHOST: "recreate",
+}
+"""
+    messages = [
+        f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")
+    ]
+    assert any("SERVING_POD_RECOVERY references unknown DecisionAction.TO_GHOST" in m for m in messages)

@@ -114,6 +114,31 @@ class TaxonomyTotalityRule(Rule):
                 members = _attr_names(value, "DecisionAction")
             tables[table] = (value, members)
 
+        # optional-but-total tables: auxiliary consequence maps (the
+        # serving-fleet recovery table, ISSUE 9).  Absence is fine — not
+        # every taxonomy grows every consumer — but a PRESENT table must be
+        # total over DecisionAction like the required ones: the fleet
+        # controller indexes it directly, so a hole is the same midnight
+        # KeyError class NX001 exists to stop.
+        for table in ("SERVING_POD_RECOVERY",):
+            value = _module_assign(module.tree, table)
+            if value is None:
+                continue
+            members = set()
+            if isinstance(value, ast.Dict):
+                for key in value.keys:
+                    if key is not None:
+                        members |= _attr_names(key, "DecisionAction")
+            tables[table] = (value, members)
+            for name, node in sorted(constants.items()):
+                if name not in members:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"DecisionAction.{name} has no {table} row "
+                        "(serving-fleet recovery undeclared)",
+                    )
+
         for name, node in sorted(constants.items()):
             if "DECISION_STAGE" in tables and name not in tables["DECISION_STAGE"][1]:
                 yield self.finding(

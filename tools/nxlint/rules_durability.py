@@ -9,6 +9,15 @@ NX007  tensor-checkpoint publish discipline: any code that writes
        right after ``ckpt.save()`` — Orbax saves may be async, so a
        preemption mid-save stranded the watchdog's restart on a torn step
        the ledger swore was there.
+
+NX008  params hot-swap discipline (the NX007 contract's serving mirror,
+       ISSUE 9): any ``swap_params(...)`` call site must be lexically
+       preceded, in the same function scope, by a verified-step resolution
+       (``restore_params`` / ``latest_verified_step`` / ``verify_step`` /
+       ...).  The bug class: a rolling update that loads the newest step
+       by mtime and swaps it into a live engine — a torn or bit-rotten
+       candidate would be served to every post-swap request with no error
+       anywhere.
 """
 
 from __future__ import annotations
@@ -174,6 +183,119 @@ class _DurabilityVisitor(ast.NodeVisitor):
         # class bodies execute at definition time — same frame rules apply
         self._check_scope(node, node.name)
         self.generic_visit(node)
+
+
+#: the hot-swap sinks: installing weights into a live executor/engine.
+#: Their own definitions are exempt (the engine method calling the executor
+#: method is the sink chain, not a call site needing its own barrier).
+_SWAP_CALLS = frozenset({"swap_params"})
+_SWAP_DEFS = frozenset(_SWAP_CALLS)
+
+#: names that prove the swapped params came out of a VERIFIED checkpoint
+#: step.  ``restore_params`` belongs here even though NX007 omits it: its
+#: contract IS verify-first (``TensorCheckpointer._resolve_step`` verifies
+#: before Orbax touches a byte), and it is the one call every honest swap
+#: path makes.  ``commit`` is deliberately ABSENT: committing step N proves
+#: nothing about the (possibly different, possibly rotten) step being
+#: swapped in.
+_SWAP_BARRIER_NAMES = frozenset(
+    {
+        "verify",
+        "verify_step",
+        "latest_verified_step",
+        "newest_verified_step",
+        "resolve_verified_uri",
+        "_resolve_verified_uri",
+        "restore_params",
+    }
+)
+
+
+def _swaps_and_barriers(scope: ast.AST) -> Tuple[List[ast.Call], Set[int]]:
+    """(swap_params call sites, line numbers where a verified-step
+    resolution is referenced) within the scope's own frame."""
+    swaps: List[ast.Call] = []
+    barrier_lines: Set[int] = set()
+    for node in _scope_statements(scope):
+        if isinstance(node, ast.Call) and _last_segment(node.func) in _SWAP_CALLS:
+            swaps.append(node)
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            if _last_segment(node) in _SWAP_BARRIER_NAMES:
+                barrier_lines.add(node.lineno)
+    return swaps, barrier_lines
+
+
+class _SwapVisitor(ast.NodeVisitor):
+    def __init__(self, rule: "ParamsSwapBarrierRule", module: Module) -> None:
+        self.rule = rule
+        self.module = module
+        self.findings: List[Finding] = []
+
+    def _check_scope(self, scope: ast.AST, scope_name: Optional[str]) -> None:
+        swaps, barrier_lines = _swaps_and_barriers(scope)
+        if not swaps:
+            return
+        if scope_name in _SWAP_DEFS:
+            return  # the sink chain itself; the obligation sits with callers
+        for call in swaps:
+            # <= end_lineno, same rationale as NX007: the barrier may BE an
+            # argument of the swap call, possibly formatter-wrapped —
+            # engine.swap_params(ckpt.restore_params(step)) is maximally safe
+            last_line = getattr(call, "end_lineno", None) or call.lineno
+            if not any(line <= last_line for line in barrier_lines):
+                self.findings.append(
+                    self.rule.finding(
+                        self.module,
+                        call,
+                        "swap_params() installs weights with no preceding "
+                        "verified-step resolution in this scope — resolve "
+                        "the step first (restore_params()/"
+                        "latest_verified_step()/verify_step()) so a live "
+                        "engine can never serve an unverified checkpoint",
+                    )
+                )
+
+    def visit_Module(self, node: ast.Module) -> None:
+        self._check_scope(node, None)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        # `cb = lambda: engine.swap_params(params)` must not dodge the rule
+        self._check_scope(node, None)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._check_scope(node, node.name)
+        self.generic_visit(node)
+
+
+@register
+class ParamsSwapBarrierRule(Rule):
+    """NX008: live-engine weight swaps only behind a verified-step
+    resolution.  Fails closed: EVERY call spelled ``*.swap_params(...)`` is
+    flagged unless a verified-step-resolution name lexically precedes it in
+    the same function scope (same conservative lexical analysis as NX007 —
+    the repo-clean gate plus the rollout chaos drills cover the dynamic
+    side; this rule stops the honest mistake of swapping whatever
+    ``latest_step()`` returned)."""
+
+    rule_id = "NX008"
+    description = "swap_params call sites need a preceding verified-step resolution"
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        visitor = _SwapVisitor(self, module)
+        visitor.visit(module.tree)
+        yield from visitor.findings
 
 
 @register

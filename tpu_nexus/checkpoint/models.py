@@ -86,6 +86,14 @@ class LifecycleStage:
 NEXUS_COMPONENT_LABEL = "science.sneaksanddata.com/nexus-component"
 #: component value for algorithm-run Jobs/Pods
 JOB_LABEL_ALGORITHM_RUN = "algorithm-run"
+#: component value for SERVING-fleet JobSets/Pods (ISSUE 9): a serving
+#: fleet is supervised by the fleet controller (serving/fleet.py —
+#: pod-level recreate/rolling-update decisions), NOT by the algorithm-run
+#: supervisor (whole-run terminal decisions).  The distinct component
+#: value is what keeps the two control loops from double-supervising one
+#: pod: ``is_nexus_run_event`` excludes it, ``is_serving_fleet_event``
+#: selects it.
+JOB_LABEL_SERVING_FLEET = "serving-fleet"
 #: carries the algorithm (job template) name on the Job
 JOB_TEMPLATE_NAME_KEY = "science.sneaksanddata.com/algorithm-template-name"
 #: k8s-standard pod->job backlink; how a pod event maps to its run id

@@ -38,6 +38,7 @@ class FakeKubeClient(KubeClient):
         self,
         objects: Optional[Dict[str, List[Dict[str, Any]]]] = None,
         jobset_controller: bool = False,
+        emit_pod_events: bool = False,
     ) -> None:
         """`objects` maps kind -> list of API dicts (the seeded cluster
         state).  With ``jobset_controller=True`` the fake also plays the
@@ -45,7 +46,17 @@ class FakeKubeClient(KubeClient):
         Jobs (`{js}-{replicatedJob}-{idx}`) and their pods, labeled exactly
         as the real controllers label them (jobset-name/replicatedjob-name
         backlinks, batch.kubernetes.io/job-name, completion-index
-        annotation) — the deployment shape VERDICT r3 found untested."""
+        annotation) — the deployment shape VERDICT r3 found untested.
+
+        With ``emit_pod_events=True`` the fake additionally plays the
+        kubelet's EVENT side for pods (ISSUE 9): a pod DELETED from the
+        cluster emits a ``Killing`` Event, a pod MODIFIED into phase
+        ``Failed`` emits a ``Failed`` Event carrying the container
+        termination text — what real clusters give the serving-fleet
+        controller to classify.  Events are NAMESPACE-scoped to the pod
+        (same discipline as the PR 2 dependents fix: pod names are only
+        unique per namespace, so a bare-name event would cross-classify a
+        same-named pod's death in another namespace)."""
         self._objects: Dict[str, Dict[Tuple[str, str], Dict[str, Any]]] = {
             kind: {} for kind in KIND_API
         }
@@ -57,8 +68,10 @@ class FakeKubeClient(KubeClient):
         self.actions: List[Tuple[str, str, str, str, Dict[str, Any]]] = []
         self._rv = 1
         self._jobset_controller = jobset_controller
+        self._emit_pod_events = emit_pod_events
         self._materialized_jobsets: set = set()
         self._uid_counter = 0
+        self._event_counter = 0
 
     # -- seeding / injection (test API) -------------------------------------
 
@@ -82,6 +95,118 @@ class FakeKubeClient(KubeClient):
             if key[1] and key not in self._materialized_jobsets:
                 self._materialized_jobsets.add(key)
                 self._materialize_jobset_children(obj)
+        if self._emit_pod_events and kind == "Pod":
+            self._emit_pod_lifecycle_event(event_type, obj)
+
+    def _emit_pod_lifecycle_event(self, event_type: str, pod: Dict[str, Any]) -> None:
+        """What the kubelet/event-recorder does when a pod dies: an Event
+        object scoped to the POD'S namespace (not the watcher's), so the
+        fleet controller's event classification is testable without a
+        cluster.  DELETED -> ``Killing``; MODIFIED into phase ``Failed`` ->
+        ``Failed`` with the container termination reasons/messages — the
+        text ``classify_tpu_failure`` runs its signature pass over."""
+        meta = pod.get("metadata") or {}
+        status = pod.get("status") or {}
+        statuses = status.get("containerStatuses") or []
+        crash_looping = any(
+            "BackOff" in (((cs.get("state") or {}).get("waiting") or {}).get("reason") or "")
+            for cs in statuses
+        )
+        if event_type == "DELETED":
+            reason, message = "Killing", f"Stopping container {meta.get('name', '')}"
+        elif event_type in ("ADDED", "MODIFIED") and (
+            status.get("phase") == "Failed" or crash_looping
+        ):
+            # kubelet parity: a crash-looping container emits `BackOff`
+            # (pod phase often still Running); a dead pod emits `Failed`
+            reason = "BackOff" if crash_looping else "Failed"
+            parts = []
+            for cs in statuses:
+                term = (cs.get("state") or {}).get("terminated") or (
+                    cs.get("lastState") or {}
+                ).get("terminated") or {}
+                if term:
+                    parts.append(
+                        f"{term.get('reason', '')}: {term.get('message', '')} "
+                        f"(exit {term.get('exitCode', '')})"
+                    )
+            message = "\n".join(parts) or "Pod failed"
+        else:
+            return
+        self._event_counter += 1
+        self.inject(
+            "ADDED",
+            "Event",
+            {
+                "kind": "Event",
+                "metadata": {
+                    "name": f"evt-{reason.lower()}-{meta.get('name', '')}-{self._event_counter}",
+                    "namespace": meta.get("namespace", ""),
+                },
+                "reason": reason,
+                "message": message,
+                "type": "Warning",
+                "involvedObject": {
+                    "kind": "Pod",
+                    "name": meta.get("name", ""),
+                    "namespace": meta.get("namespace", ""),
+                    "uid": meta.get("uid", ""),
+                },
+            },
+        )
+
+    def fail_pod(
+        self,
+        namespace: str,
+        name: str,
+        message: str = "",
+        reason: str = "Error",
+        exit_code: int = 1,
+        crash_loop: bool = False,
+    ) -> None:
+        """Test API: terminate a pod's container with ``message``/``exit_code``
+        and flip its phase to ``Failed`` (a MODIFIED watch event; with
+        ``emit_pod_events`` also the matching ``Failed`` Event).  ``message``
+        carries the failure wording the classifier's signature pass reads —
+        e.g. the HBM RESOURCE_EXHAUSTED text for the reduced-KV drill.
+        ``crash_loop=True`` models the restart-loop shape instead: container
+        waiting in ``CrashLoopBackOff`` (pod phase stays Running), emitted
+        Event reason ``BackOff`` — the kubelet's crash-loop signature."""
+        pod = self._objects.get("Pod", {}).get((namespace, name))
+        if pod is None:
+            raise NotFoundError(f"Pod {namespace}/{name} not found")
+        status = pod.setdefault("status", {})
+        state: Dict[str, Any] = {
+            "terminated": {
+                "reason": reason,
+                "message": message,
+                "exitCode": exit_code,
+            }
+        }
+        if crash_loop:
+            status["phase"] = "Running"
+            state = {
+                "waiting": {"reason": "CrashLoopBackOff"},
+                # the last crash's termination rides lastState, where the
+                # classifier's signature pass reads it (objects.py parity)
+            }
+            status["containerStatuses"] = [
+                {
+                    "name": "main",
+                    "state": state,
+                    "lastState": {
+                        "terminated": {
+                            "reason": reason,
+                            "message": message,
+                            "exitCode": exit_code,
+                        }
+                    },
+                }
+            ]
+        else:
+            status["phase"] = "Failed"
+            status["containerStatuses"] = [{"name": "main", "state": state}]
+        self.inject("MODIFIED", "Pod", pod)
 
     def _next_uid(self) -> str:
         self._uid_counter += 1

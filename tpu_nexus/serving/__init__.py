@@ -21,7 +21,13 @@ Layering (each module imports only downward):
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
 * ``engine``         — ModelExecutor / PagedModelExecutor (jitted compute)
                        + ServingEngine (host loop: fault isolation,
-                       deadlines, graceful drain, block-table admission)
+                       deadlines, graceful drain, block-table admission,
+                       the quiesce/swap_params rolling-update seam)
+* ``fleet``          — ServingFleet replica router + zero-drop rolling
+                       weight updates + FleetSupervisor (ISSUE 9: the
+                       supervisor's control loop closed over serving —
+                       taxonomy-classified pod recovery, checkpoint
+                       watcher, missing-pod sweep)
 """
 
 from tpu_nexus.serving.cache_manager import (
@@ -42,6 +48,14 @@ from tpu_nexus.serving.engine import (
     PagedModelExecutor,
     ServingEngine,
 )
+from tpu_nexus.serving.fleet import (
+    CAUSE_REPLICA_LOST,
+    CheckpointWatcher,
+    EngineReplica,
+    FleetError,
+    FleetSupervisor,
+    ServingFleet,
+)
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
 from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
@@ -58,8 +72,13 @@ __all__ = [
     "ACTIVE_STATES",
     "AdmitPlan",
     "BlockError",
+    "CAUSE_REPLICA_LOST",
+    "CheckpointWatcher",
     "DeviceStateLost",
+    "EngineReplica",
     "FifoScheduler",
+    "FleetError",
+    "FleetSupervisor",
     "IllegalTransition",
     "KVBlockManager",
     "KVSlotManager",
@@ -74,6 +93,7 @@ __all__ = [
     "SCRATCH_BLOCK",
     "SchedulerConfig",
     "ServingEngine",
+    "ServingFleet",
     "ServingMetrics",
     "SlotError",
     "StepFault",

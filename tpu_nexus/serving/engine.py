@@ -83,6 +83,7 @@ CAUSE_STARVATION = "starvation guard reclaimed slot"
 CAUSE_OVERFLOW = "cache overflow backstop"
 CAUSE_DRAIN_SHED = "drain: shed before admission"
 CAUSE_DRAIN_GRACE = "drain: grace budget exhausted"
+CAUSE_RELOAD_GRACE = "weight reload: quiesce grace exhausted"
 
 
 def _prefill_buckets(max_len: int) -> List[int]:
@@ -171,6 +172,42 @@ class _ExecutorCommon:
 
     def _fresh_cache(self):
         raise NotImplementedError  # pragma: no cover - subclass contract
+
+    def swap_params(self, params: Any) -> None:
+        """Hot-swap the model weights (rolling update, ISSUE 9).  Params
+        ride every jitted call as a plain argument, so a swap between
+        dispatches is safe and retrace-free as long as the new pytree has
+        the same structure/shapes/dtypes — verified here, because a
+        mismatched swap would otherwise silently retrace every jit
+        (doubling compile cost mid-rollout) or fail deep inside XLA.
+
+        Contract (nxlint NX008): the caller resolved ``params`` from a
+        VERIFIED checkpoint step — ``restore_params()`` / a
+        ``latest_verified_step()`` resolution — never from a bare
+        ``save()``; this is the serving mirror of the NX007 publish
+        barrier.  The ENGINE-level protocol (quiesce first, reset the
+        prefix index) lives in :meth:`ServingEngine.swap_params`."""
+
+        def spec(tree):
+            # treedef alone is blind to leaf shapes/dtypes — the exact
+            # mismatch (same-architecture model, different hidden size;
+            # unquantized weights into an int8 fleet) this guard exists for
+            return self._jax.tree.map(
+                lambda leaf: (
+                    tuple(getattr(leaf, "shape", ())),
+                    str(getattr(leaf, "dtype", type(leaf).__name__)),
+                ),
+                tree,
+            )
+
+        old, new = spec(self.params), spec(params)
+        if old != new:
+            raise ValueError(
+                "swap_params: new weights' pytree structure/shapes/dtypes "
+                "differ from the serving params — wrong checkpoint or "
+                "missing quantization transform"
+            )
+        self.params = params
 
     def _guard_cache(self, exc: RuntimeError) -> None:
         """After a faulted jitted call: if the DONATED cache buffer was
@@ -561,6 +598,14 @@ class ServingEngine:
         #: set by :meth:`drain`: admission is over, the engine only finishes
         #: (or evicts) what is already in flight
         self.draining = False
+        #: set by :meth:`pause_admission` (weight-reload quiesce, ISSUE 9):
+        #: NEW submits shed and the queue stops feeding slots, but — unlike
+        #: ``draining`` — queued requests are KEPT: they have no KV state
+        #: yet, so they simply wait through the swap and run on the new
+        #: weights; the pause is temporary by design
+        self.admission_paused = False
+        #: completed hot weight swaps (rolling updates land here)
+        self.weight_swaps = 0
         self._retired_log_limit = retired_log_limit
         #: LIVE requests only (queued + in flight): retirement removes the
         #: entry, so a long-running engine's memory is bounded by what is
@@ -618,6 +663,11 @@ class ServingEngine:
         if self.draining:
             self.metrics.shed("draining")
             raise QueueFull(f"request {rid} shed: engine is draining")
+        if self.admission_paused:
+            self.metrics.shed("reloading")
+            raise QueueFull(
+                f"request {rid} shed: admission paused for weight reload"
+            )
         if self.scheduler.full:
             self.metrics.shed("queue-full")
             raise QueueFull(
@@ -674,12 +724,17 @@ class ServingEngine:
                 self._retire(req, RequestState.EVICTED, cause=CAUSE_DEADLINE)
 
         # 3. admission: prefill into free slots under the token budget
-        # (suspended while draining — nothing new starts during shutdown)
-        admitted = 0 if self.draining else self._admit()
+        # (suspended while draining — nothing new starts during shutdown —
+        # and while paused for a weight swap: a prefill run now would pin
+        # old-weight KV into a request meant to ride the new weights)
+        admitted = (
+            0 if (self.draining or self.admission_paused) else self._admit()
+        )
 
         # 4. starvation guard: reclaim the youngest slot for a starving head
         if (
             not self.draining
+            and not self.admission_paused
             and self.scheduler.head_starving()
             and self._admission_blocked()
         ):
@@ -815,6 +870,107 @@ class ServingEngine:
             "drain_evicted": evicted,
             "drain_shed_queue": shed_queue,
         }
+
+    # -- rolling weight updates (ISSUE 9) --------------------------------------
+
+    @property
+    def in_flight(self) -> int:
+        """Requests currently holding a slot (prefilled — their KV embeds
+        the CURRENT weights).  What the quiesce protocol must finish before
+        a swap; queued requests are not in flight."""
+        return len(self._active)
+
+    def pause_admission(self) -> None:
+        """Stop accepting NEW submits (they shed ``QueueFull`` with reason
+        ``reloading`` — the fleet router retries another replica) AND stop
+        feeding queued requests into slots.  Unlike :meth:`drain`, the
+        queue is KEPT: a queued request has no KV state, so it safely waits
+        through the weight swap and runs entirely on the new weights —
+        which is exactly why a reload never needs to drop it."""
+        self.admission_paused = True
+
+    def resume_admission(self) -> None:
+        self.admission_paused = False
+
+    def evict_in_flight(self, cause: str) -> int:
+        """Evict every IN-FLIGHT (slotted) request with the honest
+        ``cause`` — the grace-expiry backstop of the quiesce protocol (and
+        of the fleet's rolling update).  Queued requests are untouched:
+        they can still run on whatever weights come next.  Returns how
+        many were evicted."""
+        evicted = 0
+        for req in list(self._active.values()):
+            self._retire(req, RequestState.EVICTED, cause=cause)
+            evicted += 1
+        return evicted
+
+    def abandon(self, cause: str) -> int:
+        """The replica's PROCESS is gone (serving pod killed): every live
+        request died with it, so the fleet accounts them here — decoding
+        requests retire ``FAILED`` with the classified ``cause`` (device
+        time was lost mid-generation), queued ones ``EVICTED`` (they never
+        got device time — same wording contract as a drain shed).  Returns
+        how many requests were accounted."""
+        n = 0
+        for req in self.scheduler.drain_queue():
+            self._retire(req, RequestState.EVICTED, cause=cause)
+            n += 1
+        for req in list(self._active.values()):
+            self._retire(req, RequestState.FAILED, cause=cause)
+            n += 1
+        return n
+
+    def quiesce(self, grace_s: float, max_steps: int = 1_000_000) -> Dict[str, int]:
+        """Weight-swap preamble: pause admission, keep stepping until every
+        IN-FLIGHT request finishes on the current weights, bounded by the
+        ``grace_s`` budget — stragglers past the budget evict with cause
+        :data:`CAUSE_RELOAD_GRACE` so the swap can never hang behind one
+        slow generation.  Queued requests are deliberately NOT drained:
+        they carry no KV, so they wait through the swap and run on the new
+        weights — a deep queue costs a reload nothing.  Admission STAYS
+        paused on return: the caller swaps params and then
+        :meth:`resume_admission`."""
+        self.pause_admission()
+        deadline = self._clock() + max(0.0, grace_s)
+        finished_before = self.metrics.retired.get(RequestState.FINISHED, 0)
+        steps = 0
+        while self._active and steps < max_steps and self._clock() < deadline:
+            self.step()
+            steps += 1
+        evicted = self.evict_in_flight(CAUSE_RELOAD_GRACE)
+        return {
+            "quiesce_steps": steps,
+            "quiesce_finished": self.metrics.retired.get(RequestState.FINISHED, 0)
+            - finished_before,
+            "quiesce_evicted": evicted,
+        }
+
+    def swap_params(self, params: Any) -> None:
+        """Install new weights into the quiesced engine (the rolling-update
+        seam).  Refuses while requests are in flight — a mid-generation
+        swap would emit tokens from MIXED weights, which no client asked
+        for; callers hold :meth:`quiesce` first.  Queued-but-unstarted
+        requests are fine: their prefill has not run, so they execute
+        entirely on the new weights.
+
+        In paged mode the prefix index is RESET: every cached prefix block
+        holds KV computed with the old weights, and serving one as a
+        shared prefix of a new-weights prompt would mix weights through
+        the cache instead of the params.  NX008 holds the verified-step
+        contract (see the executor-level docstring)."""
+        if self._active:
+            raise RuntimeError(
+                f"swap_params with {len(self._active)} request(s) in flight "
+                "— quiesce() the engine first (a mid-generation swap would "
+                "serve tokens from mixed weights)"
+            )
+        self.executor.swap_params(params)
+        if self.paged is not None:
+            # old-weight KV must never be served as a cached prefix of a
+            # new-weight prompt: drop the index, invalidate plans
+            self.paged.reset()
+        self.weight_swaps += 1
+        self.metrics.weight_swap()
 
     # -- internals -------------------------------------------------------------
 

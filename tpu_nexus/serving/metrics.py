@@ -64,6 +64,8 @@ class ServingMetrics:
         #: last-step token-level occupancy sample (summary convenience;
         #: the gauge stream is the production signal)
         self.token_occupancy = 0.0
+        #: completed hot weight swaps (rolling updates, ISSUE 9)
+        self.weight_swaps_total = 0
 
     def queue_wait(self, seconds: float) -> None:
         """Submit → admission (slot granted), the scheduler-owned slice of
@@ -127,6 +129,12 @@ class ServingMetrics:
         self._m.count("serving.prefix_hit")
         self._m.count("serving.prefix_shared_tokens", value=shared_tokens)
 
+    def weight_swap(self) -> None:
+        """One completed hot weight swap (the engine finished a quiesce and
+        installed new verified weights — a rolling-update progress tick)."""
+        self.weight_swaps_total += 1
+        self._m.count("serving.weight_swaps")
+
     def blocks_cow(self, n: int = 1) -> None:
         """``n`` copy-on-write block copies at admission (a shared partial
         block diverged)."""
@@ -161,6 +169,7 @@ class ServingMetrics:
             "prefix_hits": self.prefix_hits,
             "prefix_shared_tokens": self.prefix_shared_tokens,
             "blocks_cow": self.blocks_cow_total,
+            "weight_swaps": self.weight_swaps_total,
             "token_occupancy": self.token_occupancy,
             "ttft_p50_s": percentile(self.ttft_s, 50),
             "ttft_p99_s": percentile(self.ttft_s, 99),

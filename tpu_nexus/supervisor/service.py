@@ -29,7 +29,12 @@ from dataclasses import dataclass
 from datetime import datetime, timedelta, timezone
 from typing import Dict, Optional
 
-from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.models import (
+    JOB_LABEL_ALGORITHM_RUN,
+    JOB_LABEL_SERVING_FLEET,
+    CheckpointedRequest,
+    LifecycleStage,
+)
 from tpu_nexus.checkpoint.store import CheckpointStore
 from tpu_nexus.core.pipeline import PipelineStageActor
 from tpu_nexus.core.signals import LifecycleContext
@@ -158,6 +163,12 @@ class Supervisor:
         # observability counters (tests + metrics)
         self.events_seen = 0
         self.events_filtered = 0
+        #: serving-fleet events dropped HERE by design (ISSUE 9): pod-level
+        #: serving failures belong to the fleet controller
+        #: (serving/fleet.py), and this supervisor acting on them too would
+        #: double-supervise one pod — delete a JobSet the fleet is about to
+        #: heal, or write a terminal stage over a row the fleet keeps alive
+        self.events_delegated = 0
         self.decisions_enqueued = 0
         self.decisions_executed = 0
         self.commit_latencies: deque = deque(maxlen=2048)
@@ -289,7 +300,23 @@ class Supervisor:
         if not event.meta.name:
             return  # sanity check (reference :139)
         informers = self._factory.informers
-        if not resolvers.is_nexus_run_event(event, self.namespace, informers):
+        # ONE ownership-chain walk decides both questions (hot path: every
+        # watch event lands here)
+        component = resolvers.event_component(event, self.namespace, informers)
+        if component == JOB_LABEL_SERVING_FLEET:
+            # division of labor (ISSUE 9): serving-fleet pods are the fleet
+            # controller's to heal (recreate / reduced-KV / escalate), never
+            # this supervisor's to terminate — counted separately so a
+            # dashboard can tell delegation from noise
+            self.events_delegated += 1
+            self._metrics.count("events_delegated_to_fleet")
+            self._log.v(2).info(
+                "delegating serving-fleet event to the fleet controller",
+                event=event.meta.name,
+                reason=event.reason,
+            )
+            return
+        if component != JOB_LABEL_ALGORITHM_RUN:
             self.events_filtered += 1
             self._log.v(4).info(
                 "dropping non-nexus event", event=event.meta.name, reason=event.reason

@@ -126,6 +126,53 @@ NON_DELETING_ACTIONS = frozenset(
 #: protocol), so it is deliberately absent.
 STEP_RETRYABLE_ACTIONS = frozenset({DecisionAction.TO_FAIL_ICI_LINK_DOWN})
 
+
+class FleetRecovery:
+    """What the serving-fleet controller (serving/fleet.py, ISSUE 9) does
+    about a classified SERVING-pod failure.  A serving pod is a stateless
+    replica behind a router — unlike a training run, killing one is not a
+    run-terminal event, so the whole-run ``DECISION_STAGE`` verdicts do
+    not apply; these do."""
+
+    #: delete/replace the pod and revive its engine replica on the newest
+    #: verified weights — the default for infrastructure causes
+    RECREATE = "recreate"
+    #: recreate, but with the replica's ``NEXUS_KV_BLOCKS`` budget halved:
+    #: an HBM OOM in a serving pod is a KV-pool-sizing fact, and replaying
+    #: the same pool just replays the OOM
+    RECREATE_REDUCED_KV = "recreate-reduced-kv"
+    #: record the cause and STOP recreating — deterministic program/config
+    #: facts (compile abort, stuck-pending scheduling failures) where a
+    #: fresh pod replays the identical failure; an operator owns the fix
+    ESCALATE = "escalate"
+    #: not a failure (ToRunning) — nothing to recover
+    NONE = "none"
+
+
+#: decision -> serving-fleet recovery, TOTAL over DecisionAction (nxlint
+#: NX001, same contract as ACTION_MESSAGES): a serving-pod failure class
+#: with no declared recovery is the midnight-KeyError bug class all over
+#: again, now inside the fleet controller.  The fleet indexes this dict
+#: directly — an unmapped action fails loudly, never silently drops a pod.
+SERVING_POD_RECOVERY: Dict[str, str] = {
+    DecisionAction.TO_RUNNING: FleetRecovery.NONE,
+    #: scheduling/config failure — a recreated pod lands Pending again
+    DecisionAction.TO_FAIL_STUCK_IN_PENDING: FleetRecovery.ESCALATE,
+    DecisionAction.TO_FAIL_DEADLINE_EXCEEDED: FleetRecovery.ESCALATE,
+    #: crash-loop (BackOff / generic fatal): the classic recreate case
+    DecisionAction.TO_FAIL_FATAL_ERROR: FleetRecovery.RECREATE,
+    #: deterministic program fact — recreating replays the compile
+    DecisionAction.TO_FAIL_COMPILE_ABORT: FleetRecovery.ESCALATE,
+    #: KV-pool sizing fact — recreate with a smaller NEXUS_KV_BLOCKS
+    DecisionAction.TO_FAIL_HBM_OOM: FleetRecovery.RECREATE_REDUCED_KV,
+    #: slice-local hardware fault — a replacement pod may land on a
+    #: healthy slice
+    DecisionAction.TO_FAIL_ICI_LINK_DOWN: FleetRecovery.RECREATE,
+    DecisionAction.TO_PREEMPT_RESTARTABLE: FleetRecovery.RECREATE,
+    DecisionAction.TO_FAIL_STUCK_IN_RUNNING: FleetRecovery.RECREATE,
+    DecisionAction.TO_FAIL_RESTART_STALLED: FleetRecovery.ESCALATE,
+}
+
 #: decision -> human run-status message, TOTAL over DecisionAction (nxlint
 #: NX001).  TO_RUNNING maps to "" because Running results carry the raw
 #: event reason, not a canned message (reference services/supervisor.go:166).

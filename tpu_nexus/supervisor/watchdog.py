@@ -56,7 +56,7 @@ import asyncio
 import time
 from dataclasses import dataclass
 from datetime import datetime, timedelta, timezone
-from typing import Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 from tpu_nexus.checkpoint.models import LifecycleStage
 from tpu_nexus.checkpoint.store import CheckpointStore
@@ -74,6 +74,41 @@ from tpu_nexus.supervisor.taxonomy import (
 class _Observation:
     fingerprint: Tuple
     since: float  # monotonic timestamp when this fingerprint was first seen
+
+
+class StalenessTracker:
+    """Fingerprint-staleness bookkeeping shared by absence-driven sweeps:
+    this watchdog's RUNNING/PREEMPTED sweeps and the serving-fleet
+    controller's missing-pod sweep (serving/fleet.py, ISSUE 9).  The
+    contract is the module-doc staleness rule in one reusable piece:
+    staleness is *this process's monotonic observation* of an unchanged
+    fingerprint — never a wall-clock column comparison — so a restarted
+    observer starts its deadlines over (delayed, never lost)."""
+
+    def __init__(self) -> None:
+        self.entries: Dict[Any, _Observation] = {}
+
+    def observe(self, key: Any, fingerprint: Tuple, now: float) -> Optional[float]:
+        """Record ``key``'s fingerprint at ``now``; returns how long it has
+        been UNCHANGED, or None when it just changed (timer restarted)."""
+        obs = self.entries.get(key)
+        if obs is None or obs.fingerprint != fingerprint:
+            self.entries[key] = _Observation(fingerprint=fingerprint, since=now)
+            return None
+        return now - obs.since
+
+    def forget(self, key: Any) -> None:
+        """Drop ``key`` (a decision now owns it, or it left the swept set)
+        — the next observation starts a fresh timer."""
+        self.entries.pop(key, None)
+
+    def retain(self, live_keys) -> None:
+        """Forget every key not in ``live_keys`` so rows/pods that left the
+        swept universe cannot pin entries for the process lifetime."""
+        live = set(live_keys)
+        for key in list(self.entries):
+            if key not in live:
+                del self.entries[key]
 
 
 class HeartbeatWatchdog:
@@ -133,9 +168,15 @@ class HeartbeatWatchdog:
         #: CachingUriResolver`` — the bare function re-checksums the step
         #: on every sweep.
         self._resolve_verified_uri = resolve_verified_uri
-        self._observations: Dict[Tuple[str, str], _Observation] = {}
+        self._tracker = StalenessTracker()
         self.flagged = 0  # observability counter (tests + metrics)
         self.ckpt_rollbacks = 0  # URIs repointed at a previous verified step
+
+    @property
+    def _observations(self) -> Dict[Any, _Observation]:
+        """The tracker's raw entries (kept under the historical name —
+        tests and operators introspect it)."""
+        return self._tracker.entries
 
     @staticmethod
     def _fingerprint(cp) -> Tuple:
@@ -194,10 +235,9 @@ class HeartbeatWatchdog:
             for cp in rows:
                 key = (cp.algorithm, cp.id)
                 live_keys.add(key)
-                obs = self._observe(key, self._fingerprint(cp), now)
-                if obs is None:
+                stalled_for = self._tracker.observe(key, self._fingerprint(cp), now)
+                if stalled_for is None:
                     continue
-                stalled_for = now - obs.since
                 window = self._stale_after if cp.per_chip_steps else self._first_progress_grace
                 if stalled_for < window:
                     continue
@@ -220,7 +260,7 @@ class HeartbeatWatchdog:
                 )
                 # the decision owns the run now; if its commit fails the actor
                 # retries — re-observing from scratch would double-flag
-                del self._observations[key]
+                self._tracker.forget(key)
 
         if self._restart_deadline is not None:
             rows = await asyncio.to_thread(self._store.query_by_stage, LifecycleStage.PREEMPTED)
@@ -228,10 +268,11 @@ class HeartbeatWatchdog:
                 key = (cp.algorithm, cp.id)
                 live_keys.add(key)
                 await self._repoint_unverifiable_checkpoint(cp)
-                obs = self._observe(key, self._restart_fingerprint(cp), now)
-                if obs is None:
+                stalled_for = self._tracker.observe(
+                    key, self._restart_fingerprint(cp), now
+                )
+                if stalled_for is None:
                     continue
-                stalled_for = now - obs.since
                 if stalled_for < self._restart_deadline:
                     continue
                 self._log.info(
@@ -255,13 +296,11 @@ class HeartbeatWatchdog:
                     ),
                     "watchdog_restart_stalled_runs",
                 )
-                del self._observations[key]
+                self._tracker.forget(key)
 
         # forget rows that left the swept stages (completed/failed/cancelled,
         # or resumed RUNNING while the RUNNING sweep is disabled)
-        for key in list(self._observations):
-            if key not in live_keys:
-                del self._observations[key]
+        self._tracker.retain(live_keys)
 
     async def _repoint_unverifiable_checkpoint(self, cp) -> None:
         """Restart path, checkpoint side: a PREEMPTED row whose published
@@ -311,15 +350,6 @@ class HeartbeatWatchdog:
         self._metrics.count("watchdog_ckpt_rollbacks")
         self.ckpt_rollbacks += 1
         cp.tensor_checkpoint_uri = resolved
-
-    def _observe(self, key, fp: Tuple, now: float) -> Optional[_Observation]:
-        """Record/update the fingerprint observation; returns None when the
-        fingerprint just changed (timer restarted)."""
-        obs = self._observations.get(key)
-        if obs is None or obs.fingerprint != fp:
-            self._observations[key] = _Observation(fingerprint=fp, since=now)
-            return None
-        return obs
 
     async def run(self, ctx: LifecycleContext) -> None:
         """Sweep every interval until the lifecycle context cancels."""

@@ -434,6 +434,73 @@ class CachingUriResolver:
         return resolved
 
 
+class VerifiedStepPoller:
+    """Cheap newest-verified-step polling for sweep-cadence consumers (the
+    serving reload check, the fleet's checkpoint watcher): an uncached
+    :func:`newest_verified_step` deep-verifies the newest step — a full
+    re-hash of a multi-GB checkpoint — on EVERY poll, forever.
+
+    Same trade as :class:`CachingUriResolver`: the scan result is cached
+    against a fingerprint of the directory's step entries and their commit
+    markers' identity ``(mtime_ns, size)``.  Any commit, adoption, or
+    quarantine changes the fingerprint and re-triggers a real scan, so a
+    steady-state poll costs one ``listdir`` + ``stat``s.  Corruption
+    arriving while the markers stay byte-identical is NOT re-detected here
+    — the commit marker is the poll-side trust anchor, and the load side
+    (``TensorCheckpointer.restore_params``) still deep-verifies before any
+    bytes are trusted, so a poll-side false positive can never be served.
+
+    ``quarantine=True`` hands the scan mutation rights (rename bad steps
+    to ``<step>.corrupt``) — only for callers that OWN the directory; the
+    default is the read-only contract serving already holds."""
+
+    def __init__(self, directory: str, quarantine: bool = False) -> None:
+        self.directory = directory
+        self.quarantine = quarantine
+        #: rollback events accumulated across scans (same record shape as
+        #: :func:`newest_verified_step`) — callers report/clear
+        self.rollbacks: List[Dict[str, Any]] = []
+        self.scans = 0  # real (non-cached) scans, for tests/metrics
+        self._fingerprint: Optional[tuple] = None
+        self._last: Optional[int] = None
+
+    def _dir_fingerprint(self) -> tuple:
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return ()
+        entries = []
+        for name in names:
+            if not name.isdigit():
+                continue
+            try:
+                st = os.stat(os.path.join(self.directory, name, MANIFEST_NAME))
+                marker = (st.st_mtime_ns, st.st_size)
+            except OSError:
+                marker = None
+            entries.append((name, marker))
+        return tuple(sorted(entries))
+
+    def latest_verified_step(self) -> Optional[int]:
+        """Newest verified step, re-scanned only when the directory's step
+        entries / commit markers changed since the last poll.  The
+        fingerprint is taken BEFORE the scan: renames the scan itself
+        performs (quarantine) change the directory, so the next poll pays
+        one redundant scan and then stabilizes — staleness is never
+        possible, only one extra scan."""
+        fp = self._dir_fingerprint()
+        if fp == self._fingerprint:
+            return self._last
+        step, rollbacks = newest_verified_step(
+            self.directory, quarantine=self.quarantine
+        )
+        self.rollbacks.extend(rollbacks)
+        self.scans += 1
+        self._fingerprint = fp
+        self._last = step
+        return step
+
+
 def _main(argv: List[str]) -> int:
     """``python -m tpu_nexus.workload.durability adopt <dir>`` — the
     one-command upgrade migration (stdlib-only, safe on any host)."""

@@ -300,11 +300,14 @@ class FaultyExecutor:
         return self.inner.step(tokens, cursors, *args)
 
 
-def _flip_committed_leaf(step_dir: str) -> str:
+def flip_committed_leaf(step_dir: str) -> str:
     """Flip one byte of a committed payload file — silent media corruption
     the manifest checksums must catch.  Prefers content-addressed leaf data
     (orbax ocdbt ``d/`` files) over metadata so the drill corrupts an actual
-    tensor leaf; deterministic pick (first sorted candidate)."""
+    tensor leaf; deterministic pick (first sorted candidate).  Public: the
+    rollout chaos harness (tests/test_rollout_chaos.py) corrupts rolling-
+    update CANDIDATE checkpoints with the exact same primitive the
+    checkpoint drills use."""
     from tpu_nexus.workload import durability
 
     files = durability.manifest_files(step_dir)
@@ -353,7 +356,7 @@ def checkpoint_fault_hook(plan: FaultPlan):
             os.kill(os.getpid(), signal.SIGTERM)
         elif plan.mode == "ckpt-bitflip" and point == "post-commit":
             fired["count"] += 1
-            target = _flip_committed_leaf(step_dir)
+            target = flip_committed_leaf(step_dir)
             logger.warning(
                 "injecting ckpt-bitflip: corrupted %s after commit of step %d",
                 target, step,
@@ -364,6 +367,10 @@ def checkpoint_fault_hook(plan: FaultPlan):
     # nothing — the run must not exit 0 looking like a passed drill)
     hook.fired = fired
     return hook
+
+
+#: back-compat alias (tests imported the pre-rollout private name)
+_flip_committed_leaf = flip_committed_leaf
 
 
 def wrap_executor(plan: FaultPlan, executor):
