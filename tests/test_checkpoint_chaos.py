@@ -62,6 +62,8 @@ CTX = ProcessContext(
 
 
 def mnist_cfg(**over):
+    from tpu_nexus.workload.health import HealthConfig
+
     base = dict(
         model=get_adapter("mnist"),
         mesh=MeshSpec(fsdp=-1),
@@ -70,6 +72,12 @@ def mnist_cfg(**over):
         steps=6,
         heartbeat_every=2,
         checkpoint_every=2,
+        # sentinel off: these drills pin seed-calibrated bit-identical loss
+        # trajectories, and the gating ops cost compile time in every one
+        # of this file's ~20 fresh jits (tier-1 870s budget).  The
+        # health x checkpoint composition has its own drills:
+        # tests/test_training_health.py rolls back against REAL commits.
+        health=HealthConfig(enabled=False),
     )
     base.update(over)
     return WorkloadConfig(**base)
@@ -612,6 +620,7 @@ from tpu_nexus.models.registry import get_adapter
 from tpu_nexus.parallel import MeshSpec
 from tpu_nexus.parallel.distributed import ProcessContext
 from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.workload.health import HealthConfig
 
 ledger, ckpt_dir, rid, algo, steps = sys.argv[1:6]
 run_workload(
@@ -619,6 +628,7 @@ run_workload(
         model=get_adapter("mnist"), mesh=MeshSpec(fsdp=-1), batch_size=8,
         seq_len=16, steps=int(steps), heartbeat_every=2, checkpoint_every=2,
         checkpoint_dir=ckpt_dir,
+        health=HealthConfig(enabled=False),  # seed-program parity with mnist_cfg
     ),
     store=SqliteCheckpointStore(ledger),
     ctx=ProcessContext(run_id=rid, algorithm=algo, process_id=0,

@@ -131,6 +131,10 @@ async def test_full_chain_launch_run_fail(tmp_path):
             "NEXUS__SQLITE_STORE_PATH": ledger,
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+            # sentinel off: this chain is about supervisor classification of
+            # the fault-injected death; skip the gating ops' compile bill
+            # (tier-1 budget; health has its own e2e drills)
+            "NEXUS_HEALTH": "0",
         }
     )
     proc = await asyncio.to_thread(
@@ -269,6 +273,7 @@ async def test_full_chain_jobset_multihost(tmp_path):
             "PALLAS_AXON_POOL_IPS": "",
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "NEXUS_HEALTH": "0",  # sentinel off: compile budget (see above)
         }
     )
     procs = [
@@ -450,6 +455,7 @@ async def test_north_star_preempt_recreate_resume_one_piece(tmp_path):
             "PALLAS_AXON_POOL_IPS": "",
             "JAX_PLATFORMS": "cpu",
             "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+            "NEXUS_HEALTH": "0",  # sentinel off: compile budget (see above)
         }
     )
 

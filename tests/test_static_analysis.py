@@ -1086,6 +1086,24 @@ def test_nx007_suppressible_per_line():
     assert lint_source(src, "NX007") == []
 
 
+def test_nx007_health_rollback_is_a_publisher():
+    """The health-policy recovery repoint (ISSUE 10) writes the same ledger
+    column — callers carry the same barrier obligation."""
+    bare = """
+    def recover(ckpt, reporter, step):
+        reporter.health_rollback(ckpt.uri_for(step), step, "{}")
+    """
+    findings = lint_source(bare, "NX007")
+    assert [f.rule_id for f in findings] == ["NX007"]
+    assert "health_rollback()" in findings[0].message
+    barriered = """
+    def recover(ckpt, reporter, anomaly):
+        target = ckpt.latest_verified_step(before=anomaly.step + 1)
+        reporter.health_rollback(ckpt.uri_for(target), target, "{}")
+    """
+    assert lint_source(barriered, "NX007") == []
+
+
 def test_nx007_publish_inside_lambda_flagged():
     """Fail-closed must reach lambda bodies: a publish deferred through a
     callback is still a publish, and a barrier in the ENCLOSING scope
@@ -1098,6 +1116,88 @@ def test_nx007_publish_inside_lambda_flagged():
     """
     findings = lint_source(src, "NX007")
     assert len(findings) == 1 and "durability barrier" in findings[0].message
+
+
+# -- NX009 chaos coverage -------------------------------------------------------
+
+FAULTS_SRC = """
+EXECUTOR_FAULT_MODES = frozenset({"step-boom"})
+DATA_FAULT_MODES = frozenset({"bad-data", "worse-data"})
+
+def maybe_inject(plan):
+    if plan.mode == "kill-now":
+        raise SystemExit(1)
+"""
+
+
+def _faults_project(tmp_path, faults_src=FAULTS_SRC, tests=None):
+    pkg = tmp_path / "pkg" / "workload"
+    pkg.mkdir(parents=True)
+    (pkg / "faults.py").write_text(textwrap.dedent(faults_src))
+    if tests is not None:
+        tests_dir = tmp_path / "tests"
+        tests_dir.mkdir()
+        for name, src in tests.items():
+            (tests_dir / name).write_text(textwrap.dedent(src))
+    rules = [r for r in all_rules() if r.rule_id == "NX009"]
+    return lint_paths([str(tmp_path / "pkg")], root=str(tmp_path), rules=rules)
+
+
+def test_nx009_collects_table_and_comparison_modes(tmp_path):
+    from tools.nxlint.rules_faults import registered_fault_modes
+    import ast as _ast
+
+    modes = registered_fault_modes(_ast.parse(textwrap.dedent(FAULTS_SRC)))
+    assert set(modes) == {"step-boom", "bad-data", "worse-data", "kill-now"}
+
+
+def test_nx009_fully_drilled_registry_passes(tmp_path):
+    findings = _faults_project(
+        tmp_path,
+        tests={
+            "test_chaos.py": """
+            def test_modes():
+                drill("step-boom"); drill('bad-data')
+                assert mode == "kill-now" or mode == "worse-data"
+            """,
+        },
+    )
+    assert findings == []
+
+
+def test_nx009_undrilled_mode_flagged(tmp_path):
+    findings = _faults_project(
+        tmp_path,
+        tests={"test_chaos.py": 'MODES = ["step-boom", "bad-data", "kill-now"]\n'},
+    )
+    assert [f.rule_id for f in findings] == ["NX009"]
+    assert "'worse-data'" in findings[0].message
+
+
+def test_nx009_missing_tests_dir_fails_closed(tmp_path):
+    findings = _faults_project(tmp_path, tests=None)
+    assert [f.rule_id for f in findings] == ["NX009"]
+    assert "no test files found" in findings[0].message
+
+
+def test_nx009_unparseable_registry_fails_closed(tmp_path):
+    findings = _faults_project(
+        tmp_path,
+        faults_src="WHATEVER = 1\n",
+        tests={"test_x.py": "pass\n"},
+    )
+    assert [f.rule_id for f in findings] == ["NX009"]
+    assert "no fault modes found" in findings[0].message
+
+
+def test_nx009_absent_registry_out_of_scope(tmp_path):
+    """Projects without workload/faults.py (the tools tree gate) are not
+    this rule's business."""
+    pkg = tmp_path / "other"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    rules = [r for r in all_rules() if r.rule_id == "NX009"]
+    assert lint_paths([str(pkg)], root=str(tmp_path), rules=rules) == []
 
 
 def test_nx007_lambda_with_inline_barrier_passes():

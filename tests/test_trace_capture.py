@@ -82,6 +82,63 @@ def test_classify_precedence_on_combined_traces():
     assert classify_tpu_failure("container exited 1: assertion failed") is None
 
 
+def test_health_causes_classify_and_do_not_shadow():
+    """ISSUE 10 satellite: the training-health signatures (NUMERIC_NAN /
+    LOSS_SPIKE / STEP_HANG) rank BELOW every pre-existing signature and,
+    symmetrically, none of the pre-existing canonical texts trips a health
+    pattern — extend the precedence matrix in both directions."""
+    from tpu_nexus.supervisor.taxonomy import (
+        MSG_LOSS_SPIKE,
+        MSG_NUMERIC_NAN,
+        MSG_STEP_HANG,
+        DecisionAction,
+    )
+
+    nan = "numeric health sentinel: non-finite loss/grad_norm at step 5 (loss=nan)"
+    spike = "numeric health sentinel: loss spike at step 7; skip budget exhausted"
+    hang = "step-hang: step 5 exceeded its 2s step deadline"
+    assert classify_tpu_failure(nan) == DecisionAction.TO_FAIL_NUMERIC_NAN
+    assert classify_tpu_failure(spike) == DecisionAction.TO_FAIL_LOSS_SPIKE
+    assert classify_tpu_failure(hang) == DecisionAction.TO_FAIL_STEP_HANG
+    # the human messages round-trip (k8s event text re-classification)
+    assert classify_tpu_failure(MSG_NUMERIC_NAN) == DecisionAction.TO_FAIL_NUMERIC_NAN
+    assert classify_tpu_failure(MSG_LOSS_SPIKE) == DecisionAction.TO_FAIL_LOSS_SPIKE
+    assert classify_tpu_failure(MSG_STEP_HANG) == DecisionAction.TO_FAIL_STEP_HANG
+
+    # every pre-existing signature WINS over every health signature when
+    # both appear in one trace (hardware cause > self-reported symptom)
+    preempt = "node shutdown: spot reclaim"
+    ici = "ICI link down on chip 3"
+    oom = "RESOURCE_EXHAUSTED: HBM OOM while allocating"
+    compile_ = "XLA compilation error: Mosaic lowering failed"
+    for health_text in (nan, spike, hang):
+        assert classify_tpu_failure(f"{health_text}\n{preempt}") == (
+            DecisionAction.TO_PREEMPT_RESTARTABLE
+        ), health_text
+        assert classify_tpu_failure(f"{health_text}\n{ici}") == (
+            DecisionAction.TO_FAIL_ICI_LINK_DOWN
+        ), health_text
+        assert classify_tpu_failure(f"{health_text}\n{oom}") == (
+            DecisionAction.TO_FAIL_HBM_OOM
+        ), health_text
+        assert classify_tpu_failure(f"{health_text}\n{compile_}") == (
+            DecisionAction.TO_FAIL_COMPILE_ABORT
+        ), health_text
+    # and within the health family: hang > nan > spike
+    assert classify_tpu_failure(f"{nan}\n{hang}") == DecisionAction.TO_FAIL_STEP_HANG
+    assert classify_tpu_failure(f"{spike}\n{nan}") == DecisionAction.TO_FAIL_NUMERIC_NAN
+
+    # symmetric non-shadowing: old canonical texts still classify OLD —
+    # none of them matches a health pattern first (they classify the same
+    # as before the health signatures existed)
+    assert classify_tpu_failure(preempt) == DecisionAction.TO_PREEMPT_RESTARTABLE
+    assert classify_tpu_failure(ici) == DecisionAction.TO_FAIL_ICI_LINK_DOWN
+    assert classify_tpu_failure(oom) == DecisionAction.TO_FAIL_HBM_OOM
+    assert classify_tpu_failure(compile_) == DecisionAction.TO_FAIL_COMPILE_ABORT
+    # non-failure text still classifies to nothing
+    assert classify_tpu_failure("container exited 1: assertion failed") is None
+
+
 @pytest.mark.parametrize(
     "text,expected",
     [

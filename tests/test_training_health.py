@@ -1,0 +1,933 @@
+"""Self-healing training chaos (ISSUE 10): in-jit numerical sentinel,
+rollback-and-skip recovery, and the step-hang watchdog.
+
+The drills assert the graded-recovery ladder end to end:
+
+* a NaN batch never lands an update (the jit gates params on the sentinel
+  verdict), the run rolls back to the newest VERIFIED pre-window
+  checkpoint, skips the poisoned draw window on the data cursor, and ends
+  COMPLETED with the cause + window in the ledger details — with a
+  post-recovery loss **bit-identical** to a fault-free run on the same
+  skipped-window schedule;
+* a loss spike skips its update in-jit inside a bounded budget; a streak
+  past the budget escalates to the same rollback path; recurrence at the
+  same window is terminal with a cause ``classify_tpu_failure`` maps to
+  the new taxonomy decisions;
+* a wedged step (``step-hang``) exits within the watchdog deadline with an
+  emergency save and a classified FAILED ledger row — never a silent wedge.
+
+Model is the mnist MLP throughout (tiny jit, float batches — the data
+poison modes need float leaves); multi-seed recovery fuzz rides behind the
+``slow`` marker.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import uuid
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore, SqliteCheckpointStore
+from tpu_nexus.core.signals import LifecycleContext
+from tpu_nexus.core.telemetry import RecordingMetrics
+from tpu_nexus.models.registry import get_adapter
+from tpu_nexus.parallel import MeshSpec
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.supervisor.taxonomy import DecisionAction, classify_tpu_failure
+from tpu_nexus.workload import durability, health
+from tpu_nexus.workload.data import DataCursor
+from tpu_nexus.workload.faults import (
+    FaultPlan,
+    PoisonedDataStream,
+    maybe_inject,
+    wrap_data_stream,
+)
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.workload.health import (
+    Anomaly,
+    HealthConfig,
+    HealthMonitor,
+    HealthPolicy,
+    StepWatchdog,
+)
+from tpu_nexus.workload.tensor_checkpoint import CURSOR_SIDECAR, TensorCheckpointer
+
+ALGORITHM = "mnist-train"
+CTX = ProcessContext(
+    run_id="run-health", algorithm=ALGORITHM, process_id=0, num_processes=1, coordinator=None
+)
+
+
+def mnist_cfg(**over):
+    base = dict(
+        model=get_adapter("mnist"),
+        mesh=MeshSpec(fsdp=-1),
+        batch_size=8,
+        seq_len=16,
+        steps=8,
+        heartbeat_every=2,
+        checkpoint_every=2,
+        # warmup 2: the drills poison early draws, and the default warmup
+        # of 5 applied steps would let an early spike through un-armed
+        health=HealthConfig(warmup_steps=2),
+    )
+    base.update(over)
+    return WorkloadConfig(**base)
+
+
+def seeded_store(rid=CTX.run_id, algorithm=ALGORITHM):
+    store = InMemoryCheckpointStore()
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=algorithm, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    return store
+
+
+def mnist_stream(seed=0, batch=8):
+    return get_adapter("mnist").data(batch, 16, seed=seed)
+
+
+# -- in-jit sentinel units -----------------------------------------------------
+
+
+class TestSentinel:
+    def _update(self, h, loss, grad, **over):
+        kwargs = dict(ema_beta=0.9, spike_factor=4.0, warmup_steps=2)
+        kwargs.update(over)
+        return health.sentinel_update(
+            h, jnp.float32(loss), jnp.float32(grad), **kwargs
+        )
+
+    def test_clean_step_applies_and_seeds_ema(self):
+        h, flags = self._update(health.health_init(), 2.0, 1.0)
+        assert float(flags["health_applied"]) == 1.0
+        assert float(flags["health_nonfinite"]) == 0.0
+        assert float(h["ema_loss"]) == 2.0 and float(h["ema_grad"]) == 1.0
+        assert int(h["count"]) == 1
+
+    def test_nonfinite_flags_and_freezes_ema(self):
+        h0 = health.health_init()
+        h0, _ = self._update(h0, 2.0, 1.0)
+        h1, flags = self._update(h0, float("nan"), 1.0)
+        assert float(flags["health_nonfinite"]) == 1.0
+        assert float(flags["health_applied"]) == 0.0
+        assert float(h1["ema_loss"]) == float(h0["ema_loss"])
+        assert int(h1["count"]) == int(h0["count"])  # warmup clock frozen too
+        _, flags_inf = self._update(h0, 2.0, float("inf"))
+        assert float(flags_inf["health_nonfinite"]) == 1.0
+
+    def test_spike_skips_after_warmup_only(self):
+        h = health.health_init()
+        for _ in range(2):
+            h, _ = self._update(h, 2.0, 1.0)
+        # armed: 4x the EMA trips, and the spike must not drag the EMA up
+        h2, flags = self._update(h, 9.0, 1.0)
+        assert float(flags["health_spike"]) == 1.0
+        assert float(flags["health_applied"]) == 0.0
+        assert float(h2["ema_loss"]) == pytest.approx(float(h["ema_loss"]))
+        # not armed: the same ratio during warmup applies
+        cold, _ = self._update(health.health_init(), 2.0, 1.0)
+        _, flags_cold = self._update(cold, 9.0, 1.0, warmup_steps=5)
+        assert float(flags_cold["health_spike"]) == 0.0
+        assert float(flags_cold["health_applied"]) == 1.0
+
+    def test_grad_spike_detected_independently(self):
+        h = health.health_init()
+        for _ in range(2):
+            h, _ = self._update(h, 2.0, 1.0)
+        _, flags = self._update(h, 2.0, 40.0)
+        assert float(flags["health_spike"]) == 1.0
+
+    def test_negative_loss_baseline_never_spikes(self):
+        """A factor-over-baseline threshold is meaningless over a negative
+        EMA (log-likelihood losses): every finite step must still apply —
+        NaN/Inf protection and the grad-norm spike remain the guards."""
+        h = health.health_init()
+        for _ in range(3):
+            h, flags = self._update(h, -5.0, 1.0)
+            assert float(flags["health_applied"]) == 1.0
+        # warm, baseline negative: a much "worse" (higher) loss still applies
+        _, flags = self._update(h, -0.1, 1.0)
+        assert float(flags["health_spike"]) == 0.0
+        assert float(flags["health_applied"]) == 1.0
+        # grad spike still armed on the nonnegative grad baseline
+        _, flags = self._update(h, -5.0, 40.0)
+        assert float(flags["health_spike"]) == 1.0
+        # NaN still caught
+        _, flags = self._update(h, float("nan"), 1.0)
+        assert float(flags["health_nonfinite"]) == 1.0
+
+    def test_gated_train_step_freezes_params_on_nan(self):
+        """The in-jit gate: a NaN batch's update never lands, bit-for-bit,
+        while the step counter (data-cursor clock) still advances."""
+        from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, build_mesh
+        from tpu_nexus.workload.train import TrainConfig, init_train_state, make_train_step
+
+        adapter = get_adapter("mnist")
+        mesh = build_mesh(MeshSpec(fsdp=-1))
+        tcfg = TrainConfig(warmup_steps=2, total_steps=50)
+        state = init_train_state(jax.random.PRNGKey(0), adapter, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+        step_fn = make_train_step(
+            adapter, tcfg, mesh, LOGICAL_RULES_FSDP_TP, health=HealthConfig(warmup_steps=2)
+        )
+        data = mnist_stream()
+        with mesh:
+            state, _ = step_fn(state, jax.tree.map(jnp.asarray, next(data)))
+            before = jax.tree.map(np.asarray, state["params"])
+            bad = next(data)
+            bad = {"x": np.full_like(bad["x"], np.nan), "y": bad["y"]}
+            state, m = step_fn(state, jax.tree.map(jnp.asarray, bad))
+        assert float(m["health_nonfinite"]) == 1.0
+        after = jax.tree.map(np.asarray, state["params"])
+        for a, b in zip(jax.tree.leaves(before), jax.tree.leaves(after)):
+            np.testing.assert_array_equal(a, b)
+        assert int(state["step"]) == 2
+
+
+# -- host-side monitor / policy / config units ---------------------------------
+
+
+def flags(nonfinite=0.0, spike=0.0, applied=1.0, loss=2.0, grad=1.0):
+    return {
+        "health_nonfinite": np.float32(nonfinite),
+        "health_spike": np.float32(spike),
+        "health_applied": np.float32(applied),
+        "loss": np.float32(loss),
+        "grad_norm": np.float32(grad),
+    }
+
+
+class TestMonitorAndPolicy:
+    def test_readback_is_one_step_delayed(self):
+        mon = HealthMonitor(HealthConfig())
+        assert mon.push(0, flags(nonfinite=1.0, applied=0.0)) is None  # stored, not read
+        anomaly = mon.push(1, flags())
+        assert anomaly is not None and anomaly.kind == "numeric-nan"
+        assert anomaly.step == 0
+        assert "loss=" in anomaly.detail
+
+    def test_drain_flushes_the_final_step(self):
+        mon = HealthMonitor(HealthConfig())
+        assert mon.push(5, flags(nonfinite=1.0, applied=0.0)) is None
+        anomaly = mon.drain()
+        assert anomaly is not None and anomaly.step == 5
+        assert mon.drain() is None  # cleared
+
+    def test_spike_streak_escalates_past_budget(self):
+        rec = RecordingMetrics()
+        mon = HealthMonitor(HealthConfig(skip_budget=2), metrics=rec)
+        mon.push(0, flags(spike=1.0, applied=0.0))
+        assert mon.push(1, flags(spike=1.0, applied=0.0)) is None  # streak 1
+        assert mon.push(2, flags(spike=1.0, applied=0.0)) is None  # streak 2
+        anomaly = mon.push(3, flags(spike=1.0, applied=0.0))      # streak 3 > 2
+        assert anomaly is not None and anomaly.kind == "loss-spike"
+        assert anomaly.step == 0  # the window START, not the breach step
+        assert rec.tagged_counts[("train.skip", ("cause:loss-spike",))] == 3
+
+    def test_applied_step_resets_the_streak(self):
+        mon = HealthMonitor(HealthConfig(skip_budget=2))
+        for i in range(2):
+            mon.push(i, flags(spike=1.0, applied=0.0))
+        mon.push(2, flags())  # healthy step — classify(1) keeps streak at 2
+        assert mon.push(3, flags(spike=1.0, applied=0.0)) is None  # classify(2): reset
+        assert mon.push(4, flags(spike=1.0, applied=0.0)) is None  # streak 1
+        assert mon.push(5, flags()) is None                        # streak 2
+        assert mon.drain() is None  # classify(5): healthy, streak reset again
+        assert mon.skips_observed == 4
+
+    def test_sentinel_less_metrics_ignored(self):
+        mon = HealthMonitor(HealthConfig())
+        assert mon.push(0, {"loss": np.float32(1.0)}) is None
+        assert mon.drain() is None
+
+    def test_policy_grades(self):
+        policy = HealthPolicy(HealthConfig(max_rollbacks=2))
+        nan = Anomaly("numeric-nan", 5)
+        verdict, why = policy.decide(nan, None)
+        assert verdict == "fail" and "no verified checkpoint" in why
+        verdict, _ = policy.decide(nan, 4)
+        assert verdict == "rollback"
+        policy.record({"restored_step": 4, "flagged_step": 5})
+        # same target, flagged at/before the previous window: recurrence
+        verdict, why = policy.decide(nan, 4)
+        assert verdict == "fail" and "recurred" in why
+        # same target but a LATER flagged step: fresh poison arriving
+        # before the next commit boundary — healable, not recurrence
+        verdict, _ = policy.decide(Anomaly("numeric-nan", 8), 4)
+        assert verdict == "rollback"
+        policy.record({"restored_step": 4, "flagged_step": 8})
+        verdict, why = policy.decide(Anomaly("numeric-nan", 12), 2)
+        assert verdict == "fail" and "budget" in why
+
+    def test_config_validation_and_env(self):
+        with pytest.raises(ValueError, match="ema_beta"):
+            HealthConfig(ema_beta=1.0)
+        with pytest.raises(ValueError, match="spike_factor"):
+            HealthConfig(spike_factor=1.0)
+        with pytest.raises(ValueError, match="step_timeout_s"):
+            HealthConfig(step_timeout_s=-1)
+        cfg = HealthConfig.from_env(
+            {
+                "NEXUS_HEALTH": "0",
+                "NEXUS_HEALTH_SPIKE_FACTOR": "6.5",
+                "NEXUS_STEP_TIMEOUT_S": "12",
+            }
+        )
+        assert cfg.enabled is False
+        assert cfg.spike_factor == 6.5 and cfg.step_timeout_s == 12.0
+        assert HealthConfig.from_env({}).enabled is True
+
+    def test_classified_failure_texts_map_to_taxonomy(self):
+        nan_text = health.classified_failure_text(
+            Anomaly("numeric-nan", 3, "loss=nan"), "no verified checkpoint"
+        )
+        spike_text = health.classified_failure_text(
+            Anomaly("loss-spike", 7, "streak of 4"), "recurred after a rollback"
+        )
+        assert classify_tpu_failure(nan_text) == DecisionAction.TO_FAIL_NUMERIC_NAN
+        assert classify_tpu_failure(spike_text) == DecisionAction.TO_FAIL_LOSS_SPIKE
+        assert classify_tpu_failure(health.hang_cause(5, 2.0)) == (
+            DecisionAction.TO_FAIL_STEP_HANG
+        )
+
+
+# -- step-hang watchdog units --------------------------------------------------
+
+
+class TestStepWatchdog:
+    def test_fires_after_deadline(self):
+        fired = []
+        dog = StepWatchdog(0.05, lambda step, t: fired.append((step, t)), poll_s=0.01)
+        dog.start()
+        dog.arm(7)
+        deadline = time.monotonic() + 2.0
+        while not fired and time.monotonic() < deadline:
+            time.sleep(0.01)
+        dog.stop()
+        assert fired == [(7, 0.05)] and dog.fired
+
+    def test_disarm_prevents_firing(self):
+        fired = []
+        dog = StepWatchdog(0.05, lambda step, t: fired.append(step), poll_s=0.01)
+        dog.start()
+        dog.arm(1)
+        dog.disarm()
+        time.sleep(0.2)
+        dog.stop()
+        assert fired == [] and not dog.fired
+
+    def test_rearming_extends_the_deadline(self):
+        fired = []
+        dog = StepWatchdog(0.08, lambda step, t: fired.append(step), poll_s=0.01)
+        dog.start()
+        for step in range(4):  # steady progress: each arm resets the clock
+            dog.arm(step)
+            time.sleep(0.03)
+        dog.disarm()
+        time.sleep(0.15)
+        dog.stop()
+        assert fired == []
+
+    def test_rejects_nonpositive_timeout(self):
+        with pytest.raises(ValueError, match="timeout_s"):
+            StepWatchdog(0.0, lambda step, t: None)
+
+
+# -- data cursor ---------------------------------------------------------------
+
+
+def counting_stream():
+    i = 0
+    while True:
+        yield i
+        i += 1
+
+
+class TestDataCursor:
+    def test_draws_and_position(self):
+        cur = DataCursor(counting_stream())
+        assert [next(cur) for _ in range(3)] == [0, 1, 2]
+        assert cur.position == 3
+
+    def test_pending_window_is_skipped_at_its_start(self):
+        cur = DataCursor(counting_stream(), skips=[[2, 5]])
+        assert [next(cur) for _ in range(4)] == [0, 1, 5, 6]
+        assert cur.position == 7  # skipped draws count
+
+    def test_abutting_windows(self):
+        cur = DataCursor(counting_stream(), skips=[[1, 2], [2, 4]])
+        assert [next(cur) for _ in range(2)] == [0, 4]
+
+    def test_recorded_past_window_draws_nothing(self):
+        cur = DataCursor(counting_stream())
+        for _ in range(5):
+            next(cur)
+        cur.skip_window(2, 5)  # bookkeeping of draws that already happened
+        assert next(cur) == 5
+        assert cur.state()["skips"] == [[2, 5]]
+
+    def test_state_roundtrip_reproduces_schedule(self):
+        cur = DataCursor(counting_stream(), skips=[[3, 6]])
+        consumed = [next(cur) for _ in range(5)]
+        restored = DataCursor.restore(counting_stream(), cur.state())
+        assert [next(restored) for _ in range(3)] == [next(cur) for _ in range(3)]
+        assert consumed == [0, 1, 2, 6, 7]
+
+    def test_rejects_bad_windows_and_rewind(self):
+        cur = DataCursor(counting_stream())
+        with pytest.raises(ValueError, match="invalid skip window"):
+            cur.skip_window(4, 4)
+        next(cur)
+        with pytest.raises(ValueError, match="rewind"):
+            cur.fast_forward(0)
+
+
+# -- fault plumbing ------------------------------------------------------------
+
+
+class TestFaultPlumbing:
+    def test_poisoned_stream_nan(self):
+        stream = PoisonedDataStream(mnist_stream(), "nan-grads", at_draw=1, times=2)
+        clean = next(stream)
+        assert np.isfinite(clean["x"]).all()
+        for _ in range(2):
+            bad = next(stream)
+            assert np.isnan(bad["x"]).all()
+            assert bad["y"].dtype.kind == "i"  # int leaves untouched
+        assert np.isfinite(next(stream)["x"]).all()
+        assert stream.fired["count"] == 2
+
+    def test_poisoned_stream_spike_scales(self):
+        stream = PoisonedDataStream(mnist_stream(), "loss-spike", at_draw=0)
+        bad = next(stream)
+        assert np.isfinite(bad["x"]).all()
+        assert np.abs(bad["x"]).max() > 1e3
+
+    def test_int_only_batch_refused(self):
+        def ints():
+            while True:
+                yield np.zeros((2, 4), np.int32)
+
+        stream = PoisonedDataStream(ints(), "nan-grads", at_draw=0)
+        with pytest.raises(ValueError, match="no float leaves"):
+            next(stream)
+
+    def test_wrap_passthrough_for_other_modes(self):
+        data = mnist_stream()
+        assert wrap_data_stream(FaultPlan(mode="hbm-oom", step=0), data) is data
+        assert wrap_data_stream(FaultPlan(mode=None, step=0), data) is data
+
+    def test_maybe_inject_guards_vacuous_drills(self):
+        with pytest.raises(ValueError, match="no wrapped data stream"):
+            maybe_inject(FaultPlan(mode="nan-grads", step=3), 3)
+        maybe_inject(FaultPlan(mode="nan-grads", step=3), 3, data_faults_handled=True)
+        with pytest.raises(ValueError, match="no armed step-hang watchdog"):
+            maybe_inject(FaultPlan(mode="step-hang", step=3), 3)
+        # off-step: silent either way
+        maybe_inject(FaultPlan(mode="step-hang", step=3), 2)
+
+    def test_vacuous_data_drill_fails_loudly(self, monkeypatch):
+        """A poison draw index the run never reaches must raise, not exit 0
+        looking like a passed drill."""
+        monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+        monkeypatch.setenv("NEXUS_FAULT_STEP", "99")
+        with pytest.raises(RuntimeError, match="injected nothing"):
+            run_workload(
+                mnist_cfg(checkpoint_every=0), store=seeded_store(), ctx=CTX,
+                lifecycle=LifecycleContext(),
+            )
+
+
+# -- the recovery drills -------------------------------------------------------
+
+
+def _comparator_loss(skips, steps, seed=0):
+    """Fault-free run on the skipped-window schedule: the same config (and
+    the same init/data seed), data pre-skipping exactly the windows the
+    recovered run skipped."""
+    result = run_workload(
+        mnist_cfg(steps=steps, checkpoint_every=0, seed=seed),
+        store=None,
+        ctx=ProcessContext(
+            run_id=str(uuid.uuid4()), algorithm=ALGORITHM, process_id=0,
+            num_processes=1, coordinator=None,
+        ),
+        data=DataCursor(mnist_stream(seed=seed), skips=skips),
+        lifecycle=LifecycleContext(),
+    )
+    return result["loss"]
+
+
+def test_nan_grads_rollback_and_skip_bit_identical(tmp_path, monkeypatch):
+    """The flagship drill: a NaN batch at draw 5 → the in-jit gate discards
+    the update, the harness rolls back to verified step 4 (checkpoint 6 is
+    abandoned: it postdates the window), the cursor skips draws [4, 7), and
+    the run COMPLETES with a loss bit-identical to a fault-free run on the
+    same post-skip schedule."""
+    d = str(tmp_path)
+    store = seeded_store()
+    rec = RecordingMetrics()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "5")
+    result = run_workload(
+        mnist_cfg(checkpoint_dir=d), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(), telemetry=rec,
+    )
+    monkeypatch.delenv("NEXUS_FAULT_MODE")
+    monkeypatch.delenv("NEXUS_FAULT_STEP")
+    assert result["final_step"] == 8
+    [event] = result["health_rollbacks"]
+    assert event["cause"] == "numeric-nan"
+    assert event["flagged_step"] == 5
+    assert event["restored_step"] == 4
+    window = event["skipped_window"]
+    assert window[0] == 4 and window[1] >= 6  # the poisoned draw 5 is inside
+    assert window[0] <= 5 < window[1]
+    # metrics: anomaly + rollback counted with the cause tag
+    assert rec.tagged_counts[("train.anomaly", ("cause:numeric-nan",))] == 1
+    assert rec.tagged_counts[("train.rollback", ("cause:numeric-nan",))] == 1
+    # ledger: COMPLETED, details carry cause + window, pointer verifies
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.COMPLETED
+    details = json.loads(row.algorithm_failure_details)
+    assert details["health_rollback"][0]["cause"] == "numeric-nan"
+    assert details["health_rollback"][0]["skipped_window"] == window
+    assert row.tensor_checkpoint_uri == f"{d}/8"
+    tc = TensorCheckpointer(d)
+    assert tc.latest_verified_step() == 8
+    tc.close()
+    # checkpoint 6 was healthy but on the abandoned trajectory
+    assert any(n.startswith("6" + durability.ABANDONED_SUFFIX) for n in os.listdir(d))
+    # THE acceptance bar: bit-identical to the fault-free post-skip schedule
+    assert result["loss"] == _comparator_loss([window], steps=8)
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_nan_recovery_multi_seed(tmp_path, monkeypatch, seed):
+    """Recovery determinism is not a seed-0 accident."""
+    store = seeded_store()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "5")
+    result = run_workload(
+        mnist_cfg(checkpoint_dir=str(tmp_path), seed=seed), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    monkeypatch.delenv("NEXUS_FAULT_MODE")
+    monkeypatch.delenv("NEXUS_FAULT_STEP")
+    assert store.read_checkpoint(ALGORITHM, CTX.run_id).lifecycle_stage == (
+        LifecycleStage.COMPLETED
+    )
+    [event] = result["health_rollbacks"]
+    assert result["loss"] == _comparator_loss([event["skipped_window"]], steps=8, seed=seed)
+
+
+def test_restart_after_recovery_reproduces_schedule(tmp_path, monkeypatch):
+    """The cursor sidecar end to end: a RESTARTED run resumes the recovered
+    run's checkpoint AND its skipped-window schedule (a bare step-count
+    fast-forward would re-consume the skipped draws and fork the
+    trajectory) — final loss bit-identical to a fault-free run that
+    pre-skipped the window."""
+    d = str(tmp_path)
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "5")
+    first = run_workload(
+        mnist_cfg(checkpoint_dir=d), store=seeded_store(), ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    monkeypatch.delenv("NEXUS_FAULT_MODE")
+    monkeypatch.delenv("NEXUS_FAULT_STEP")
+    [event] = first["health_rollbacks"]
+    resumed = run_workload(
+        mnist_cfg(steps=12, checkpoint_dir=d),
+        store=None,
+        ctx=ProcessContext(
+            run_id=str(uuid.uuid4()), algorithm=ALGORITHM, process_id=0,
+            num_processes=1, coordinator=None,
+        ),
+        lifecycle=LifecycleContext(),
+    )
+    assert resumed["resumed_from"] == 8 and resumed["final_step"] == 12
+    assert resumed["loss"] == _comparator_loss([event["skipped_window"]], steps=12)
+
+
+def test_nan_recurrence_is_terminal_and_classified(tmp_path, monkeypatch):
+    """Poison every draw from 3 on: the first anomaly rolls back and skips;
+    the data is still poisoned after the window, so the second anomaly
+    resolves to the SAME restore step — terminal, with a cause the
+    supervisor classifies as NUMERIC_NAN."""
+    store = seeded_store()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "3")
+    monkeypatch.setenv("NEXUS_FAULT_TIMES", "50")
+    rec = RecordingMetrics()
+    with pytest.raises(RuntimeError, match="cannot self-heal") as ei:
+        run_workload(
+            mnist_cfg(steps=10, checkpoint_dir=str(tmp_path)), store=store, ctx=CTX,
+            lifecycle=LifecycleContext(), telemetry=rec,
+        )
+    assert classify_tpu_failure(str(ei.value)) == DecisionAction.TO_FAIL_NUMERIC_NAN
+    assert rec.tagged_counts[("train.rollback", ("cause:numeric-nan",))] == 1
+    assert rec.tagged_counts[("train.anomaly", ("cause:numeric-nan",))] == 2
+    # the crash path stays honest: RUNNING (supervisor's call) + trace ref
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.RUNNING
+    assert row.hlo_trace_ref.startswith("file://")
+
+
+def test_nan_without_checkpointer_fails_classified(monkeypatch):
+    """No durability configured → nothing to roll back to → classified
+    terminal failure instead of burning the deadline on garbage."""
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "2")
+    with pytest.raises(RuntimeError, match="no verified checkpoint") as ei:
+        run_workload(
+            mnist_cfg(checkpoint_every=0), store=seeded_store(), ctx=CTX,
+            lifecycle=LifecycleContext(),
+        )
+    assert classify_tpu_failure(str(ei.value)) == DecisionAction.TO_FAIL_NUMERIC_NAN
+
+
+def test_loss_spike_skips_within_budget(monkeypatch):
+    """A single spiking batch costs one skipped update and NOTHING else:
+    no rollback, run completes, skip visible in metrics."""
+    store = seeded_store()
+    rec = RecordingMetrics()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "loss-spike")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "5")
+    result = run_workload(
+        mnist_cfg(steps=10, checkpoint_every=0), store=store, ctx=CTX,
+        lifecycle=LifecycleContext(), telemetry=rec,
+    )
+    assert result["final_step"] == 10
+    assert result["health_skips"] == 1
+    assert "health_rollbacks" not in result
+    assert rec.tagged_counts[("train.skip", ("cause:loss-spike",))] == 1
+    assert np.isfinite(result["loss"])
+    assert store.read_checkpoint(ALGORITHM, CTX.run_id).lifecycle_stage == (
+        LifecycleStage.COMPLETED
+    )
+
+
+def test_loss_spike_ladder_rollback_then_terminal(tmp_path, monkeypatch):
+    """The full spike ladder: every batch from draw 4 on spikes → the skip
+    budget exhausts → rollback-and-skip → the poison persists → recurrence
+    at the same window → terminal, classified LOSS_SPIKE."""
+    store = seeded_store()
+    rec = RecordingMetrics()
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "loss-spike")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "4")
+    monkeypatch.setenv("NEXUS_FAULT_TIMES", "50")
+    with pytest.raises(RuntimeError, match="cannot self-heal") as ei:
+        run_workload(
+            mnist_cfg(steps=16, checkpoint_dir=str(tmp_path)), store=store, ctx=CTX,
+            lifecycle=LifecycleContext(), telemetry=rec,
+        )
+    assert classify_tpu_failure(str(ei.value)) == DecisionAction.TO_FAIL_LOSS_SPIKE
+    assert rec.tagged_counts[("train.rollback", ("cause:loss-spike",))] == 1
+    assert rec.counters["train.skip"] >= 4  # the budget's worth of skips, twice
+
+
+def test_cursor_sidecar_is_manifested(tmp_path):
+    """The cursor sidecar is covered by the commit manifest: present in
+    every committed step, and tampering with it fails verification exactly
+    like a tampered tensor."""
+    d = str(tmp_path)
+    run_workload(
+        mnist_cfg(steps=4, checkpoint_dir=d), store=seeded_store(), ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    sidecar = os.path.join(d, "4", CURSOR_SIDECAR)
+    assert os.path.isfile(sidecar)
+    assert json.load(open(sidecar))["position"] == 4
+    durability.verify_step(os.path.join(d, "4"), 4)
+    with open(sidecar, "a", encoding="utf-8") as fh:
+        fh.write(" ")
+    with pytest.raises(durability.CheckpointCorrupt):
+        durability.verify_step(os.path.join(d, "4"), 4)
+
+
+def test_health_disabled_restores_seed_behavior(monkeypatch):
+    """NEXUS_HEALTH=0 escape hatch: no sentinel metrics, no monitor, a NaN
+    batch trains through exactly as before this layer existed."""
+    monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+    monkeypatch.setenv("NEXUS_FAULT_STEP", "2")
+    result = run_workload(
+        mnist_cfg(steps=6, checkpoint_every=0, health=HealthConfig(enabled=False)),
+        store=seeded_store(), ctx=CTX, lifecycle=LifecycleContext(),
+    )
+    assert result["final_step"] == 6
+    assert "health_rollbacks" not in result and "health_skips" not in result
+    assert "health_nonfinite" not in result
+
+
+def test_hang_handler_saves_cursor_and_merges_evidence(tmp_path, monkeypatch):
+    """The hang handler's emergency save carries the cursor sidecar (a
+    restart after a hang must replay any health-skipped window) and its
+    FAILED details re-merge the run's earlier rollback evidence instead of
+    overwriting the column."""
+    from tpu_nexus.workload.harness import LedgerReporter, _make_hang_handler
+
+    d = str(tmp_path)
+    tc = TensorCheckpointer(d)
+    state = {"params": {"w": jnp.arange(4.0)}, "step": jnp.int32(5)}
+    cursor = DataCursor(iter([]))
+    cursor.position = 8
+    cursor.skip_window(4, 7)
+    store = seeded_store()
+    rec = RecordingMetrics()
+    exited = []
+    monkeypatch.setattr(os, "_exit", lambda code: exited.append(code))
+    handler = _make_hang_handler(
+        mnist_cfg(), tc, LedgerReporter(store, CTX), CTX, rec,
+        {"snap": (state, cursor.state())},
+        evidence=lambda: {"health_rollback": [{"cause": "numeric-nan"}]},
+    )
+    handler(6, 2.0)
+    assert exited == [health.STEP_HANG_EXIT_CODE]
+    # emergency step committed WITH the cursor sidecar, and it verifies
+    assert tc.latest_verified_step() == 5
+    assert tc.load_cursor(5) == {"position": 8, "skips": [[4, 7]]}
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.FAILED
+    details = json.loads(row.algorithm_failure_details)
+    assert details["hang_step"] == 6 and details["emergency_step"] == 5
+    assert details["health_rollback"] == [{"cause": "numeric-nan"}]
+    assert rec.tagged_counts[("train.anomaly", ("cause:step-hang",))] == 1
+    tc.close()
+
+
+def test_hang_handler_exit_survives_reporter_failure(tmp_path, monkeypatch):
+    """The exit is exception-safe: a ledger write blowing up mid-protocol
+    (locked sqlite, dead session) must not leave the wedged process alive
+    — os._exit runs in a finally."""
+    from tpu_nexus.workload.harness import LedgerReporter, _make_hang_handler
+
+    class ExplodingReporter(LedgerReporter):
+        def failed(self, cause, details=""):
+            raise RuntimeError("database is locked")
+
+    exited = []
+    monkeypatch.setattr(os, "_exit", lambda code: exited.append(code))
+    handler = _make_hang_handler(
+        mnist_cfg(), None, ExplodingReporter(seeded_store(), CTX), CTX,
+        RecordingMetrics(), {},
+    )
+    with pytest.raises(RuntimeError, match="database is locked"):
+        handler(4, 1.0)  # patched _exit returns, so the raise surfaces here
+    assert exited == [health.STEP_HANG_EXIT_CODE]
+
+
+def test_pre_health_checkpoint_restores_with_reseeded_sentinel(tmp_path):
+    """Upgrade migration: a checkpoint written BEFORE the health subtree
+    existed must still resume (structure-mismatch fallback reseeds the
+    sentinel state) — an image upgrade must not crash every durable run
+    mid-flight."""
+    from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, build_mesh
+    from tpu_nexus.workload.train import TrainConfig, init_train_state
+
+    d = str(tmp_path)
+    adapter = get_adapter("mnist")
+    mesh = build_mesh(MeshSpec(fsdp=-1))
+    state = init_train_state(
+        jax.random.PRNGKey(0), adapter, TrainConfig(), mesh, LOGICAL_RULES_FSDP_TP
+    )
+    legacy = {k: v for k, v in state.items() if k != "health"}
+    legacy["step"] = jnp.int32(4)
+    tc = TensorCheckpointer(d)
+    tc.save(4, legacy)
+    tc.commit(4)
+    tc.close()
+    result = run_workload(
+        mnist_cfg(steps=8, checkpoint_dir=d), store=seeded_store(), ctx=CTX,
+        lifecycle=LifecycleContext(),
+    )
+    assert result["resumed_from"] == 4 and result["final_step"] == 8
+    assert np.isfinite(result["loss"])
+
+
+def test_second_poison_window_heals_with_second_rollback(tmp_path):
+    """Fresh poison landing AFTER a recovery but BEFORE the next commit
+    boundary resolves to the same restore target — that is a NEW window
+    (flagged later than the previous one), healable by a second
+    rollback-and-skip, not a terminal recurrence."""
+
+    def nan_at(draws, seed=0):
+        src = mnist_stream(seed=seed)
+        i = 0
+        while True:
+            batch = next(src)
+            if i in draws:
+                batch = {"x": np.full_like(batch["x"], np.nan), "y": batch["y"]}
+            i += 1
+            yield batch
+
+    d = str(tmp_path)
+    store = seeded_store()
+    result = run_workload(
+        mnist_cfg(steps=10, checkpoint_every=4, checkpoint_dir=d),
+        store=store, ctx=CTX, data=nan_at({5, 9}), lifecycle=LifecycleContext(),
+    )
+    events = result["health_rollbacks"]
+    assert [e["restored_step"] for e in events] == [4, 4]
+    assert events[0]["flagged_step"] < events[1]["flagged_step"]
+    assert store.read_checkpoint(ALGORITHM, CTX.run_id).lifecycle_stage == (
+        LifecycleStage.COMPLETED
+    )
+    # the second window subsumes the first: a fault-free run skipping just
+    # the final window reproduces the recovered trajectory bit-for-bit
+    assert result["loss"] == _comparator_loss([events[1]["skipped_window"]], steps=10)
+
+
+def test_mid_run_quarantine_during_recovery_is_reported(tmp_path, monkeypatch):
+    """A checkpoint that rots AFTER the startup scan and is quarantined by
+    the recovery's before-scan must land in the corruption evidence
+    (summary, ledger details, train.ckpt_rollback metric) — not vanish
+    into ckpt.rollbacks unreported."""
+    from tpu_nexus.workload.faults import flip_committed_leaf
+
+    d = str(tmp_path)
+    store = seeded_store()
+    rec = RecordingMetrics()
+
+    def rotting_stream():
+        src = mnist_stream()
+        i = 0
+        while True:
+            batch = next(src)
+            if i == 5:
+                # silent rot lands on the newest committed step right as
+                # the poison batch goes out: the recovery's before-scan
+                # (limit 6 -> candidates 2,4) must quarantine 4 and fall
+                # back to 2, and REPORT the quarantine
+                flip_committed_leaf(os.path.join(d, "4"))
+                batch = {"x": np.full_like(batch["x"], np.nan), "y": batch["y"]}
+            i += 1
+            yield batch
+
+    result = run_workload(
+        mnist_cfg(checkpoint_dir=d), store=store, ctx=CTX,
+        data=rotting_stream(), lifecycle=LifecycleContext(), telemetry=rec,
+    )
+    [event] = result["health_rollbacks"]
+    assert event["restored_step"] == 2  # rolled past the rotten 4
+    assert [e["step"] for e in result["ckpt_rollbacks"]] == [4]
+    assert [e["cause"] for e in result["ckpt_rollbacks"]] == ["corrupt"]
+    assert rec.tagged_counts[("train.ckpt_rollback", ("cause:corrupt",))] == 1
+    row = store.read_checkpoint(ALGORITHM, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.COMPLETED
+    details = json.loads(row.algorithm_failure_details)
+    assert details["ckpt_rollback"][0]["step"] == 4
+    assert any(n.startswith("4" + durability.QUARANTINE_SUFFIX) for n in os.listdir(d))
+
+
+# -- step-hang watchdog drill (subprocess: the watchdog os._exit()s) -----------
+
+_HANG_SCRIPT = """
+import sys
+from tpu_nexus.parallel.smap import force_virtual_cpu_devices
+force_virtual_cpu_devices(8)
+from tpu_nexus.checkpoint.store import SqliteCheckpointStore
+from tpu_nexus.models.registry import get_adapter
+from tpu_nexus.parallel import MeshSpec
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.workload.harness import WorkloadConfig, run_workload
+from tpu_nexus.workload.health import HealthConfig
+
+ledger, ckpt_dir, rid, algo = sys.argv[1:5]
+run_workload(
+    WorkloadConfig(
+        model=get_adapter("mnist"), mesh=MeshSpec(fsdp=-1), batch_size=8,
+        seq_len=16, steps=8, heartbeat_every=2, checkpoint_every=2,
+        checkpoint_dir=ckpt_dir,
+        health=HealthConfig(warmup_steps=2, step_timeout_s=2.0),
+    ),
+    store=SqliteCheckpointStore(ledger),
+    ctx=ProcessContext(run_id=rid, algorithm=algo, process_id=0,
+                       num_processes=1, coordinator=None),
+)
+"""
+
+
+def test_step_hang_watchdog_drill(tmp_path):
+    """The acceptance drill: a wedged step (sleep-forever at step 3) exits
+    within the watchdog deadline with exit code 70, a FAILED ledger row
+    whose cause classifies as STEP_HANG, and an emergency save of the last
+    completed step — never a silent wedge until the k8s deadline."""
+    rid = str(uuid.uuid4())
+    ledger = str(tmp_path / "ledger.db")
+    store = SqliteCheckpointStore(ledger)
+    store.upsert_checkpoint(
+        CheckpointedRequest(algorithm=ALGORITHM, id=rid, lifecycle_stage=LifecycleStage.BUFFERED)
+    )
+    env = dict(
+        os.environ, NEXUS_FAULT_MODE="step-hang", NEXUS_FAULT_STEP="3",
+    )
+    t0 = time.monotonic()
+    proc = subprocess.run(
+        [
+            sys.executable, "-c", _HANG_SCRIPT,
+            ledger, str(tmp_path / "ckpt"), rid, ALGORITHM,
+        ],
+        capture_output=True, text=True, timeout=240, env=env,
+    )
+    elapsed = time.monotonic() - t0
+    assert proc.returncode == health.STEP_HANG_EXIT_CODE, (
+        proc.returncode, proc.stderr[-2000:],
+    )
+    # the whole subprocess (jax import + 3 steps + 2s deadline + save)
+    # stays far inside the k8s-deadline regime a silent wedge would burn
+    assert elapsed < 200, elapsed
+    row = store.read_checkpoint(ALGORITHM, rid)
+    assert row.lifecycle_stage == LifecycleStage.FAILED
+    assert row.algorithm_failure_cause.startswith("step-hang")
+    assert classify_tpu_failure(row.algorithm_failure_cause) == (
+        DecisionAction.TO_FAIL_STEP_HANG
+    )
+    details = json.loads(row.algorithm_failure_details)
+    assert details["hang_step"] == 3 and details["deadline_s"] == 2.0
+    # emergency save: the last COMPLETED step (3) committed and verifies,
+    # and the ledger pointer was published behind the barrier
+    assert details["emergency_step"] == 3
+    assert row.tensor_checkpoint_uri == f"{tmp_path / 'ckpt'}/3"
+    tc = TensorCheckpointer(str(tmp_path / "ckpt"))
+    assert tc.latest_verified_step() == 3
+    tc.close()
+    store.close()
+
+
+# -- slow tier: multi-seed recovery fuzz ---------------------------------------
+
+
+@pytest.mark.slow
+def test_recovery_fuzz_seed_matrix(tmp_path, monkeypatch):
+    """Multi-seed, multi-draw fuzz of the rollback-and-skip invariant: for
+    every (seed, poisoned draw) the run COMPLETES and the post-recovery
+    loss is bit-identical to the fault-free run on the recovered run's own
+    skipped-window schedule."""
+    for seed in range(5):
+        for draw in (3, 5, 6):
+            d = str(tmp_path / f"s{seed}-d{draw}")
+            monkeypatch.setenv("NEXUS_FAULT_MODE", "nan-grads")
+            monkeypatch.setenv("NEXUS_FAULT_STEP", str(draw))
+            store = seeded_store()
+            result = run_workload(
+                mnist_cfg(checkpoint_dir=d, seed=seed), store=store, ctx=CTX,
+                lifecycle=LifecycleContext(),
+            )
+            monkeypatch.delenv("NEXUS_FAULT_MODE")
+            monkeypatch.delenv("NEXUS_FAULT_STEP")
+            assert store.read_checkpoint(ALGORITHM, CTX.run_id).lifecycle_stage == (
+                LifecycleStage.COMPLETED
+            ), (seed, draw)
+            [event] = result["health_rollbacks"]
+            assert event["skipped_window"][0] <= draw < event["skipped_window"][1]
+            assert result["loss"] == _comparator_loss(
+                [event["skipped_window"]], steps=8, seed=seed
+            ), (seed, draw)

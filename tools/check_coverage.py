@@ -33,6 +33,7 @@ EXCLUDE = [
 # critical modules are pinned here; absence fails the gate.
 REQUIRED = [
     "tpu_nexus/workload/durability.py",         # checkpoint commit/verify layer
+    "tpu_nexus/workload/health.py",             # sentinel + rollback-and-skip + watchdog
     "tpu_nexus/workload/tensor_checkpoint.py",
     "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors

@@ -25,6 +25,7 @@ from tools.nxlint.engine import (
 # importing the rule modules populates the registry
 from tools.nxlint import rules_control  # noqa: F401
 from tools.nxlint import rules_durability  # noqa: F401
+from tools.nxlint import rules_faults  # noqa: F401
 from tools.nxlint import rules_serving  # noqa: F401
 from tools.nxlint import rules_tracing  # noqa: F401
 

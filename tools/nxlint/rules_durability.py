@@ -30,8 +30,12 @@ from tools.nxlint.engine import Finding, Module, Rule, register
 #: ledger-publisher calls (method name, last attribute segment).  These are
 #: the ONLY sanctioned ways to write tensor_checkpoint_uri; their own
 #: definitions (on LedgerReporter) are the sinks and are exempted below —
-#: the barrier obligation sits with every CALLER.
-_PUBLISHER_CALLS = frozenset({"tensor_checkpoint", "checkpoint_rollback"})
+#: the barrier obligation sits with every CALLER.  ``health_rollback`` is
+#: the health-policy recovery's repoint (ISSUE 10) — same contract: the
+#: caller's ``latest_verified_step(before=...)`` resolution is the barrier.
+_PUBLISHER_CALLS = frozenset(
+    {"tensor_checkpoint", "checkpoint_rollback", "health_rollback"}
+)
 
 #: function definitions that ARE the publisher (LedgerReporter methods):
 #: their bodies write the column by construction; flagging them would force

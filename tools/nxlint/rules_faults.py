@@ -1,0 +1,145 @@
+"""Chaos-coverage rule: the fault registry must stay drilled.
+
+NX009  every fault mode registered in ``workload/faults.py`` must be
+       exercised by at least one test under ``tests/``.  The PR 4/5 "no
+       vacuous drills" guarantee is runtime-only: a loop that configures a
+       fault raises if the fault never fires — but nothing stops a NEW
+       fault mode from landing with no drill at all, in which case the
+       guarantee never even arms.  This rule makes it static: a mode
+       string (frozenset member of a ``*_FAULT_MODES`` table, or a
+       ``plan.mode == "..."``-style comparison) with no quoted occurrence
+       in any test file fails the repo gate.
+
+       The check is deliberately a literal-string approximation — a test
+       that names the mode but never runs it would pass.  The runtime
+       vacuous-drill guards cover that half; this rule covers the
+       "nobody ever wrote the drill" half, and fails CLOSED (no modes
+       found, or no tests directory ⇒ finding).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, Optional
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+
+FAULTS_PATH = "workload/faults.py"
+TESTS_DIR = "tests"
+
+#: string-comparison left-hand sides that denote the fault mode: the plan's
+#: attribute (``plan.mode``/``self.mode``) or a bare ``mode`` local
+_MODE_NAMES = frozenset({"mode"})
+
+
+def registered_fault_modes(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Fault-mode string -> the AST node declaring it.
+
+    Two declaration shapes, matching how faults.py registers modes:
+
+    * members of a module-level ``frozenset({...})``/set/tuple/list assigned
+      to a name ending in ``_FAULT_MODES``;
+    * ``== "literal"`` comparisons whose left side is ``*.mode`` or
+      ``mode`` (the ``maybe_inject`` dispatch chain and wrapper guards).
+    """
+    modes: Dict[str, ast.AST] = {}
+    for stmt in tree.body:
+        targets = []
+        if isinstance(stmt, ast.Assign):
+            targets = [t for t in stmt.targets if isinstance(t, ast.Name)]
+        elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+            targets = [stmt.target]
+        if not any(t.id.endswith("_FAULT_MODES") for t in targets):
+            continue
+        value = stmt.value
+        if isinstance(value, ast.Call):  # frozenset({...}) / frozenset([...])
+            value = value.args[0] if value.args else None
+        if isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            for elt in value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    modes.setdefault(elt.value, elt)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+            continue
+        if not isinstance(node.ops[0], ast.Eq):
+            continue
+        left = node.left
+        is_mode = (isinstance(left, ast.Attribute) and left.attr in _MODE_NAMES) or (
+            isinstance(left, ast.Name) and left.id in _MODE_NAMES
+        )
+        if not is_mode:
+            continue
+        comp = node.comparators[0]
+        if isinstance(comp, ast.Constant) and isinstance(comp.value, str):
+            modes.setdefault(comp.value, comp)
+    return modes
+
+
+def _test_corpus(root: str) -> Optional[str]:
+    """Concatenated source of every python file under ``<root>/tests``;
+    None when the directory is absent or holds no python files."""
+    tests_dir = os.path.join(root, TESTS_DIR)
+    if not os.path.isdir(tests_dir):
+        return None
+    chunks = []
+    for dirpath, dirnames, filenames in os.walk(tests_dir):
+        dirnames[:] = sorted(
+            d for d in dirnames if not d.startswith(".") and d != "__pycache__"
+        )
+        for name in sorted(filenames):
+            if not name.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(dirpath, name), "r", encoding="utf-8") as fh:
+                    chunks.append(fh.read())
+            except (OSError, UnicodeDecodeError):
+                continue  # unreadable test files are NX000's business
+    if not chunks:
+        return None
+    return "\n".join(chunks)
+
+
+@register
+class ChaosCoverageRule(Rule):
+    """NX009: a registered fault mode nobody drills is a recovery path
+    nobody has proven — the exact gap the vacuous-drill runtime guards
+    cannot see (they only fire once a drill EXISTS)."""
+
+    rule_id = "NX009"
+    description = "every registered fault mode must be exercised by at least one test"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        module = project.find_module(FAULTS_PATH)
+        if module is None or module.tree is None:
+            return  # project doesn't contain the fault registry (tools tree)
+        modes = registered_fault_modes(module.tree)
+        if not modes:
+            yield self.finding(
+                module,
+                module.tree,
+                "no fault modes found in workload/faults.py — the mode "
+                "extraction no longer matches the registry shape (rule "
+                "fails closed; fix registered_fault_modes)",
+            )
+            return
+        corpus = _test_corpus(project.root)
+        if corpus is None:
+            yield self.finding(
+                module,
+                module.tree,
+                f"no test files found under {os.path.join(project.root, TESTS_DIR)} "
+                "— chaos coverage unverifiable (rule fails closed)",
+            )
+            return
+        for mode in sorted(modes):
+            if f'"{mode}"' in corpus or f"'{mode}'" in corpus:
+                continue
+            yield self.finding(
+                module,
+                modes[mode],
+                f"fault mode '{mode}' is registered but no test under "
+                f"{TESTS_DIR}/ names it — add a chaos test exercising the "
+                "mode (the runtime vacuous-drill guard can only protect "
+                "drills that exist)",
+            )

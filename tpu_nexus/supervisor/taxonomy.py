@@ -53,6 +53,17 @@ class DecisionAction:
     #: deletes + writes terminal, services/supervisor.go:283-360); this
     #: restores that guarantee for the restart axis (VERDICT r4 Missing #1).
     TO_FAIL_RESTART_STALLED = "ToFailRestartStalled"
+    # -- training-health extensions (workload/health.py, ISSUE 10): a run
+    # that is alive and SICK — the heartbeat watchdog never sees it stop --
+    #: non-finite loss/gradients the self-healing policy could not recover
+    #: (no verified pre-window checkpoint, or recurrence after rollback)
+    TO_FAIL_NUMERIC_NAN = "ToFailNumericNan"
+    #: loss/grad spike streak past the skip budget that rollback-and-skip
+    #: could not heal — divergence, not transient noise
+    TO_FAIL_LOSS_SPIKE = "ToFailLossSpike"
+    #: a training step exceeded its wall-clock deadline (wedged collective);
+    #: the in-process step-hang watchdog saved what it could and exited
+    TO_FAIL_STEP_HANG = "ToFailStepHang"
 
 
 #: decision -> resulting lifecycle stage (SURVEY §2.2 classification table +
@@ -68,6 +79,9 @@ DECISION_STAGE: Dict[str, str] = {
     DecisionAction.TO_PREEMPT_RESTARTABLE: LifecycleStage.PREEMPTED,
     DecisionAction.TO_FAIL_STUCK_IN_RUNNING: LifecycleStage.FAILED,
     DecisionAction.TO_FAIL_RESTART_STALLED: LifecycleStage.DEADLINE_EXCEEDED,
+    DecisionAction.TO_FAIL_NUMERIC_NAN: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_LOSS_SPIKE: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_STEP_HANG: LifecycleStage.FAILED,
 }
 
 #: decisions that delete the k8s Job (all reference fail paths delete with
@@ -82,6 +96,9 @@ DELETES_JOB = frozenset(
         DecisionAction.TO_FAIL_ICI_LINK_DOWN,
         DecisionAction.TO_FAIL_STUCK_IN_RUNNING,
         DecisionAction.TO_FAIL_RESTART_STALLED,
+        DecisionAction.TO_FAIL_NUMERIC_NAN,
+        DecisionAction.TO_FAIL_LOSS_SPIKE,
+        DecisionAction.TO_FAIL_STEP_HANG,
     }
 )
 
@@ -101,6 +118,19 @@ MSG_STUCK_IN_RUNNING = (
 )
 MSG_RESTART_STALLED = (
     "TPU slice was preempted and the controller never restarted it within the deadline - run terminated."
+)
+# Training-health messages.  Wordings deliberately avoid the existing
+# infrastructure signatures (no "collective", "interconnect", "allocate",
+# "compile"...) so a round-trip through k8s event text re-classifies to the
+# same decision instead of being shadowed by an older pattern.
+MSG_NUMERIC_NAN = (
+    "Algorithm produced non-finite loss/gradients (NaN/Inf) and could not self-heal - run terminated."
+)
+MSG_LOSS_SPIKE = (
+    "Algorithm loss/gradients spiked past the health policy's budget (divergence) - run terminated."
+)
+MSG_STEP_HANG = (
+    "A training step exceeded its watchdog deadline - the run appeared wedged mid-step and was terminated."
 )
 
 #: decisions that do NOT delete the k8s Job — the explicit complement of
@@ -171,6 +201,12 @@ SERVING_POD_RECOVERY: Dict[str, str] = {
     DecisionAction.TO_PREEMPT_RESTARTABLE: FleetRecovery.RECREATE,
     DecisionAction.TO_FAIL_STUCK_IN_RUNNING: FleetRecovery.RECREATE,
     DecisionAction.TO_FAIL_RESTART_STALLED: FleetRecovery.ESCALATE,
+    #: training-health causes in a SERVING pod are program/weights facts —
+    #: a recreated replica replays the same numerics; an operator owns it
+    DecisionAction.TO_FAIL_NUMERIC_NAN: FleetRecovery.ESCALATE,
+    DecisionAction.TO_FAIL_LOSS_SPIKE: FleetRecovery.ESCALATE,
+    #: a hung step is slice-local wedging — a fresh pod may land healthy
+    DecisionAction.TO_FAIL_STEP_HANG: FleetRecovery.RECREATE,
 }
 
 #: decision -> human run-status message, TOTAL over DecisionAction (nxlint
@@ -187,6 +223,9 @@ ACTION_MESSAGES: Dict[str, str] = {
     DecisionAction.TO_PREEMPT_RESTARTABLE: MSG_PREEMPTED,
     DecisionAction.TO_FAIL_STUCK_IN_RUNNING: MSG_STUCK_IN_RUNNING,
     DecisionAction.TO_FAIL_RESTART_STALLED: MSG_RESTART_STALLED,
+    DecisionAction.TO_FAIL_NUMERIC_NAN: MSG_NUMERIC_NAN,
+    DecisionAction.TO_FAIL_LOSS_SPIKE: MSG_LOSS_SPIKE,
+    DecisionAction.TO_FAIL_STEP_HANG: MSG_STEP_HANG,
 }
 
 
@@ -242,6 +281,26 @@ _PREEMPT_RE = re.compile(
     r"preempt|spot.*(reclaim|terminat)|node.*shutdown|maintenance event",
     re.IGNORECASE,
 )
+# Training-health signatures (workload/health.py emits these wordings in
+# raised causes / ledger rows / exit messages).  Checked AFTER the four
+# infrastructure/program signatures above so they can never shadow an
+# existing classification — and phrased (sentinel/step-deadline vocabulary)
+# so none of the older regexes matches them either; the precedence tests in
+# tests/test_trace_capture.py pin both directions.
+_STEP_HANG_RE = re.compile(
+    r"step[- ]hang|exceeded its \S+ ?step deadline|training step deadline|"
+    r"watchdog deadline",
+    re.IGNORECASE,
+)
+_NUMERIC_NAN_RE = re.compile(
+    r"non-?finite (loss|grad|training)|numeric(al)? health sentinel.*non-?finite|"
+    r"nan/inf",
+    re.IGNORECASE,
+)
+_LOSS_SPIKE_RE = re.compile(
+    r"loss spike|grad(ient)?s? (norm )?spike|spiked past the health",
+    re.IGNORECASE,
+)
 
 # longest alternatives first: with `pb` before `pbtxt`, a `.pbtxt` ref would
 # truncate to `.pb` (the regex never backtracks to the longer suffix)
@@ -253,7 +312,11 @@ def classify_tpu_failure(text: str) -> Optional[str]:
 
     Precedence: preemption (infrastructure, restartable) > ICI (infrastructure,
     terminal) > HBM OOM > compile abort — infrastructure causes win over
-    program causes when both appear in one trace.
+    program causes when both appear in one trace.  The training-health
+    signatures (step hang > numeric NaN > loss spike) rank BELOW all four:
+    they are self-reported by the workload, and when a trace carries both a
+    hardware cause and the numerical symptom it produced, the hardware
+    cause is the story.
     """
     if not text:
         return None
@@ -265,6 +328,12 @@ def classify_tpu_failure(text: str) -> Optional[str]:
         return DecisionAction.TO_FAIL_HBM_OOM
     if _COMPILE_ABORT_RE.search(text):
         return DecisionAction.TO_FAIL_COMPILE_ABORT
+    if _STEP_HANG_RE.search(text):
+        return DecisionAction.TO_FAIL_STEP_HANG
+    if _NUMERIC_NAN_RE.search(text):
+        return DecisionAction.TO_FAIL_NUMERIC_NAN
+    if _LOSS_SPIKE_RE.search(text):
+        return DecisionAction.TO_FAIL_LOSS_SPIKE
     return None
 
 

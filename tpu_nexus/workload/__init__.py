@@ -27,6 +27,7 @@ _EXPORTS = {
     "init_train_state": "tpu_nexus.workload.train",
     "WorkloadConfig": "tpu_nexus.workload.harness",
     "run_workload": "tpu_nexus.workload.harness",
+    "HealthConfig": "tpu_nexus.workload.health",
 }
 
 __all__ = list(_EXPORTS)

@@ -7,7 +7,7 @@ iterator contract (yield int32 token arrays [batch, seq+?]).
 
 from __future__ import annotations
 
-from typing import Iterator, Optional
+from typing import Any, Dict, Iterator, List, Optional, Sequence
 
 import numpy as np
 
@@ -93,6 +93,96 @@ def write_token_npy(path: str, tokens: np.ndarray) -> str:
         raise ValueError("tokens must be a 1-D integer array")
     np.save(path, tokens)
     return path if path.endswith(".npy") else path + ".npy"
+
+
+class DataCursor:
+    """Deterministic batch cursor over a seeded stream.
+
+    The harness's restart contract ("restart-from-step must also
+    restart-from-*data*") used to be a bare fast-forward by step count.
+    Health-policy recovery (workload/health.py) adds a second requirement:
+    after a rollback the run must *skip* the poisoned batch window and a
+    later restart must reproduce exactly that skipped schedule.  The cursor
+    makes both explicit:
+
+    * ``position`` counts every batch drawn from the underlying stream —
+      including discarded ones — so ``fast_forward(position)`` on a fresh
+      stream lands at the identical point (PRNG streams are deterministic
+      in their seed; draws are the only state);
+    * ``skips`` records ``[start, end)`` windows in draw-index space.
+      A window recorded *behind* the cursor (the rollback case: those
+      draws already happened) is pure bookkeeping; a window *ahead* of the
+      cursor (a restored run, or a fault-free comparator replaying a
+      recovered run's schedule) is discarded draw-by-draw when the cursor
+      reaches it.
+
+    ``state()``/``fast_forward`` round-trip through the checkpoint cursor
+    sidecar (tensor_checkpoint.save_cursor), which the commit manifest
+    covers like any other payload file.
+    """
+
+    def __init__(self, stream: Iterator[Any], skips: Optional[Sequence[Sequence[int]]] = None) -> None:
+        self._stream = stream
+        self.position = 0
+        self.skips: List[List[int]] = []
+        for window in skips or ():
+            self.skip_window(int(window[0]), int(window[1]))
+
+    def _draw(self) -> Any:
+        batch = next(self._stream)
+        self.position += 1
+        return batch
+
+    def __iter__(self) -> "DataCursor":
+        return self
+
+    def __next__(self) -> Any:
+        # discard through any pending window covering the current position;
+        # windows may abut, so re-check until the position is clear
+        advanced = True
+        while advanced:
+            advanced = False
+            for start, end in self.skips:
+                if start <= self.position < end:
+                    while self.position < end:
+                        self._draw()
+                    advanced = True
+        return self._draw()
+
+    def skip_window(self, start: int, end: int) -> None:
+        """Register ``[start, end)`` (draw indices) as skipped.  Recording a
+        window that was already consumed (``end <= position``) only
+        documents it for the sidecar/ledger; a future window is enforced
+        during iteration."""
+        start, end = int(start), int(end)
+        if not 0 <= start < end:
+            raise ValueError(f"invalid skip window [{start}, {end})")
+        self.skips.append([start, end])
+        self.skips.sort()
+
+    def fast_forward(self, position: int) -> None:
+        """Draw-and-discard until ``position`` draws have happened — the
+        restart replay.  ``position`` already counts skipped draws, so this
+        is a raw replay with no window logic."""
+        if position < self.position:
+            raise ValueError(
+                f"cannot rewind a stream: at draw {self.position}, asked for {position}"
+            )
+        while self.position < position:
+            self._draw()
+
+    def state(self) -> Dict[str, Any]:
+        return {"position": self.position, "skips": [list(w) for w in self.skips]}
+
+    @staticmethod
+    def restore(stream: Iterator[Any], state: Dict[str, Any]) -> "DataCursor":
+        """Rebuild the cursor over a FRESH seeded stream from sidecar state:
+        replay the draws, re-register the windows."""
+        cursor = DataCursor(stream)
+        cursor.fast_forward(int(state.get("position", 0)))
+        for window in state.get("skips", ()):
+            cursor.skip_window(int(window[0]), int(window[1]))
+        return cursor
 
 
 def synthetic_mnist(batch: int, seed: int = 0) -> Iterator[tuple]:

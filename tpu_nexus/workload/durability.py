@@ -43,6 +43,12 @@ MANIFEST_NAME = "_NEXUS_MANIFEST.json"
 #: orbax's step scan and :func:`list_steps` ignore it while the bytes stay
 #: on disk for postmortems
 QUARANTINE_SUFFIX = ".corrupt"
+#: suffix for steps set aside by a HEALTH rollback (workload/health.py):
+#: the bytes are intact and verified — they are just on the abandoned
+#: (poisoned-window) trajectory, and a re-commit of the same step number
+#: must land the retrained weights, not these.  Distinct from ``.corrupt``
+#: so a postmortem can tell bit rot from a divergence recovery.
+ABANDONED_SUFFIX = ".abandoned"
 
 MANIFEST_FORMAT = 1
 
@@ -254,29 +260,43 @@ def list_steps(directory: str) -> List[int]:
     return sorted(steps)
 
 
-def quarantine_step(directory: str, step: int) -> str:
-    """Rename ``<step>`` to ``<step>.corrupt`` (``.corrupt-N`` on repeat
+def _set_step_aside(directory: str, step: int, suffix: str) -> str:
+    """Rename ``<step>`` to ``<step><suffix>`` (``<suffix>-N`` on repeat
     incidents) so no step scan ever offers it again; returns the new path.
-    The bytes stay for postmortems — quarantine is evidence preservation,
-    not deletion."""
+    The bytes stay for postmortems — evidence preservation, not deletion."""
     src = os.path.join(directory, str(step))
-    dst = src + QUARANTINE_SUFFIX
+    dst = src + suffix
     n = 0
     while os.path.exists(dst):
         n += 1
-        dst = f"{src}{QUARANTINE_SUFFIX}-{n}"
+        dst = f"{src}{suffix}-{n}"
     try:
         os.rename(src, dst)
     except FileNotFoundError:
-        # another host's quarantine won the rename race — the bad step is
-        # out of the step scan either way, which is all that matters
+        # another host's rename won the race — the step is out of the step
+        # scan either way, which is all that matters
         return dst
     _fsync_dir(directory)
     return dst
 
 
+def quarantine_step(directory: str, step: int) -> str:
+    """Quarantine a torn/corrupt step as ``<step>.corrupt``."""
+    return _set_step_aside(directory, step, QUARANTINE_SUFFIX)
+
+
+def abandon_step(directory: str, step: int) -> str:
+    """Set aside a VERIFIED step that sits on an abandoned trajectory
+    (health rollback skipped the data window it was trained on) as
+    ``<step>.abandoned`` — the retrained run will re-commit the same step
+    numbers with different weights, and the old bytes must neither shadow
+    the re-save (orbax "step already exists") nor ever be restored as if
+    they were on the new schedule."""
+    return _set_step_aside(directory, step, ABANDONED_SUFFIX)
+
+
 def newest_verified_step(
-    directory: str, quarantine: bool = True
+    directory: str, quarantine: bool = True, before: Optional[int] = None
 ) -> "tuple[Optional[int], List[Dict[str, Any]]]":
     """Newest step that verifies, rolling past torn/corrupt ones.
 
@@ -285,9 +305,18 @@ def newest_verified_step(
     "quarantined_to"}`` — newest first, for metrics/ledger reporting.
     ``step`` is None when nothing verifies (fresh directory, or every step
     bad).  With ``quarantine=False`` bad steps are skipped but left in
-    place (read-only consumers: serving, the watchdog resolver)."""
+    place (read-only consumers: serving, the watchdog resolver).
+
+    ``before`` restricts the scan to steps < ``before`` — the health
+    rollback's constraint that the restored checkpoint must predate the
+    poisoned data window.  Newer steps are neither verified nor
+    quarantined here (they may be perfectly healthy; abandoning them is
+    the RECOVERY's explicit, separate act)."""
     rollbacks: List[Dict[str, Any]] = []
-    for step in reversed(list_steps(directory)):
+    steps = list_steps(directory)
+    if before is not None:
+        steps = [s for s in steps if s < before]
+    for step in reversed(steps):
         step_dir = os.path.join(directory, str(step))
         try:
             verify_step(step_dir, step)
@@ -311,6 +340,42 @@ def newest_verified_step(
             )
             rollbacks.append(event)
     return None, rollbacks
+
+
+def write_json_sidecar(step_dir: str, name: str, payload: Dict[str, Any]) -> str:
+    """Stage a small JSON sidecar (e.g. the data-cursor state) next to a
+    step's payload with the same temp → fsync → rename discipline as the
+    manifest.  MUST run after the async save finalized (the step directory
+    exists under its final name) and BEFORE :func:`commit_manifest` — the
+    manifest then checksums the sidecar like any other payload file, so a
+    tampered cursor fails verification exactly like a tampered tensor."""
+    path = os.path.join(step_dir, name)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(payload, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.rename(tmp, path)
+    return path
+
+
+def read_json_sidecar(step_dir: str, name: str) -> Optional[Dict[str, Any]]:
+    """Read a sidecar back; None when the step predates the sidecar (the
+    fast-forward fallback), classified :class:`CheckpointCorrupt` when the
+    bytes exist but do not parse — a caller holding a VERIFIED step should
+    never see that, so surfacing it loudly beats a silent schedule drift."""
+    path = os.path.join(step_dir, name)
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            loaded = json.load(fh)
+        if not isinstance(loaded, dict):
+            raise ValueError(f"sidecar is {type(loaded).__name__}, expected object")
+        return loaded
+    except (OSError, ValueError) as exc:
+        raise CheckpointCorrupt(f"{step_dir}: unreadable sidecar {name}: {exc}") from exc
 
 
 def adopt_unmanifested_steps(directory: str) -> List[int]:

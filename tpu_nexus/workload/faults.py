@@ -75,6 +75,35 @@ mode                  effect / expected recovery
                       skips the duplicate same-step save and exits PREEMPTED
                       with the saved step in the ledger details.
 ====================  =========================================================
+
+Training-health fault modes (ISSUE 10 chaos harness) exercise the in-jit
+numerical sentinel + rollback-and-skip recovery (workload/health.py).  The
+data modes inject at the BATCH boundary (:func:`wrap_data_stream` around
+the training stream — where real data poison arrives); ``NEXUS_FAULT_STEP``
+names the batch **draw index** and ``NEXUS_FAULT_TIMES`` the window width:
+
+==============  ==============================================================
+mode            effect / expected recovery
+==============  ==============================================================
+``nan-grads``   float batch leaves become NaN for the window → in-jit
+                sentinel flags non-finite, the update is skipped on device,
+                and the harness rolls back to the newest verified pre-window
+                checkpoint, skipping the poisoned draws via the data cursor
+                (run ends COMPLETED; recurrence → classified FAILED).
+``loss-spike``  float batch leaves scaled x1e4 for the window → loss/grad
+                spike vs the EMA baseline; each spiking step's update is
+                skipped in-jit (bounded skip budget), a streak past the
+                budget escalates to the same rollback-and-skip path.
+``step-hang``   the training loop wedges at the fault step (sleep-forever —
+                a stand-in for a hung collective).  The step-hang watchdog
+                (NEXUS_STEP_TIMEOUT_S) must fire: emergency save, classified
+                ``step-hang`` cause on the ledger, exit code 70 — never a
+                silent wedge (the unwatched variant of this is ``hang``).
+==============  ==============================================================
+
+Both data modes require an adapter with float batch leaves (the mnist
+preset); poisoning an int token batch cannot produce NaN grads, so the
+wrapper raises instead of running a vacuous drill.
 """
 
 from __future__ import annotations
@@ -107,6 +136,17 @@ EXECUTOR_FAULT_MODES = frozenset({"step-hbm-oom", "step-ici", "slow-step"})
 CHECKPOINT_FAULT_MODES = frozenset(
     {"ckpt-crash-mid-save", "ckpt-bitflip", "preempt-sigterm"}
 )
+
+#: modes injected at the DATA boundary by :func:`wrap_data_stream` (train
+#: harness) — same ownership contract: the loop's :func:`maybe_inject` stays
+#: silent when the stream is wrapped, and raises in loops that would make
+#: the drill vacuous (no wrapped stream)
+DATA_FAULT_MODES = frozenset({"nan-grads", "loss-spike"})
+
+#: input scale for ``loss-spike`` — big enough that any loss linear-ish in
+#: its inputs blows through the sentinel's spike factor, small enough to
+#: stay finite in f32
+LOSS_SPIKE_SCALE = 1e4
 
 #: message wordings recognized by the supervisor's classifier
 #: (tpu_nexus.supervisor.taxonomy) — injection uses the same strings so the
@@ -146,6 +186,8 @@ def maybe_inject(
     step: int,
     executor_faults_handled: bool = False,
     checkpoint_faults_handled: bool = False,
+    data_faults_handled: bool = False,
+    hang_watchdog_armed: bool = False,
 ) -> None:
     """Called once per training step / engine iteration; fires the
     configured fault at its step.  Executor-boundary modes
@@ -153,11 +195,15 @@ def maybe_inject(
     the serve-engine loop passes ``executor_faults_handled=True`` and this
     hook stays silent so the engine's recovery layer sees the fault;
     checkpoint-commit modes (:data:`CHECKPOINT_FAULT_MODES`) likewise
-    belong to :func:`checkpoint_fault_hook`, and the train loop passes
+    belong to :func:`checkpoint_fault_hook` (the train loop passes
     ``checkpoint_faults_handled=True`` when its checkpointer carries the
-    hook.  A loop that did NOT wire the corresponding seam raises at the
-    fault step instead: a chaos drill that injects nothing and reports
-    success is worse than no drill."""
+    hook), and data modes (:data:`DATA_FAULT_MODES`) to
+    :func:`wrap_data_stream`.  A loop that did NOT wire the corresponding
+    seam raises at the fault step instead: a chaos drill that injects
+    nothing and reports success is worse than no drill.  ``step-hang``
+    additionally demands an ARMED step-hang watchdog
+    (``hang_watchdog_armed``) — wedging a loop nothing watches is the
+    pre-existing ``hang`` drill, not this one."""
     if plan.mode is None or step != plan.step:
         return
     if plan.mode in EXECUTOR_FAULT_MODES:
@@ -177,6 +223,29 @@ def maybe_inject(
             "NEXUS_CHECKPOINT_EVERY/NEXUS_CHECKPOINT_DIR) — the drill would "
             "inject nothing"
         )
+    if plan.mode in DATA_FAULT_MODES:
+        if data_faults_handled:
+            return
+        raise ValueError(
+            f"fault mode {plan.mode!r} injects at the training-data "
+            "boundary; this loop has no wrapped data stream — the drill "
+            "would inject nothing"
+        )
+    if plan.mode == "step-hang":
+        if not hang_watchdog_armed:
+            raise ValueError(
+                "fault mode 'step-hang' wedges the training step; no armed "
+                "step-hang watchdog covers this step (set "
+                "NEXUS_STEP_TIMEOUT_S, and note the first iteration's jit "
+                "compile window runs unarmed — target a later step) — the "
+                "drill would hang silently instead of proving recovery"
+            )
+        logger.warning(
+            "injecting step-hang at step %d: wedging until the watchdog kills us",
+            step,
+        )
+        while True:  # pragma: no cover - the watchdog exits the process
+            time.sleep(3600)
     logger.warning("injecting fault %r at step %d", plan.mode, step)
     if plan.mode == "oom":
         os._exit(137)
@@ -367,6 +436,97 @@ def checkpoint_fault_hook(plan: FaultPlan):
     # nothing — the run must not exit 0 looking like a passed drill)
     hook.fired = fired
     return hook
+
+
+def _poison_tree(batch, poison_leaf):
+    """Map ``poison_leaf`` over float ndarray leaves of a plain batch pytree
+    (dict/tuple/list/ndarray — the numpy batches adapters yield).  Returns
+    ``(new_batch, n_poisoned)``."""
+    import numpy as np
+
+    count = 0
+
+    def walk(node):
+        nonlocal count
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        if isinstance(node, (list, tuple)):
+            mapped = [walk(v) for v in node]
+            return type(node)(mapped) if isinstance(node, tuple) else mapped
+        arr = np.asarray(node)
+        if np.issubdtype(arr.dtype, np.floating):
+            count += 1
+            return poison_leaf(arr)
+        return node
+
+    return walk(batch), count
+
+
+class PoisonedDataStream:
+    """Training-stream wrapper injecting numeric poison at the batch
+    boundary — exactly where real data corruption arrives, so the in-jit
+    sentinel + rollback-and-skip recovery is exercised end to end.
+
+    ``at_draw`` counts batches drawn from the underlying stream (the
+    DataCursor's draw-index space, so a recorded skip window lines up with
+    the poisoned window 1:1); ``times`` consecutive draws are poisoned.
+    ``fired`` is the vacuous-drill observable: a run that completes with
+    ``fired["count"] == 0`` must raise, not exit 0 looking like a passed
+    drill (same contract as :func:`checkpoint_fault_hook`)."""
+
+    def __init__(self, inner, mode: str, at_draw: int, times: int = 1) -> None:
+        if mode not in DATA_FAULT_MODES:
+            raise ValueError(
+                f"unknown data fault mode {mode!r}; use one of {sorted(DATA_FAULT_MODES)}"
+            )
+        self.inner = inner
+        self.mode = mode
+        self.at_draw = at_draw
+        self.times = times
+        self.draws = 0
+        self.fired = {"count": 0}
+
+    def __iter__(self) -> "PoisonedDataStream":
+        return self
+
+    def __next__(self):
+        import numpy as np
+
+        batch = next(self.inner)
+        index = self.draws
+        self.draws += 1
+        if not self.at_draw <= index < self.at_draw + self.times:
+            return batch
+        if self.mode == "nan-grads":
+            poison = lambda arr: np.full_like(arr, np.nan)  # noqa: E731
+        else:  # loss-spike
+            poison = lambda arr: arr * LOSS_SPIKE_SCALE  # noqa: E731
+        batch, poisoned = _poison_tree(batch, poison)
+        if poisoned == 0:
+            raise ValueError(
+                f"fault mode {self.mode!r} found no float leaves in the batch "
+                "(int token batches cannot carry NaN) — use a float-batch "
+                "adapter (mnist preset) for this drill"
+            )
+        self.fired["count"] += 1
+        logger.warning(
+            "injecting %s into batch draw %d (%d float leaves poisoned)",
+            self.mode, index, poisoned,
+        )
+        return batch
+
+
+def wrap_data_stream(plan: FaultPlan, stream):
+    """Wrap the training batch stream per the fault plan; pass-through for
+    non-data modes.  ``NEXUS_FAULT_STEP`` names the batch draw index,
+    ``NEXUS_FAULT_TIMES`` the poisoned-window width."""
+    if plan.mode not in DATA_FAULT_MODES:
+        return stream
+    logger.warning(
+        "training chaos: poisoning data stream with %r (draw=%d times=%d)",
+        plan.mode, plan.step, plan.times,
+    )
+    return PoisonedDataStream(stream, plan.mode, at_draw=plan.step, times=plan.times)
 
 
 #: back-compat alias (tests imported the pre-rollout private name)

@@ -32,10 +32,11 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import threading
 import time
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
-from typing import Any, Dict, Iterator, Optional
+from typing import Any, Callable, Dict, Iterator, Optional
 
 import jax
 import numpy as np
@@ -49,7 +50,26 @@ from tpu_nexus.models.registry import adapter_for, get_adapter
 from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
 from tpu_nexus.parallel.distributed import ProcessContext, initialize_distributed
 from tpu_nexus.parallel.sharding import RuleTable
-from tpu_nexus.workload.faults import FaultPlan, checkpoint_fault_hook, maybe_inject
+from tpu_nexus.workload import durability
+from tpu_nexus.workload.data import DataCursor
+from tpu_nexus.workload.faults import (
+    FaultPlan,
+    checkpoint_fault_hook,
+    maybe_inject,
+    wrap_data_stream,
+)
+from tpu_nexus.workload.health import (
+    CAUSE_NUMERIC_NAN,
+    CAUSE_STEP_HANG,
+    STEP_HANG_EXIT_CODE,
+    Anomaly,
+    HealthConfig,
+    HealthMonitor,
+    HealthPolicy,
+    StepWatchdog,
+    classified_failure_text,
+    hang_cause,
+)
 from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
 from tpu_nexus.workload.train import (
     TrainConfig,
@@ -109,6 +129,9 @@ class WorkloadConfig:
     #: and its duration reported honestly; the budget is what tests and the
     #: ledger details hold it to.
     emergency_grace_s: float = 30.0
+    #: numerical-health sentinel + step-hang watchdog knobs
+    #: (workload/health.py; NEXUS_HEALTH*/NEXUS_STEP_TIMEOUT_S env contract)
+    health: HealthConfig = field(default_factory=HealthConfig)
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "WorkloadConfig":
@@ -153,6 +176,7 @@ class WorkloadConfig:
             eval_every=int(e.get("NEXUS_EVAL_EVERY", "0")),
             eval_steps=int(e.get("NEXUS_EVAL_STEPS", "4")),
             emergency_grace_s=float(e.get("NEXUS_EMERGENCY_GRACE_S", "30")),
+            health=HealthConfig.from_env(e),
         )
 
 
@@ -230,6 +254,32 @@ class LedgerReporter:
         )
         self.heartbeat(step)
 
+    def health_rollback(self, uri: str, step: int, details: str) -> None:
+        """Health-policy recovery: repoint the ledger at the verified step
+        the run rolled back to and record the anomaly + skipped data window
+        in the details column.  Same NX007 contract as
+        :meth:`tensor_checkpoint`: the caller's verified-step resolution
+        (``latest_verified_step(before=...)``) is the barrier."""
+        self._guarded_update(
+            {"tensor_checkpoint_uri": uri, "algorithm_failure_details": details}
+        )
+        self.heartbeat(step)
+
+    def failed(self, cause: str, details: str = "") -> None:
+        """Workload-side terminal failure with a classified cause — the
+        step-hang watchdog's exit path.  Normally detection is the
+        supervisor's job (crash → k8s event), but a hang produces NO event
+        and NO crash until the k8s deadline; writing FAILED here mirrors
+        the drain protocol's own PREEMPTED write: the process that KNOWS
+        the cause records it.  The IsFinished guard makes the multi-host
+        fan-in safe (first writer wins, later hosts' writes drop)."""
+        fields: Dict[str, Any] = {"lifecycle_stage": LifecycleStage.FAILED}
+        if cause:
+            fields["algorithm_failure_cause"] = cause
+        if details:
+            fields["algorithm_failure_details"] = details
+        self._guarded_update(fields)
+
     def completed(self, result_uri: str = "") -> None:
         self._guarded_update(
             {"lifecycle_stage": LifecycleStage.COMPLETED, "result_uri": result_uri}
@@ -276,6 +326,147 @@ def _dump_failure_trace(cfg: WorkloadConfig, ctx: ProcessContext, step: int, exc
     except OSError:  # pragma: no cover - trace dir unwritable
         logger.exception("failed to write failure trace")
         return ""
+
+
+def _restore_train_state(
+    ckpt: TensorCheckpointer, state: Dict[str, Any], step: int
+) -> Dict[str, Any]:
+    """Restore ``step`` into the current state template, migrating
+    PRE-HEALTH checkpoints on the way: the train state grew a ``health``
+    subtree (sentinel EMA scalars), and a checkpoint written before it
+    would fail the template restore with a tree-structure mismatch —
+    turning an image upgrade into a startup crash for every durable run
+    mid-flight.  On that specific mismatch, restore the legacy structure
+    and seed fresh sentinel state (the EMA re-warms over
+    ``warmup_steps`` applied steps — safe, just briefly unarmed).
+    Deterministic per checkpoint, so multi-host retries stay uniform."""
+    from tpu_nexus.workload.health import health_init
+
+    try:
+        return ckpt.restore(state, step)
+    except (ValueError, KeyError, TypeError) as exc:
+        if "health" not in state:
+            raise
+        legacy_template = {k: v for k, v in state.items() if k != "health"}
+        try:
+            restored = ckpt.restore(legacy_template, step)
+        except Exception:  # noqa: BLE001 - migration probe failed: surface the ORIGINAL structure error, not the probe's
+            raise exc from None
+        logger.info(
+            "restored pre-health checkpoint at step %d (sentinel state reseeded)",
+            step,
+        )
+        return {**restored, "health": health_init()}
+
+
+def _make_hang_handler(
+    cfg: WorkloadConfig,
+    ckpt: Optional[TensorCheckpointer],
+    reporter: LedgerReporter,
+    ctx: ProcessContext,
+    telemetry: Metrics,
+    latest_ref: Dict[str, Any],
+    evidence: Optional[Callable[[], Dict[str, Any]]] = None,
+):
+    """Build the StepWatchdog's on_hang callback.
+
+    Runs on the watchdog thread while the main thread is wedged mid-step
+    (stuck collective / injected hang); it never returns.  Protocol:
+
+    1. attempt the emergency-save path for the newest COMPLETED state the
+       loop handed over (``latest_ref`` — the wedged step's own state is
+       unmaterialized futures, and on TPU the pre-step buffers were donated
+       into the wedged dispatch, so best-effort is the only honest
+       contract).  The save runs on a helper thread with the emergency
+       grace budget as a join timeout: if the device runtime itself is
+       wedged, the save hangs and we exit without it, honestly recorded.
+    2. write the ledger row FAILED with the classified ``step-hang`` cause
+       (``classify_tpu_failure`` → TO_FAIL_STEP_HANG) and the save outcome
+       in the details — the supervisor's event path would otherwise see
+       nothing until the k8s deadline ("a wedge is not an event").
+    3. ``os._exit(STEP_HANG_EXIT_CODE)``: the wedged main thread cannot
+       unwind, so a raw exit is the only way off the box; nonzero so the
+       JobSet never mistakes the wedge for success.
+
+    Multi-host: every host's watchdog arms the same deadline on the same
+    step cadence and a wedged collective freezes all of them, so each host
+    runs this independently — the uniform-deadline analogue of the PR 5
+    allgather pattern (the wedged collective itself cannot carry a vote).
+    The FAILED write is idempotent under the IsFinished guard.
+    """
+    import os as _os
+
+    def _on_hang(step: int, timeout_s: float) -> None:
+        # EVERYTHING here is best-effort inside try/finally: a failure in
+        # the save, the telemetry, or the ledger write (a locked sqlite, a
+        # dead CQL session) must never skip the exit — an exception
+        # escaping this handler would end the one-shot watchdog thread and
+        # leave the wedged process alive and silent, the exact outcome the
+        # watchdog exists to prevent.
+        try:
+            _hang_protocol(step, timeout_s)
+        finally:
+            _os._exit(STEP_HANG_EXIT_CODE)
+
+    def _hang_protocol(step: int, timeout_s: float) -> None:
+        cause = hang_cause(step, timeout_s)
+        if ctx.is_coordinator:
+            # one incident, one count: every host's watchdog fires on a
+            # wedged collective — same dedup rule as the rollback counters
+            telemetry.count("train.anomaly", tags={"cause": CAUSE_STEP_HANG})
+        logger.error("%s — emergency save + classified exit", cause)
+        info: Dict[str, Any] = {
+            "hang_step": step,
+            "deadline_s": timeout_s,
+            "emergency_step": None,
+        }
+        state, cursor_state = latest_ref.get("snap") or (None, None)
+        if ckpt is not None and state is not None:
+            saved: Dict[str, Any] = {}
+
+            def _save() -> None:
+                try:
+                    final_step = int(state["step"])
+                    if final_step <= 0:
+                        return
+                    if ckpt.last_committed_step != final_step:
+                        ckpt.save(final_step, state)
+                        if ctx.is_coordinator:
+                            if cursor_state is not None:
+                                # the hang restart must replay any
+                                # health-skipped windows too — same
+                                # restart-from-*data* contract as the
+                                # preemption emergency save.  The SNAPSHOT
+                                # paired with this state, never the live
+                                # cursor: the wedge may sit between a draw
+                                # and its step completing, and the live
+                                # position would be one draw ahead.
+                                ckpt.save_cursor(final_step, cursor_state)
+                            uri = ckpt.commit(final_step)
+                            reporter.tensor_checkpoint(uri, final_step)
+                        else:
+                            ckpt.wait()
+                    saved["step"] = final_step
+                except Exception:  # noqa: BLE001 - best-effort: a wedged runtime hangs/kills the save; the exit below still records the hang honestly
+                    logger.exception("emergency save during step-hang failed")
+
+            t0 = time.perf_counter()
+            saver = threading.Thread(target=_save, daemon=True)
+            saver.start()
+            saver.join(timeout=cfg.emergency_grace_s)
+            info["emergency_step"] = saved.get("step")
+            info["emergency_save_s"] = time.perf_counter() - t0
+            telemetry.count(
+                "train.emergency_save",
+                tags={"skipped": "false" if saved.get("step") else "failed"},
+            )
+        # re-merge the run's earlier recovery evidence (health/ckpt
+        # rollbacks) — the details column is rewritten wholesale, and the
+        # cause trail RUNBOOK §13 points operators at must survive the hang
+        payload = {**(evidence() if evidence is not None else {}), **info}
+        reporter.failed(cause, details=json.dumps(payload))
+
+    return _on_hang
 
 
 def run_workload(
@@ -364,7 +555,7 @@ def _workload_loop(
         # so every host still lands on the same step)
         latest = ckpt.latest_verified_step(quarantine=ctx.is_coordinator)
         if latest is not None:
-            state = ckpt.restore(state, latest)
+            state = _restore_train_state(ckpt, state, latest)
             start_step = latest
             resumed_from = latest
             logger.info("restored verified tensor checkpoint at step %d", latest)
@@ -405,7 +596,7 @@ def _workload_loop(
                     rollback_events,
                 )
 
-    step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules)
+    step_fn = make_train_step(adapter, cfg.train, mesh, cfg.rules, health=cfg.health)
     # cfg.batch_size is GLOBAL.  Two multi-process data modes:
     #  * batch-rows mode (the scalable default): each process generates its
     #    own shard of the batch rows (disjoint seeds) and the global array
@@ -458,11 +649,24 @@ def _workload_loop(
                 )
             local_batch = cfg.batch_size // ctx.num_processes
             data = make_stream(local_batch, seed=cfg.seed + ctx.process_id)
-    # restart-from-step must also restart-from-*data*: fast-forward the
-    # stream so resumed steps see the batches they would have seen, not a
-    # replay of batch 0..N (which silently corrupts the training trajectory)
-    for _ in range(start_step):
-        next(data)
+    # chaos seam: data fault modes (nan-grads/loss-spike) poison batches at
+    # the draw boundary, UNDER the cursor so draw indices line up with the
+    # cursor's skip-window space
+    poison = wrap_data_stream(plan, data)
+    data_faults_handled = poison is not data
+    # restart-from-step must also restart-from-*data*: the cursor replays
+    # the stream to the exact draw position the restored checkpoint's
+    # sidecar recorded (which includes any health-rollback skip windows —
+    # a plain step-count fast-forward would re-consume a skipped window and
+    # silently fork the trajectory); steps older than the sidecar fall back
+    # to the historical step-count fast-forward
+    if start_step:
+        cursor_state = (ckpt.load_cursor(start_step) if ckpt else None) or {
+            "position": start_step
+        }
+        cursor = DataCursor.restore(poison, cursor_state)
+    else:
+        cursor = DataCursor(poison)
     shardings = batch_shardings(adapter, mesh, cfg.rules)
 
     def to_global(raw):
@@ -501,25 +705,142 @@ def _workload_loop(
     if ctx.num_processes > 1:
         from jax.experimental import multihost_utils
 
-        def cancel_requested() -> bool:
-            # the break decision must be UNIFORM across hosts: SIGTERM
-            # delivery skews by milliseconds, and a host that breaks for
-            # the emergency save while another enters the next step's
-            # psums leaves the two sides in mismatched collectives —
-            # deadlocked until the runtime SIGKILLs, losing the very
-            # checkpoint the grace window exists for.  Every host
-            # contributes its local flag at the same loop point; any host
-            # signalled → all break together.  One tiny host allgather
-            # per step, multi-host runs only.
+        def sync_flags(anomaly_local: bool) -> "tuple[bool, bool]":
+            # the break/recover decision must be UNIFORM across hosts:
+            # SIGTERM delivery skews by milliseconds, and a host that
+            # breaks for the emergency save (or enters the collective
+            # rollback restore) while another enters the next step's psums
+            # leaves the two sides in mismatched collectives — deadlocked
+            # until the runtime SIGKILLs.  Every host contributes BOTH
+            # local flags (cancelled, health anomaly) at the same loop
+            # point; any host set → all act together.  The health flag is
+            # derived from globally-reduced scalars so divergence should be
+            # impossible — the allgather makes that a guarantee instead of
+            # an argument (PR 5 pattern).  One tiny allgather per step,
+            # multi-host runs only.
             flags = multihost_utils.process_allgather(
-                np.asarray(bool(lifecycle.cancelled))
+                np.asarray([bool(lifecycle.cancelled), bool(anomaly_local)])
             )
-            return bool(np.any(flags))
+            gathered = np.asarray(flags).reshape(-1, 2)
+            return bool(np.any(gathered[:, 0])), bool(np.any(gathered[:, 1]))
 
     else:
 
-        def cancel_requested() -> bool:
-            return lifecycle.cancelled
+        def sync_flags(anomaly_local: bool) -> "tuple[bool, bool]":
+            return lifecycle.cancelled, bool(anomaly_local)
+
+    def cancel_requested() -> bool:
+        return sync_flags(False)[0]
+
+    # -- self-healing machinery (workload/health.py) ---------------------------
+    health_cfg = cfg.health
+    monitor = (
+        HealthMonitor(health_cfg, metrics=telemetry if ctx.is_coordinator else None)
+        if health_cfg.enabled
+        else None
+    )
+    policy = HealthPolicy(health_cfg)
+    health_events: list = []
+
+    def _evidence() -> Dict[str, Any]:
+        # ONE details payload carrying every recovery story this run owns —
+        # each write rewrites the column wholesale, so later writers (the
+        # rollback repoint, the hang handler, preempted()) must re-merge
+        # the earlier evidence
+        details: Dict[str, Any] = {}
+        if health_events:
+            details["health_rollback"] = list(health_events)
+        if rollback_events:
+            details["ckpt_rollback"] = _rollback_record(rollback_events)
+        return details
+
+    def _health_details() -> str:
+        return json.dumps(_evidence())
+
+    def _health_recover(anomaly: Anomaly, current_state: Dict[str, Any]):
+        """Rollback-and-skip: restore the newest VERIFIED checkpoint from
+        before the poisoned window, skip the window on the data cursor, and
+        resume — or raise a classified terminal failure when recovery
+        cannot help (no pre-window checkpoint, recurrence, budget).  Every
+        host executes this at the same loop point with the same anomaly
+        (sentinel flags derive from globally-reduced scalars; sync_flags
+        re-proved agreement), so the collective restore below is uniform."""
+        limit = anomaly.step + 1  # checkpoints <= the flagged step predate the window
+        target = (
+            ckpt.latest_verified_step(quarantine=ctx.is_coordinator, before=limit)
+            if ckpt is not None
+            else None
+        )
+        # the before-scan may have quarantined steps that rotted SINCE the
+        # startup scan — fold the fresh events into the run's corruption
+        # evidence (ledger details, summary, metrics) like the startup ones
+        if ckpt is not None and len(ckpt.rollbacks) > len(rollback_events):
+            fresh = ckpt.rollbacks[len(rollback_events):]
+            rollback_events.extend(fresh)
+            if ctx.is_coordinator:
+                for event in fresh:
+                    telemetry.count(
+                        "train.ckpt_rollback", tags={"cause": event["cause"]}
+                    )
+        verdict, why = policy.decide(anomaly, target)
+        if ctx.is_coordinator:
+            telemetry.count("train.anomaly", tags={"cause": anomaly.kind})
+        if verdict == "fail":
+            raise RuntimeError(classified_failure_text(anomaly, why))
+        # newer steps are healthy bytes on the ABANDONED trajectory: the
+        # retrained run re-commits the same step numbers with different
+        # weights, so set them aside (never quarantine-as-corrupt — a
+        # postmortem must tell divergence recovery from bit rot)
+        abandoned = []
+        if ctx.is_coordinator:
+            for s in durability.list_steps(cfg.checkpoint_dir):
+                if s > target:
+                    abandoned.append(durability.abandon_step(cfg.checkpoint_dir, s))
+        restored = _restore_train_state(ckpt, current_state, target)
+        # the renames above happened behind every host's live orbax manager
+        # (including the coordinator's own); the collective restore is the
+        # sync point proving they landed — refresh so a re-save of an
+        # abandoned step number is a real save, not a silent no-op
+        ckpt.reload()
+        sidecar = ckpt.load_cursor(target) or {"position": target}
+        window = [int(sidecar.get("position", target)), int(cursor.position)]
+        cursor.skip_window(window[0], window[1])
+        record = {
+            "cause": anomaly.kind,
+            "flagged_step": anomaly.step,
+            "restored_step": target,
+            "skipped_window": window,
+            "detail": str(anomaly.detail)[:200],
+        }
+        policy.record(record)
+        health_events.append(record)
+        if monitor is not None:
+            monitor.reset()  # pending flags belong to the abandoned trajectory
+        logger.warning(
+            "health rollback (%s): flagged step %d, restored verified step %d, "
+            "skipped data window [%d, %d), abandoned %d newer checkpoint(s)",
+            anomaly.kind, anomaly.step, target, window[0], window[1], len(abandoned),
+        )
+        if ctx.is_coordinator:
+            telemetry.count("train.rollback", tags={"cause": anomaly.kind})
+            reporter.health_rollback(ckpt.uri_for(target), target, _health_details())
+        return restored, target
+
+    # the hang handler's snapshot: (state, matching cursor state) as ONE
+    # atomic tuple — a live cursor read from the watchdog thread could be
+    # one draw ahead of the last completed state (the wedge may land
+    # between the draw and the step completing), and a restart from that
+    # pair would silently shift the schedule by one batch
+    latest_ref: Dict[str, Any] = {"snap": (state, cursor.state())}
+    watchdog: Optional[StepWatchdog] = None
+    if health_cfg.enabled and health_cfg.step_timeout_s > 0:
+        watchdog = StepWatchdog(
+            health_cfg.step_timeout_s,
+            _make_hang_handler(
+                cfg, ckpt, reporter, ctx, telemetry, latest_ref, evidence=_evidence
+            ),
+        )
+        watchdog.start()
 
     reporter.running()
     metrics: Dict[str, Any] = {}
@@ -527,22 +848,77 @@ def _workload_loop(
     t0 = time.perf_counter()
     tokens_done = 0
     step = start_step
+    pending_anomaly: Optional[Anomaly] = None
+    compile_pending = True  # the first step_fn call compiles synchronously
     try:
         with mesh:
-            for step in range(start_step, cfg.steps):
-                if cancel_requested():
+            while True:
+                if step >= cfg.steps and pending_anomaly is None and monitor is not None:
+                    # the sentinel reads flags one step delayed — the FINAL
+                    # step's verdict is still pending when the loop drains
+                    pending_anomaly = monitor.drain()
+                cancelled, anomaly_flag = sync_flags(pending_anomaly is not None)
+                if cancelled:
                     # preemption: stop consuming batches NOW — the grace
                     # window belongs to the emergency save below
                     break
-                maybe_inject(plan, step, checkpoint_faults_handled=ckpt is not None)
-                batch = to_global(next(data))
+                if anomaly_flag:
+                    # a peer host's flag without a local anomaly should be
+                    # impossible (flags derive from the same global scalars)
+                    # — fail safe to the same window if it ever happens
+                    anomaly = pending_anomaly or Anomaly(
+                        CAUSE_NUMERIC_NAN, max(step - 1, start_step), "peer host flagged"
+                    )
+                    pending_anomaly = None
+                    state, step = _health_recover(anomaly, state)
+                    latest_ref["snap"] = (state, cursor.state())
+                    continue
+                if step >= cfg.steps:
+                    break
+                # the deadline is sized to steady-state step time, so the
+                # first iteration — whose step_fn call compiles the jit
+                # synchronously, potentially for minutes — runs unarmed;
+                # the armed window covers batch draw, dispatch, the
+                # sentinel's delayed readback and the heartbeat sync, and
+                # closes before the eval/checkpoint blocks (whose duration
+                # legitimately dwarfs a step)
+                armed = watchdog is not None and not compile_pending
+                if armed:
+                    watchdog.arm(step)
+                maybe_inject(
+                    plan,
+                    step,
+                    checkpoint_faults_handled=ckpt is not None,
+                    data_faults_handled=data_faults_handled,
+                    hang_watchdog_armed=armed,
+                )
+                batch = to_global(next(cursor))
                 state, m = step_fn(state, batch)
+                # one assignment: the watchdog thread must never observe a
+                # state/cursor pair that disagrees about consumed draws
+                latest_ref["snap"] = (state, cursor.state())
                 tokens_done += adapter.items_in(batch)
+                if monitor is not None:
+                    # one-step-delayed readback: materializes the PREVIOUS
+                    # step's verdict (already retired on device), stores this
+                    # step's — no sync on the step just dispatched.  The jit
+                    # already gated a condemned update, so acting a step
+                    # late loses nothing irreversible.
+                    pending_anomaly = monitor.push(step, m)
                 if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
                     # pull metrics (device sync) only on heartbeat steps
                     metrics = {k: float(v) for k, v in m.items()}
                     reporter.heartbeat(step + 1)
                     logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
+                    # anomalies must be visible in statsd BEFORE (and after)
+                    # the sentinel trips — the on-call watches these gauges
+                    if "loss" in metrics:
+                        telemetry.gauge("train.loss", metrics["loss"])
+                    if "grad_norm" in metrics:
+                        telemetry.gauge("train.grad_norm", metrics["grad_norm"])
+                if watchdog is not None:
+                    watchdog.disarm()
+                compile_pending = False
                 if eval_fn and (step + 1) % cfg.eval_every == 0:
                     losses = [
                         eval_fn(state, to_global(next(eval_data)))["loss"]
@@ -557,12 +933,16 @@ def _workload_loop(
                     # URI that could still be torn (nxlint NX007).  One
                     # manifest writer per run: non-coordinators only hold the
                     # wait (the save itself is the multi-host collective).
+                    # The cursor sidecar stages between save and commit so
+                    # the manifest covers it (restart-from-*data*).
                     ckpt.save(step + 1, state)
                     if ctx.is_coordinator:
+                        ckpt.save_cursor(step + 1, cursor.state())
                         uri = ckpt.commit(step + 1)
                         reporter.tensor_checkpoint(uri, step + 1)
                     else:
                         ckpt.wait()
+                step += 1
     except Exception as exc:  # noqa: BLE001 - annotate, record, re-raise
         # north-star contract: failure-time trace artifact, its ref in the
         # ledger (hlo_trace_ref) AND in the raised message so the k8s event
@@ -572,6 +952,9 @@ def _workload_loop(
             reporter.hlo_trace(uri)
             raise RuntimeError(f"{exc} [hlo_trace: {uri}]") from exc
         raise
+    finally:
+        if watchdog is not None:
+            watchdog.stop()
     jax.block_until_ready(state["step"])
     elapsed = time.perf_counter() - t0
     # same uniformity rule as the loop break: every host reaches this point
@@ -581,7 +964,9 @@ def _workload_loop(
     preempted = cancel_requested()
     emergency: Dict[str, Any] = {}
     if preempted:
-        emergency = _emergency_save(cfg, ckpt, state, reporter, ctx, lifecycle, telemetry)
+        emergency = _emergency_save(
+            cfg, ckpt, state, reporter, ctx, lifecycle, telemetry, cursor=cursor
+        )
     if ckpt:
         ckpt.wait()
         ckpt.close()
@@ -601,6 +986,28 @@ def _workload_loop(
             f"chaos drill injected nothing: fault mode {plan.mode!r} targets "
             f"checkpoint step {plan.step}, but that step never committed "
             f"(checkpoint_every={cfg.checkpoint_every}, steps={cfg.steps})"
+        )
+    if (
+        ctx.is_coordinator
+        and data_faults_handled
+        and not preempted
+        and poison.fired["count"] == 0
+    ):
+        # same guard, data-poison flavor: the fault draw index was never
+        # reached (or a rollback skip-window silently swallowed it before
+        # it could fire) — a drill that poisoned nothing must not exit 0
+        raise RuntimeError(
+            f"chaos drill injected nothing: fault mode {plan.mode!r} targets "
+            f"batch draw {plan.step}, but only {cursor.position} draws happened "
+            f"(steps={cfg.steps})"
+        )
+    if ctx.is_coordinator and plan.mode == "step-hang" and not preempted:
+        # reachable only if the fault step was never hit: a fired step-hang
+        # exits the process through the watchdog (exit code 70)
+        raise RuntimeError(
+            f"chaos drill injected nothing: fault mode 'step-hang' targets "
+            f"step {plan.step}, but the run completed {cfg.steps} steps "
+            "without wedging"
         )
     metrics = {k: float(v) for k, v in m.items()} if m else metrics
     final_step = int(state["step"])
@@ -633,6 +1040,11 @@ def _workload_loop(
                             if rollback_events
                             else {}
                         ),
+                        **(
+                            {"health_rollback": health_events}
+                            if health_events
+                            else {}
+                        ),
                     }
                 ),
             )
@@ -646,6 +1058,8 @@ def _workload_loop(
         **({"eval_loss": eval_loss} if eval_loss is not None else {}),
         **({"preempted": True, **emergency} if preempted else {}),
         **({"ckpt_rollbacks": rollback_events} if rollback_events else {}),
+        **({"health_rollbacks": health_events} if health_events else {}),
+        **({"health_skips": monitor.skips_observed} if monitor and monitor.skips_observed else {}),
         **metrics,
     }
 
@@ -658,6 +1072,7 @@ def _emergency_save(
     ctx: ProcessContext,
     lifecycle: LifecycleContext,
     telemetry: Metrics,
+    cursor: Optional[DataCursor] = None,
 ) -> Dict[str, Any]:
     """Preemption → saved step: cut a final checkpoint inside the grace
     window, skipping when the interrupted loop already committed this exact
@@ -690,6 +1105,11 @@ def _emergency_save(
     try:
         ckpt.save(step, state)
         if ctx.is_coordinator:
+            if cursor is not None:
+                # restart-from-*data*: the emergency step's sidecar carries
+                # the cursor (incl. any health-rollback skip windows) so the
+                # restart resumes the exact schedule
+                ckpt.save_cursor(step, cursor.state())
             uri = ckpt.commit(step)  # durability barrier before publish (NX007)
         else:
             ckpt.wait()

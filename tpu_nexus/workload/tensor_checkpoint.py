@@ -50,6 +50,11 @@ logger = logging.getLogger(__name__)
 HOOK_PRE_COMMIT = "pre-commit"
 HOOK_POST_COMMIT = "post-commit"
 
+#: data-cursor sidecar (workload/data.DataCursor.state()), written into the
+#: step directory before commit so the manifest checksums it — the
+#: restart-from-*data* half of the restart-from-step contract
+CURSOR_SIDECAR = "_NEXUS_CURSOR.json"
+
 
 class TensorCheckpointer:
     """Orbax wrapper with an explicit commit protocol: save/restore the
@@ -135,6 +140,23 @@ class TensorCheckpointer:
     def wait(self) -> None:
         self._mngr.wait_until_finished()
 
+    def save_cursor(self, step: int, state: Dict[str, Any]) -> str:
+        """Stage the data-cursor sidecar into step ``step``'s directory.
+        Must run between :meth:`save` and :meth:`commit` (it waits for the
+        async save itself — orbax only renames the step directory into
+        place at finalize); the commit manifest then covers the sidecar, so
+        cursor state is exactly as durable and tamper-evident as the
+        tensors it describes.  Coordinator-only on multi-host (shared
+        filesystem, one writer)."""
+        self.wait()
+        return durability.write_json_sidecar(self.step_dir(step), CURSOR_SIDECAR, state)
+
+    def load_cursor(self, step: int) -> Optional[Dict[str, Any]]:
+        """The cursor sidecar of a (verified) step; None for steps written
+        before the sidecar existed — callers fall back to the plain
+        step-count fast-forward."""
+        return durability.read_json_sidecar(self.step_dir(step), CURSOR_SIDECAR)
+
     # -- verification / rollback ----------------------------------------------
 
     def verify(self, step: int) -> Dict[str, Any]:
@@ -142,13 +164,17 @@ class TensorCheckpointer:
         manifest); raises the classified ``Checkpoint*`` errors."""
         return durability.verify_step(self.step_dir(step), step)
 
-    def latest_verified_step(self, quarantine: bool = True) -> Optional[int]:
+    def latest_verified_step(
+        self, quarantine: bool = True, before: Optional[int] = None
+    ) -> Optional[int]:
         """Newest step that passes verification, rolling back past torn or
         corrupt ones.  Bad steps are quarantined (renamed ``<step>.corrupt``)
         unless ``quarantine=False`` (read-only consumers: serving), and each
-        rollback is appended to :attr:`rollbacks` for the caller to report."""
+        rollback is appended to :attr:`rollbacks` for the caller to report.
+        ``before`` restricts the scan to steps < ``before`` (the health
+        rollback's pre-poison-window constraint)."""
         step, rollbacks = durability.newest_verified_step(
-            self.directory, quarantine=quarantine
+            self.directory, quarantine=quarantine, before=before
         )
         self.rollbacks.extend(rollbacks)
         if step is not None:

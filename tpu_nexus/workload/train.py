@@ -24,6 +24,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_nexus.parallel.sharding import RuleTable, sharding_tree, spec_for
+from tpu_nexus.workload.health import HealthConfig, gate_update, health_init, sentinel_update
 
 
 @dataclass(frozen=True)
@@ -222,7 +223,15 @@ def init_train_state(
 
     def init(key):
         params = adapter.init(key)
-        return {"params": params, "opt_state": optimizer.init(params), "step": jnp.zeros((), jnp.int32)}
+        return {
+            "params": params,
+            "opt_state": optimizer.init(params),
+            "step": jnp.zeros((), jnp.int32),
+            # numerical-health sentinel state (workload/health.py): EMA
+            # baselines + warmup clock, carried on device with the rest of
+            # the train state so checkpoints capture it
+            "health": health_init(),
+        }
 
     if mesh is None:
         return init(key)
@@ -265,6 +274,8 @@ def state_shardings(init_fn, key, model, mesh, rules) -> Any:
             subtree_sharding, state_shape["opt_state"], is_leaf=is_param_tree
         ),
         "step": replicated,
+        # sentinel scalars: replicated like the step counter
+        "health": jax.tree.map(lambda _: replicated, state_shape["health"]),
     }
 
 
@@ -311,27 +322,67 @@ def make_train_step(
     train_cfg: TrainConfig,
     mesh: Mesh,
     rules: RuleTable,
+    health: Optional[HealthConfig] = None,
 ) -> Callable[[Dict[str, Any], Any], Tuple[Dict[str, Any], Dict[str, jax.Array]]]:
     """Jitted (state, batch) -> (state, metrics); donates state buffers.
 
     The adapter builds the loss (for Llama that includes injecting ring
     attention when the mesh's ``sp`` axis is non-trivial; otherwise attention
     dispatches to the pallas flash kernel on TPU or XLA).
+
+    ``health`` adds the in-jit numerical sentinel: finite-flags and an EMA
+    spike detector over (loss, grad_norm), and the optimizer update is
+    GATED on the verdict — a NaN/Inf or spiking step leaves
+    params/opt_state bit-untouched (``jnp.where`` is a select, never
+    arithmetic over the rejected branch), while an applied step installs
+    exactly the computed update.  The verdict rides the metrics dict as
+    device scalars (health_nonfinite/health_spike/health_applied) for the
+    harness's delayed readback; no host sync happens under the trace.
+
+    ``health=None`` (the bare-caller default: benches, numeric parity
+    tests) compiles the UNGATED seed program — the gating ops cost real
+    compile time per trace, and callers outside the harness own their own
+    numerics.  The training STACK is sentinel-on by default: the harness
+    always passes ``WorkloadConfig.health`` (enabled unless
+    ``NEXUS_HEALTH=0``).
     """
     adapter = _as_adapter(model)
     optimizer = make_optimizer(train_cfg)
     loss_fn = adapter.make_loss(train_cfg, mesh, rules=rules)
     shardings = batch_shardings(adapter, mesh, rules)
+    health_cfg = health if health is not None else HealthConfig(enabled=False)
 
     def step_fn(state, batch):
         batch = jax.lax.with_sharding_constraint(batch, shardings)
         (loss, metrics), grads = jax.value_and_grad(loss_fn, has_aux=True)(
             state["params"], batch
         )
+        grad_norm = optax.global_norm(grads)
         updates, opt_state = optimizer.update(grads, state["opt_state"], state["params"])
         params = optax.apply_updates(state["params"], updates)
-        new_state = {"params": params, "opt_state": opt_state, "step": state["step"] + 1}
-        metrics = dict(metrics, loss=loss, grad_norm=optax.global_norm(grads))
+        health_state = state["health"]
+        metrics = dict(metrics, loss=loss, grad_norm=grad_norm)
+        if health_cfg.enabled:
+            health_state, flags = sentinel_update(
+                health_state,
+                loss,
+                grad_norm,
+                ema_beta=health_cfg.ema_beta,
+                spike_factor=health_cfg.spike_factor,
+                warmup_steps=health_cfg.warmup_steps,
+            )
+            applied = flags["health_applied"] > 0
+            params = gate_update(applied, params, state["params"])
+            opt_state = gate_update(applied, opt_state, state["opt_state"])
+            metrics.update(flags)
+        new_state = {
+            "params": params,
+            "opt_state": opt_state,
+            # the step counter always advances — it counts data consumed,
+            # and the data cursor's determinism contract depends on that
+            "step": state["step"] + 1,
+            "health": health_state,
+        }
         return new_state, metrics
 
     return jax.jit(step_fn, donate_argnums=(0,))
