@@ -155,6 +155,110 @@ class TestKernelParity:
         )
 
 
+class TestRaggedQVerify:
+    """The q_len>1 VECTOR-POS path (ISSUE 11, the speculative verify's
+    attention): per-row ``q_starts`` ragged query blocks — previously the
+    multi-q clamp was uniform (every row's block ends at kv_len-1) and
+    had no serving-context coverage."""
+
+    @pytest.mark.parametrize("sq", [2, 3, 5, 8])
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_ragged_positions_match_xla(self, sq, dtype):
+        """Per-slot cursors at different depths (the serving batch): the
+        kernel's prefetched q_starts mask vs the XLA per-row clamp."""
+        b = 3
+        k, v = _case(b=b, max_len=96, dtype=dtype)
+        q = _rand(jax.random.PRNGKey(21), (b, sq, 4, 32), dtype)
+        starts = jnp.asarray([5, 61, 30], jnp.int32)  # ragged slot cursors
+        kv_len = jnp.max(starts) + sq
+        kw = dict(
+            prompt_lengths=jnp.zeros(b, jnp.int32), prompt_width=0,
+            q_starts=starts,
+        )
+        out = decode_attention(q, k, v, kv_len, interpret=True, **kw)
+        ref = _xla(q, k, v, kv_len, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out, np.float32), np.asarray(ref, np.float32), **_tol(dtype)
+        )
+
+    @pytest.mark.parametrize("sq", [2, 8])
+    def test_uniform_q_starts_equal_default(self, sq):
+        """q_starts = kv_len - sq broadcast IS the uniform clamp: both
+        kernel and XLA must reproduce their default-path outputs, so the
+        ragged mode is a strict generalization, not a fork."""
+        b = 2
+        k, v = _case(b=b, max_len=96)
+        q = _rand(jax.random.PRNGKey(22), (b, sq, 4, 32), jnp.float32)
+        kv_len = jnp.asarray(57, jnp.int32)
+        starts = jnp.full((b,), 57 - sq, jnp.int32)
+        base_kw = dict(prompt_lengths=jnp.zeros(b, jnp.int32), prompt_width=0)
+        for fn in (
+            lambda **kw: decode_attention(q, k, v, kv_len, interpret=True, **kw),
+            lambda **kw: _xla(q, k, v, kv_len, **kw),
+        ):
+            default = fn(**base_kw)
+            ragged = fn(q_starts=starts, **base_kw)
+            np.testing.assert_allclose(
+                np.asarray(ragged), np.asarray(default), rtol=1e-6, atol=1e-6
+            )
+
+    @pytest.mark.parametrize("sq", [2, 6])
+    def test_int8_kv_ragged_positions(self, sq):
+        """int8-KV deferred dequant composes with the ragged-q mask."""
+        b = 3
+        k, v = _case(b=b, max_len=96)
+        kq, ksc = _quantize_kv(k)
+        vq, vsc = _quantize_kv(v)
+        q = _rand(jax.random.PRNGKey(23), (b, sq, 4, 32), jnp.float32)
+        starts = jnp.asarray([12, 40, 3], jnp.int32)
+        kv_len = jnp.max(starts) + sq
+        kw = dict(
+            prompt_lengths=jnp.zeros(b, jnp.int32), prompt_width=0,
+            q_starts=starts, k_scale=ksc, v_scale=vsc,
+        )
+        out = decode_attention(q, kq, vq, kv_len, interpret=True, **kw)
+        ref = _xla(q, kq, vq, kv_len, **kw)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+    def test_row_isolation_staggered_reuse(self):
+        """A deep slot's history must not leak into a shallow slot's
+        ragged-q output (staggered slot reuse: slot 1 is a fresh tenant at
+        cursor 4 while slot 0 sits at 60): poisoning keys above the
+        shallow row's window changes nothing for it."""
+        b, sq = 2, 3
+        k, v = _case(b=b, max_len=96)
+        q = _rand(jax.random.PRNGKey(24), (b, sq, 4, 32), jnp.float32)
+        starts = jnp.asarray([60, 4], jnp.int32)
+        kv_len = jnp.max(starts) + sq
+        kw = dict(
+            prompt_lengths=jnp.zeros(b, jnp.int32), prompt_width=0,
+            q_starts=starts,
+        )
+        out = decode_attention(q, k, v, kv_len, interpret=True, **kw)
+        # poison row 1's slots ABOVE its query window [0, 4+j] — stale
+        # rows a previous deeper tenant left behind
+        k2 = k.at[1, 10:].set(1e3)
+        v2 = v.at[1, 10:].set(1e3)
+        out2 = decode_attention(q, k2, v2, kv_len, interpret=True, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out[1]), np.asarray(out2[1]), rtol=1e-6, atol=1e-6
+        )
+        # and the XLA path agrees on the same invariant
+        ref2 = _xla(q, k2, v2, kv_len, **kw)
+        np.testing.assert_allclose(
+            np.asarray(out2), np.asarray(ref2), rtol=2e-5, atol=2e-5
+        )
+
+    def test_bad_q_starts_shape_rejected(self):
+        k, v = _case()
+        q = _rand(jax.random.PRNGKey(25), (2, 2, 4, 32), jnp.float32)
+        with pytest.raises(ValueError, match="q_starts"):
+            decode_attention(
+                q, k, v, jnp.asarray(8, jnp.int32),
+                q_starts=jnp.zeros(5, jnp.int32), interpret=True,
+            )
+
+
 class TestDispatch:
     def test_auto_stays_xla_off_tpu(self):
         """On the CPU mesh the auto dispatcher must not route into the

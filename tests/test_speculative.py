@@ -1,0 +1,920 @@
+"""Speculative multi-token decoding (tpu_nexus/serving/speculative.py).
+
+Layers, cheapest first:
+
+* pure units — the ``accept_tokens`` oracle, drafter proposal logic, and
+  the truncate/extend rollback primitives (slot + paged, audited by
+  ``verify_consistent``);
+* deterministic fake-executor bookkeeping fuzz — a Markov-1 fake model
+  whose greedy continuation is arithmetic, so hundreds of draft/accept/
+  rollback/slot-reuse scenarios run without compiling anything while the
+  accepted stream is still checked against a closed-form oracle;
+* real-model engine-vs-generate parity — the ISSUE 11 acceptance gate:
+  for every registered drafter (nxlint NX013) × {bf16, int8-KV} ×
+  {contiguous, paged} × {xla, pallas-interpret}, the speculative engine's
+  accepted streams are token-identical to one-shot greedy ``generate``;
+* chaos — step-hbm-oom DURING a verify dispatch retires exactly the
+  implicated request while survivors stay token-identical.
+
+Float caveat (the PR 6 precedent, documented in docs/SERVING.md): the
+q_len=k+1 verify is a different traced program than the q_len=1 scan, so
+bf16's reordered reductions can flip a NEAR-TIED argmax at long
+generation lengths — emitting the co-argmax, not a wrong token.  The
+bf16 matrices here run at the established parity scale; the long fuzz
+parity runs in f32, where the verify is exact across every length
+tested.
+"""
+
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.parallel.distributed import ProcessContext
+from tpu_nexus.serving import (
+    DRAFTERS,
+    BlockError,
+    KVSlotManager,
+    ModelDrafter,
+    ModelExecutor,
+    NGramDrafter,
+    PagedCacheManager,
+    PagedModelExecutor,
+    RequestState,
+    ServingEngine,
+    ServingMetrics,
+    SlotError,
+    accept_tokens,
+)
+from tpu_nexus.serving.cache_manager import SCRATCH_BLOCK
+from tpu_nexus.workload.faults import FaultyExecutor
+
+
+# -- the acceptance oracle -----------------------------------------------------
+
+
+class TestAcceptTokens:
+    def test_all_drafts_accepted_plus_bonus(self):
+        emitted, n_draft = accept_tokens([5, 6, 7], [5, 6, 7, 8], limit=10)
+        assert emitted == [5, 6, 7, 8]
+        assert n_draft == 3
+
+    def test_first_mismatch_emits_correction(self):
+        emitted, n_draft = accept_tokens([5, 9, 7], [5, 6, 7, 8], limit=10)
+        assert emitted == [5, 6]  # accepted 5, correction 6 — never 9
+        assert n_draft == 1
+
+    def test_no_drafts_accepted(self):
+        emitted, n_draft = accept_tokens([1, 2], [7, 8, 9], limit=10)
+        assert emitted == [7]
+        assert n_draft == 0
+
+    def test_limit_caps_emission_and_accepted_count(self):
+        # 3 drafts accepted + bonus would be 4 tokens; the budget says 2 —
+        # both emitted tokens came from the draft, so n_draft == 2
+        emitted, n_draft = accept_tokens([5, 6, 7], [5, 6, 7, 8], limit=2)
+        assert emitted == [5, 6]
+        assert n_draft == 2
+
+    def test_match_after_mismatch_never_counts(self):
+        emitted, n_draft = accept_tokens([9, 6], [5, 6, 7], limit=10)
+        assert emitted == [5]
+        assert n_draft == 0
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="limit"):
+            accept_tokens([1], [1, 2], limit=0)
+        with pytest.raises(ValueError, match="k\\+1"):
+            accept_tokens([1, 2], [1, 2], limit=5)
+
+    def test_emitted_is_always_the_greedy_stream(self):
+        """Property: whatever the drafts, emitted is a prefix of greedy —
+        the whole safety argument in one assert."""
+        rng = random.Random(0)
+        for _ in range(200):
+            k = rng.randint(1, 6)
+            greedy = [rng.randint(0, 9) for _ in range(k + 1)]
+            drafts = [rng.randint(0, 9) for _ in range(k)]
+            limit = rng.randint(1, k + 2)
+            emitted, n_draft = accept_tokens(drafts, greedy, limit)
+            assert emitted == greedy[: len(emitted)]
+            assert 1 <= len(emitted) <= min(k + 1, limit)
+            assert n_draft <= len(emitted)
+
+
+# -- drafters ------------------------------------------------------------------
+
+
+class TestNGramDrafter:
+    def test_lookup_finds_most_recent_continuation(self):
+        dr = NGramDrafter(1, max_ngram=3)
+        #      0  1  2  3  4  5  6  7
+        ctx = [1, 2, 3, 9, 1, 2, 3, 1]  # suffix [2,3,1]? no — suffix is [3,1]
+        # suffix tries n=3 [3,1]... actually tail n=3 = [2,3,1]: occurs? no.
+        # n=2 [3,1]: ctx[2:4]=[3,9] no; ctx[6:8]=[3,1] is the suffix itself;
+        # earlier: ctx[2:4]? -> scan finds [3,1] at i=2? [3,9] no. n=1 [1]:
+        # most recent earlier occurrence at i=4 -> continuation [2,3,1]
+        assert dr.lookup(ctx, 3) == [2, 3, 1]
+
+    def test_lookup_prefers_longer_ngram(self):
+        dr = NGramDrafter(1, max_ngram=2)
+        ctx = [7, 8, 5, 7, 8]
+        # n=2 tail [7,8] matches at 0 -> continuation [5, 7, 8][:k]
+        assert dr.lookup(ctx, 2) == [5, 7]
+
+    def test_lookup_no_match(self):
+        dr = NGramDrafter(1)
+        assert dr.lookup([1, 2, 3, 4], 3) == []
+
+    def test_propose_pads_with_last_token(self):
+        dr = NGramDrafter(2)
+        dr.begin(0, np.array([1, 2, 3, 4], np.int32))
+        out = dr.propose(np.array([4, 0], np.int32), np.zeros(2, np.int32), [0], 3)
+        assert out.shape == (2, 3)
+        assert list(out[0]) == [4, 4, 4]  # no recurrence -> weakest pad
+        assert list(out[1]) == [0, 0, 0]  # inactive slot untouched
+
+    def test_propose_predicts_runs(self):
+        dr = NGramDrafter(1)
+        dr.begin(0, np.array([9, 9, 9, 9], np.int32))
+        out = dr.propose(np.array([9], np.int32), np.zeros(1, np.int32), [0], 4)
+        assert list(out[0]) == [9, 9, 9, 9]
+
+    def test_out_of_sync_raises(self):
+        dr = NGramDrafter(1)
+        dr.begin(0, np.array([1, 2], np.int32))
+        with pytest.raises(RuntimeError, match="out of sync"):
+            dr.propose(np.array([7], np.int32), np.zeros(1, np.int32), [0], 2)
+
+    def test_lookup_respects_recency_window(self):
+        """The suffix search is bounded: a match OLDER than the window is
+        invisible (per-step host cost must not grow with generation
+        length), a recent one is found."""
+        dr = NGramDrafter(1, max_ngram=2, window=4)
+        ctx = [5, 6, 9] + [1, 2, 3, 4] * 3 + [5, 6]
+        # [5, 6] recurs only at the very start — outside the 4-token window
+        assert dr.lookup(ctx, 2) == []
+        wide = NGramDrafter(1, max_ngram=2, window=len(ctx))
+        assert wide.lookup(ctx, 1) == [9]
+        with pytest.raises(ValueError, match="window"):
+            NGramDrafter(1, window=0)
+
+    def test_retire_is_tolerant(self):
+        dr = NGramDrafter(2)
+        dr.retire(1)  # never began — a faulted begin must not explode here
+        dr.begin(0, np.array([1], np.int32))
+        dr.retire(0)
+        assert dr._ctx == {}
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="min_ngram"):
+            NGramDrafter(1, max_ngram=2, min_ngram=3)
+
+
+class RampDraftExecutor:
+    """Markov-1 stand-in draft model: next token = (t + 1) % V."""
+
+    temperature = 0.0
+
+    def __init__(self, num_slots=2, vocab=100):
+        self.num_slots = num_slots
+        self.vocab = vocab
+        self.begins = []
+        self.step_calls = 0
+
+    def begin(self, slot, prompt):
+        self.begins.append((slot, len(prompt)))
+        return (int(prompt[-1]) + 1) % self.vocab
+
+    def step(self, tokens, cursors):
+        self.step_calls += 1
+        return (np.asarray(tokens, np.int32) + 1) % self.vocab
+
+
+class TestModelDrafter:
+    def test_propose_is_the_draft_rollout(self):
+        ex = RampDraftExecutor()
+        dr = ModelDrafter(ex)
+        out = dr.propose(np.array([10, 20], np.int32), np.zeros(2, np.int32), [0, 1], 3)
+        assert out.tolist() == [[11, 12, 13], [21, 22, 23]]
+
+    def test_propose_runs_one_extra_write_step(self):
+        """k proposals cost k+1 draft steps: the final write-only step
+        lands d_k's KV so a full acceptance leaves no zero-KV hole (the
+        desync bug this drafter shipped without, caught by acceptance
+        collapsing from 1.0 to ~0.55 on a self-draft)."""
+        ex = RampDraftExecutor()
+        ModelDrafter(ex).propose(
+            np.array([1, 2], np.int32), np.zeros(2, np.int32), [0, 1], 4
+        )
+        assert ex.step_calls == 5
+
+    def test_begin_delegates_prefill(self):
+        ex = RampDraftExecutor()
+        dr = ModelDrafter(ex)
+        dr.begin(1, np.array([3, 4], np.int32))
+        assert ex.begins == [(1, 2)]
+
+    def test_sampling_draft_rejected(self):
+        class Hot(RampDraftExecutor):
+            temperature = 0.7
+
+        with pytest.raises(ValueError, match="greedy"):
+            ModelDrafter(Hot())
+
+    def test_registry_names_match_classes(self):
+        assert DRAFTERS == {"ngram": NGramDrafter, "model": ModelDrafter}
+        for name, cls in DRAFTERS.items():
+            assert cls.name == name
+
+
+# -- rollback primitives: truncate/extend --------------------------------------
+
+
+class TestSlotTruncate:
+    def test_set_length_truncate_roundtrip(self):
+        mgr = KVSlotManager(2, max_len=16)
+        slot = mgr.allocate("r1")
+        mgr.set_length(slot, 10)
+        assert mgr.length(slot) == 10
+        assert mgr.truncate(slot, 7) == 3
+        assert mgr.length(slot) == 7
+        mgr.verify_consistent()
+
+    def test_truncate_cannot_grow(self):
+        mgr = KVSlotManager(1, max_len=16)
+        slot = mgr.allocate("r1")
+        mgr.set_length(slot, 4)
+        with pytest.raises(SlotError, match="only shrink"):
+            mgr.truncate(slot, 5)
+
+    def test_truncate_needs_recorded_length(self):
+        mgr = KVSlotManager(1, max_len=16)
+        slot = mgr.allocate("r1")
+        with pytest.raises(SlotError, match="no recorded length"):
+            mgr.truncate(slot, 2)
+
+    def test_unallocated_slot_rejected(self):
+        mgr = KVSlotManager(1, max_len=16)
+        with pytest.raises(SlotError, match="unallocated"):
+            mgr.set_length(0, 2)
+        with pytest.raises(SlotError, match="unallocated"):
+            mgr.truncate(0, 1)
+
+    def test_free_drops_length(self):
+        mgr = KVSlotManager(1, max_len=16)
+        slot = mgr.allocate("r1")
+        mgr.set_length(slot, 9)
+        mgr.free(slot)
+        mgr.verify_consistent()
+        slot2 = mgr.allocate("r2")
+        assert mgr.length(slot2) is None
+
+    def test_verify_consistent_catches_stray_length(self):
+        mgr = KVSlotManager(2, max_len=16)
+        slot = mgr.allocate("r1")
+        mgr.set_length(slot, 4)
+        mgr._len[1] = 3  # corrupt: length for a free slot
+        with pytest.raises(SlotError, match="unowned"):
+            mgr.verify_consistent()
+
+
+class TestPagedTruncate:
+    def _admitted(self, total_len=12, page_size=4, num_blocks=16):
+        paged = PagedCacheManager(num_blocks, page_size, max_len=total_len)
+        plan = paged.admit("r1", list(range(100, 104)), total_len)
+        return paged, plan
+
+    def test_truncate_releases_tail_and_credits(self):
+        paged, plan = self._admitted()  # 3 blocks for 12 tokens
+        free_before = paged.manager.free_count
+        released = paged.truncate("r1", 5)  # keep ceil(5/4)=2 blocks
+        assert released == [plan.block_row[2]]
+        assert paged.manager.free_count == free_before + 1
+        # pool-neutral: the released block is earmarked for regrowth
+        assert paged.manager.reserved_total == 1
+        paged.verify_consistent()
+
+    def test_extend_regrows_from_credits(self):
+        paged, plan = self._admitted()
+        paged.truncate("r1", 5)
+        grown = paged.extend("r1", 12)
+        assert [logical for logical, _ in grown] == [2]
+        assert paged.manager.reserved_total == 0
+        assert len(paged.manager.request_blocks("r1")) == 3
+        paged.verify_consistent()
+
+    def test_extend_noop_when_covered(self):
+        paged, _ = self._admitted()
+        assert paged.extend("r1", 12) == []
+
+    def test_truncate_noop_within_coverage(self):
+        paged, _ = self._admitted()
+        assert paged.truncate("r1", 12) == []
+        assert paged.truncate("r1", 9) == []  # same block count
+
+    def test_reclaim_past_credits_raises(self):
+        paged, _ = self._admitted()
+        with pytest.raises(BlockError, match="reservation credits"):
+            paged.manager.reclaim("r1", 1)
+
+    def test_truncate_refuses_shared_blocks(self):
+        """An indexed (prefix-cached) block must never roll back: truncate
+        below the prompt region is an engine bug surfaced loudly."""
+        paged, plan = self._admitted()
+        paged.register_prompt("r1", list(range(100, 104)), plan.block_row)
+        with pytest.raises(BlockError, match="shared/indexed"):
+            paged.manager.truncate_request("r1", 0)
+
+    def test_release_request_drops_outstanding_credits(self):
+        paged, _ = self._admitted()
+        paged.truncate("r1", 5)
+        paged.release("r1")
+        assert paged.manager.reserved_total == 0
+        assert paged.manager.free_count == paged.manager.usable
+        paged.verify_consistent()
+
+    def test_can_admit_is_pool_neutral_across_truncate(self):
+        """Truncate credits must not let a NEW admission overcommit: the
+        freed blocks are spoken for."""
+        paged = PagedCacheManager(7, 4, max_len=16)  # 6 usable blocks
+        paged.admit("r1", list(range(100, 104)), 16)  # takes 4
+        fits_before = paged.can_admit(list(range(200, 204)), 16)
+        paged.truncate("r1", 5)  # frees 2 blocks, reserves 2 credits
+        assert paged.can_admit(list(range(200, 204)), 16) == fits_before
+        paged.verify_consistent()
+
+
+# -- deterministic fake-executor engine fuzz -----------------------------------
+
+
+class FakeSpecExecutor:
+    """Markov-1 fake model for engine bookkeeping: greedy continuation of
+    token t is (t + 1) % vocab, so the expected output of any request is a
+    closed-form ramp.  verify() honors the contract exactly: greedy row j
+    is the continuation of whatever token sits at row j of the scored
+    block — so wrong drafts provoke real rejections."""
+
+    temperature = 0.0
+
+    def __init__(self, num_slots, max_len, vocab=97, page_size=0, num_blocks=0):
+        self.num_slots = num_slots
+        self.max_len = max_len
+        self.vocab = vocab
+        if page_size:
+            self.page_size = page_size
+            self.num_blocks = num_blocks or (
+                1 + num_slots * (-(-max_len // page_size))
+            )
+            self.prefilled_tokens = 0
+
+    def begin(self, slot, prompt, **kwargs):
+        if kwargs and hasattr(self, "prefilled_tokens"):
+            self.prefilled_tokens += len(prompt) - kwargs.get("tail_start", 0)
+        return (int(np.asarray(prompt).reshape(-1)[-1]) + 1) % self.vocab
+
+    def step(self, tokens, cursors, *args):
+        return (np.asarray(tokens, np.int32) + 1) % self.vocab
+
+    def verify(self, tokens, cursors, drafts, *args):
+        block = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None], np.asarray(drafts, np.int32)],
+            axis=1,
+        )
+        return (block + 1) % self.vocab
+
+
+class WrongSometimesDrafter(NGramDrafter):
+    """Seeded drafter that corrupts a random fraction of its proposals —
+    exercises every acceptance length m in [0, k] against the fake."""
+
+    def __init__(self, num_slots, seed, wrong_p=0.4):
+        super().__init__(num_slots)
+        self._rng = random.Random(seed)
+        self._wrong_p = wrong_p
+
+    def propose(self, tokens, cursors, slots, k):
+        out = np.zeros((self.num_slots, k), np.int32)
+        for slot in slots:
+            t = int(tokens[slot])
+            for j in range(k):
+                t = (t + 1) % 97
+                if self._rng.random() < self._wrong_p:
+                    out[slot, j] = (t + 13) % 97  # deliberately wrong
+                else:
+                    out[slot, j] = t
+        return out
+
+
+def _fuzz_spec_one(seed: int):
+    rng = random.Random(seed)
+    num_slots = rng.randint(1, 4)
+    paged = rng.random() < 0.5
+    page_size = rng.choice([2, 4]) if paged else 0
+    max_len = 48
+    k = rng.randint(1, 5)
+    ex = FakeSpecExecutor(num_slots, max_len, page_size=page_size)
+    eng = ServingEngine(
+        ex, spec_k=k, drafter=WrongSometimesDrafter(num_slots, seed)
+    )
+    n_requests = rng.randint(1, 10)
+    reqs = []
+    for i in range(n_requests):
+        plen = rng.randint(1, 8)
+        gen = rng.randint(1, max_len - plen)
+        prompt = np.asarray([rng.randint(0, 96) for _ in range(plen)], np.int32)
+        reqs.append((eng.submit(prompt, gen, request_id=f"f{i}"), prompt, gen))
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        eng.slots.verify_consistent()
+        if eng.paged is not None:
+            eng.paged.verify_consistent()
+        assert steps < 10_000, "fuzz engine failed to drain"
+    for req, prompt, gen in reqs:
+        assert req.state == RequestState.FINISHED
+        expect = [(int(prompt[-1]) + 1 + j) % 97 for j in range(gen)]
+        assert req.output_tokens == expect, (
+            f"seed {seed}: accepted stream diverged from the fake's greedy"
+        )
+    # no leak: every slot free, every block back (no cached prefixes here:
+    # the fake registers prompts, so allow index-held blocks)
+    assert eng.slots.free_count == num_slots
+    if eng.paged is not None:
+        for req, _, _ in reqs:
+            assert not eng.paged.owns(req.request_id)
+
+
+def test_spec_fuzz_quick():
+    """25-seed speculative engine fuzz (ISSUE 11): no slot/block leak,
+    allocator+trie audits after EVERY step, terminal totality, and the
+    accepted stream equals the fake model's closed-form greedy ramp for
+    every request — across ks, paged/contiguous, slot reuse, and a
+    drafter that is wrong ~40% of the time."""
+    for seed in range(25):
+        _fuzz_spec_one(seed)
+
+
+@pytest.mark.slow
+def test_spec_fuzz_deep():
+    for seed in range(25, 200):
+        _fuzz_spec_one(seed)
+
+
+# -- engine config validation --------------------------------------------------
+
+
+class TestSpecEngineConfig:
+    def test_spec_k_requires_drafter(self):
+        with pytest.raises(ValueError, match="drafter"):
+            ServingEngine(FakeSpecExecutor(1, 16), spec_k=2)
+
+    def test_drafter_requires_spec_k(self):
+        with pytest.raises(ValueError, match="spec_k"):
+            ServingEngine(FakeSpecExecutor(1, 16), drafter=NGramDrafter(1))
+
+    def test_spec_k_bounded_by_verify_width(self):
+        from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
+
+        with pytest.raises(ValueError, match="verify"):
+            ServingEngine(
+                FakeSpecExecutor(1, 16),
+                spec_k=MAX_DECODE_Q_LEN, drafter=NGramDrafter(1),
+            )
+
+    def test_sampling_executor_rejected(self):
+        class Hot(FakeSpecExecutor):
+            temperature = 0.5
+
+        with pytest.raises(ValueError, match="greedy-only"):
+            ServingEngine(Hot(1, 16), spec_k=2, drafter=NGramDrafter(1))
+
+    def test_negative_spec_k_rejected(self):
+        with pytest.raises(ValueError, match=">= 0"):
+            ServingEngine(FakeSpecExecutor(1, 16), spec_k=-1)
+
+
+class TestServeConfigSpec:
+    def _cfg(self, **kw):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        return ServeConfig(model=LlamaConfig.tiny(), **kw)
+
+    def test_spec_env_parses(self):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        cfg = ServeConfig.from_env(
+            {"NEXUS_SPEC_K": "3", "NEXUS_SPEC_DRAFTER": "model"}
+        )
+        assert cfg.spec_k == 3 and cfg.spec_drafter == "model"
+
+    def test_speculation_is_greedy_only_at_parse(self):
+        with pytest.raises(ValueError, match="greedy-only"):
+            self._cfg(spec_k=2, temperature=0.5)
+
+    def test_unknown_drafter_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="spec_drafter"):
+            self._cfg(spec_k=2, spec_drafter="medusa")
+
+    def test_spec_k_width_rejected_at_parse(self):
+        with pytest.raises(ValueError, match="verify width"):
+            self._cfg(spec_k=8)
+
+    def test_draft_preset_needs_model_drafter(self):
+        with pytest.raises(ValueError, match="spec_draft_preset"):
+            self._cfg(spec_k=2, spec_drafter="ngram", spec_draft_preset="tiny")
+
+    def test_spec_off_ignores_drafter_field(self):
+        cfg = self._cfg(spec_k=0, spec_drafter="ngram")
+        assert cfg.spec_k == 0
+
+
+class TestSpecCostAccounting:
+    def test_model_drafter_charges_draft_prefill(self):
+        """A prefilling drafter doubles each head's budget price: with a
+        budget of one prompt, the second admission (which would fit under
+        target-only pricing) must wait for the next step."""
+        from tpu_nexus.serving import FifoScheduler, SchedulerConfig
+
+        ex = FakeSpecExecutor(3, 32)
+        dr = ModelDrafter(RampDraftExecutor(num_slots=3))
+        eng = ServingEngine(
+            ex,
+            scheduler=FifoScheduler(SchedulerConfig(prefill_token_budget=9)),
+            spec_k=2,
+            drafter=dr,
+        )
+        for i in range(3):
+            eng.submit(np.full(4, 7 + i, np.int32), 4, request_id=f"c{i}")
+        # cost per head = 4 (target) + 4 (draft prefill) = 8; budget 9
+        # admits the floor head + nothing else (8 + 8 > 9)
+        counts = eng.step()
+        assert counts["admitted"] == 1
+        counts = eng.step()
+        assert counts["admitted"] == 1
+
+    def test_ngram_drafter_keeps_target_only_pricing(self):
+        from tpu_nexus.serving import FifoScheduler, SchedulerConfig
+
+        ex = FakeSpecExecutor(3, 32)
+        eng = ServingEngine(
+            ex,
+            scheduler=FifoScheduler(SchedulerConfig(prefill_token_budget=9)),
+            spec_k=2,
+            drafter=NGramDrafter(3),
+        )
+        for i in range(3):
+            eng.submit(np.full(4, 7 + i, np.int32), 4, request_id=f"c{i}")
+        assert not NGramDrafter.prefills_prompt
+        counts = eng.step()
+        assert counts["admitted"] == 2  # 4 + 4 <= 9; the third breaks it
+
+
+# -- metrics -------------------------------------------------------------------
+
+
+class TestSpecMetrics:
+    def test_accepted_not_proposed_counts_in_tokens_and_tpot(self):
+        m = ServingMetrics()
+        m.spec_verify(proposed=4, accepted=2)
+        m.spec_tokens(0.09, 3)  # 2 accepted drafts + correction
+        assert m.tokens_out == 3
+        assert m.spec_proposed == 4 and m.spec_accepted == 2
+        # mean-preserving spread: three samples of dt/3
+        assert m.tpot_s == pytest.approx([0.03, 0.03, 0.03])
+        s = m.summary()
+        assert s["spec_acceptance_rate"] == pytest.approx(0.5)
+
+    def test_first_batch_has_no_tpot_sample(self):
+        m = ServingMetrics()
+        m.spec_tokens(None, 2)
+        assert m.tokens_out == 2 and m.tpot_s == []
+
+    def test_rollback_blocks_counter(self):
+        m = ServingMetrics()
+        m.spec_rollback_blocks(3)
+        m.spec_rollback_blocks(1)
+        assert m.summary()["spec_rollback_blocks"] == 4
+
+
+# -- chaos: faults during verify -----------------------------------------------
+
+
+class TestVerifyChaos:
+    def test_faulty_executor_passes_verify_through(self):
+        """wrap_executor's verify seam: drafts + paged operands ride
+        through unchanged, and verify counts on the SAME step counter as
+        step() so NEXUS_FAULT_STEP targets decode dispatch N either way."""
+        inner = FakeSpecExecutor(2, 32, page_size=4)
+        faulty = FaultyExecutor(inner, "step-hbm-oom", at_step=2)
+        drafts = np.array([[1, 2], [3, 4]], np.int32)
+        tables = np.zeros((2, 8), np.int32)
+        out = faulty.verify(
+            np.array([5, 6], np.int32), np.array([1, 1], np.int32), drafts, tables
+        )
+        assert out.shape == (2, 3)
+        faulty.step(np.array([5, 6], np.int32), np.array([1, 1], np.int32))
+        assert faulty.step_calls == 2
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            faulty.verify(
+                np.array([5, 6], np.int32), np.array([1, 1], np.int32),
+                drafts, tables,
+            )
+        assert faulty.injected == 1
+
+    def test_hbm_oom_during_verify_retires_implicated_only(self):
+        """step-hbm-oom firing INSIDE a speculative verify dispatch: the
+        youngest admission retires FAILED (cause hbm-oom), survivors keep
+        decoding and their accepted streams stay token-identical to the
+        fake's greedy ramp."""
+        ex = FakeSpecExecutor(2, 32)
+        faulty = FaultyExecutor(ex, "step-hbm-oom", at_step=1)
+        eng = ServingEngine(faulty, spec_k=2, drafter=NGramDrafter(2))
+        a = eng.submit(np.array([10, 11], np.int32), 8, request_id="a")
+        eng.step()  # admits a, verify 0 ok
+        b = eng.submit(np.array([50], np.int32), 8, request_id="b")
+        eng.step()  # admits b; verify 1 faults -> youngest (b) retires
+        assert b.state == RequestState.FAILED and b.cause == "hbm-oom"
+        eng.run_until_drained(max_steps=100)
+        assert a.state == RequestState.FINISHED
+        assert a.output_tokens == [(12 + j) % 97 for j in range(8)]
+        assert eng.metrics.step_faults == {"hbm-oom": 1}
+        eng.slots.verify_consistent()
+
+
+class FaultyDrafter(NGramDrafter):
+    """Drafter whose device half dies: propose always raises, begin
+    raises after the first call — the draft-side fault drill."""
+
+    def __init__(self, num_slots, fail_begin=False):
+        super().__init__(num_slots)
+        self._fail_begin = fail_begin
+
+    def begin(self, slot, prompt):
+        if self._fail_begin:
+            raise RuntimeError("draft prefill: RESOURCE_EXHAUSTED on draft chip")
+        super().begin(slot, prompt)
+
+    def propose(self, tokens, cursors, slots, k):
+        raise RuntimeError("draft step: device wedged")
+
+
+class TestDraftFaultIsolation:
+    """Drafts are HINTS: a draft-side device fault must cost acceptance,
+    never a request — the engine's documented one-fault-one-request
+    contract covers the TARGET executor only, and the drafter sits
+    outside it behind the _propose_safe degradation boundary."""
+
+    def test_propose_fault_degrades_to_no_drafts(self):
+        ex = FakeSpecExecutor(2, 32)
+        eng = ServingEngine(ex, spec_k=3, drafter=FaultyDrafter(2))
+        reqs = [
+            eng.submit(np.array([10 + i], np.int32), 6, request_id=f"d{i}")
+            for i in range(2)
+        ]
+        eng.run_until_drained(max_steps=100)
+        for i, req in enumerate(reqs):
+            assert req.state == RequestState.FINISHED
+            assert req.output_tokens == [(11 + i + j) % 97 for j in range(6)]
+        assert eng.metrics.draft_faults > 0
+        assert eng.metrics.summary()["draft_faults"] == eng.metrics.draft_faults
+        # zero drafts still emit >= 1 token/step: acceptance 0, not 0 tokens
+        assert eng.metrics.spec_accepted == 0
+
+    def test_begin_fault_keeps_the_admission(self):
+        ex = FakeSpecExecutor(1, 32)
+        dr = FaultyDrafter(1, fail_begin=True)
+        dr.propose = lambda tokens, cursors, slots, k: np.zeros(
+            (1, k), np.int32
+        )
+        eng = ServingEngine(ex, spec_k=2, drafter=dr)
+        req = eng.submit(np.array([40], np.int32), 4, request_id="b0")
+        eng.run_until_drained(max_steps=100)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == [(41 + j) % 97 for j in range(4)]
+        assert eng.metrics.draft_faults >= 1
+
+
+# -- real-model parity: the acceptance gate ------------------------------------
+
+
+def _interpret_works() -> bool:
+    from tpu_nexus.ops.decode_attention import decode_attention
+
+    try:
+        q = jnp.ones((1, 2, 2, 8), jnp.float32)
+        kv = jnp.ones((1, 16, 2, 8), jnp.float32)
+        decode_attention(
+            q, kv, kv, jnp.asarray(4, jnp.int32),
+            q_starts=jnp.asarray([2], jnp.int32), interpret=True,
+        )
+        return True
+    except Exception:  # noqa: BLE001 - any interpreter failure means "skip env"
+        return False
+
+
+_CAN_INTERPRET = _interpret_works()
+
+CFG = LlamaConfig.tiny()
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+# the pallas matrix runs in f32 — same precedent as the paged parity
+# matrix (PR 6): interpreted-kernel reduction order at bf16 can flip a
+# near-tied argmax vs the XLA path; f32 is exact
+CFG32 = dataclasses.replace(CFG, dtype=jnp.float32)
+PARAMS32 = llama_init(jax.random.PRNGKey(0), CFG32)
+
+
+def _kernels():
+    yield "xla"
+    if _CAN_INTERPRET:
+        yield "pallas"
+
+
+def _make_drafter(name, params, cfg, num_slots, max_len):
+    if name == "ngram":
+        return NGramDrafter(num_slots)
+    return ModelDrafter(
+        ModelExecutor(params, cfg, num_slots=num_slots, max_len=max_len)
+    )
+
+
+@pytest.mark.parametrize("drafter_name", sorted(DRAFTERS))
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("paged", [False, True])
+@pytest.mark.parametrize("kernel", list(_kernels()))
+def test_spec_engine_matches_generate(drafter_name, kv_quant, paged, kernel):
+    """The ISSUE 11 token-identity gate: for every registered drafter ×
+    {bf16, int8-KV} × {contiguous, paged} × {xla, pallas-interpret}, the
+    speculative engine's accepted streams equal one-shot greedy
+    ``generate`` exactly."""
+    params, cfg = (PARAMS32, CFG32) if kernel == "pallas" else (PARAMS, CFG)
+    B, S, T, K = 3, 8, 9, 3
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(1, cfg.vocab_size, size=(B, S)).astype(np.int32)
+    ref = np.asarray(
+        generate(
+            params, jnp.asarray(prompts), cfg,
+            max_new_tokens=T, max_len=S + T,
+            kv_quant=kv_quant, decode_kernel=kernel,
+        )
+    )
+    kwargs = dict(
+        num_slots=B, max_len=S + T, kv_quant=kv_quant, decode_kernel=kernel
+    )
+    if paged:
+        executor = PagedModelExecutor(params, cfg, page_size=4, **kwargs)
+    else:
+        executor = ModelExecutor(params, cfg, **kwargs)
+    eng = ServingEngine(
+        executor,
+        spec_k=K,
+        drafter=_make_drafter(drafter_name, params, cfg, B, S + T),
+    )
+    reqs = [eng.submit(prompts[i], T) for i in range(B)]
+    eng.run_until_drained(max_steps=1000)
+    out = np.stack([np.asarray(r.output_tokens) for r in reqs])
+    np.testing.assert_array_equal(out, ref)
+    eng.slots.verify_consistent()
+    if eng.paged is not None:
+        eng.paged.verify_consistent()
+    # the verify ran multi-query: every slot proposed K per step
+    assert eng.metrics.spec_proposed > 0
+
+
+def test_self_draft_accepts_everything():
+    """'model' drafter with the TARGET's own params: every draft matches
+    the verify argmax, so throughput is the full k+1 tokens per step —
+    the acceptance-rate plumbing proven at its fixed point."""
+    B, S, T, K = 2, 8, 9, 3
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, CFG.vocab_size, size=(B, S)).astype(np.int32)
+    ex = ModelExecutor(PARAMS, CFG, num_slots=B, max_len=S + T)
+    dr = ModelDrafter(ModelExecutor(PARAMS, CFG, num_slots=B, max_len=S + T))
+    eng = ServingEngine(ex, spec_k=K, drafter=dr)
+    reqs = [eng.submit(prompts[i], T) for i in range(B)]
+    eng.run_until_drained(max_steps=100)
+    s = eng.metrics.summary()
+    assert s["spec_acceptance_rate"] == pytest.approx(1.0)
+    # T=9 tokens at 4/step (3 drafts + bonus): ceil((9-1)/4)=2 decode steps
+    assert eng.steps <= 4
+    ref = np.asarray(
+        generate(PARAMS, jnp.asarray(prompts), CFG, max_new_tokens=T, max_len=S + T)
+    )
+    out = np.stack([np.asarray(r.output_tokens) for r in reqs])
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_staggered_slot_reuse_matches_solo_generate():
+    """num_slots < requests under speculation: ragged per-slot verify
+    positions + slot refill mid-flight change nothing about any single
+    request's accepted stream."""
+    S, T, N, K = 8, 7, 5, 2
+    rng = np.random.default_rng(11)
+    prompts = rng.integers(1, CFG.vocab_size, size=(N, S)).astype(np.int32)
+    executor = ModelExecutor(PARAMS, CFG, num_slots=2, max_len=S + T)
+    eng = ServingEngine(executor, spec_k=K, drafter=NGramDrafter(2))
+    reqs = [eng.submit(prompts[i], T) for i in range(N)]
+    eng.run_until_drained(max_steps=1000)
+    for i, req in enumerate(reqs):
+        solo = np.asarray(
+            generate(
+                PARAMS, jnp.asarray(prompts[i : i + 1]), CFG,
+                max_new_tokens=T, max_len=S + T,
+            )
+        )[0]
+        np.testing.assert_array_equal(np.asarray(req.output_tokens), solo)
+
+
+def test_paged_rollback_released_blocks_are_regrown():
+    """Paged speculation must cycle truncate -> extend without leaking:
+    drive a request whose drafts are usually wrong so rollback constantly
+    strands tail blocks, then audit every step."""
+    S, T, K = 4, 12, 3
+    prompt = np.arange(1, S + 1, dtype=np.int32)
+    ex = FakeSpecExecutor(1, S + T, page_size=2)
+    eng = ServingEngine(ex, spec_k=K, drafter=WrongSometimesDrafter(1, 5, 0.7))
+    req = eng.submit(prompt, T, request_id="roll")
+    while eng.has_work:
+        eng.step()
+        eng.paged.verify_consistent()
+        eng.slots.verify_consistent()
+    assert req.state == RequestState.FINISHED
+    assert req.output_tokens == [(int(prompt[-1]) + 1 + j) % 97 for j in range(T)]
+    assert eng.metrics.spec_rollback_blocks_total > 0
+    assert not eng.paged.owns("roll")
+
+
+@pytest.mark.slow
+def test_spec_fuzz_real_model_f32():
+    """Real-model speculative fuzz (f32 — exact across lengths): random
+    prompts/budgets/ks, accepted == one-shot generate for every request."""
+    for seed in range(6):
+        rng = np.random.default_rng(seed)
+        B, S = 2, 6
+        T = int(rng.integers(4, 16))
+        K = int(rng.integers(1, 5))
+        prompts = rng.integers(1, CFG32.vocab_size, size=(B, S)).astype(np.int32)
+        ex = ModelExecutor(PARAMS32, CFG32, num_slots=B, max_len=S + T)
+        eng = ServingEngine(ex, spec_k=K, drafter=NGramDrafter(B))
+        reqs = [eng.submit(prompts[i], T) for i in range(B)]
+        eng.run_until_drained(max_steps=1000)
+        ref = np.asarray(
+            generate(
+                PARAMS32, jnp.asarray(prompts), CFG32,
+                max_new_tokens=T, max_len=S + T,
+            )
+        )
+        out = np.stack([np.asarray(r.output_tokens) for r in reqs])
+        np.testing.assert_array_equal(out, ref)
+
+
+# -- serve loop wiring ---------------------------------------------------------
+
+
+CTX = ProcessContext(
+    run_id="spec-1", algorithm="llama-spec", process_id=0, num_processes=1,
+    coordinator=None,
+)
+
+
+def _seeded_store():
+    store = InMemoryCheckpointStore()
+    store.upsert_checkpoint(
+        CheckpointedRequest(
+            algorithm=CTX.algorithm, id=CTX.run_id,
+            lifecycle_stage=LifecycleStage.BUFFERED,
+        )
+    )
+    return store
+
+
+@pytest.mark.parametrize("drafter_name", sorted(DRAFTERS))
+def test_serve_engine_spec_ledger_protocol(drafter_name):
+    """NEXUS_SPEC_K > 0 routes run_serve_engine through the speculative
+    decode loop under the identical ledger contract, for both registered
+    drafters; spec counters surface in the summary."""
+    from tpu_nexus.workload.serve import ServeConfig, run_serve_engine
+
+    store = _seeded_store()
+    cfg = ServeConfig(
+        model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+        gen_tokens=6, rounds=2, heartbeat_every=2,
+        spec_k=2, spec_drafter=drafter_name,
+    )
+    summary = run_serve_engine(cfg, store=store, ctx=CTX)
+    row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+    assert row.lifecycle_stage == LifecycleStage.COMPLETED
+    assert summary["finished"] == summary["requests"] == 4
+    assert summary["spec_k"] == 2
+    assert summary["spec_proposed"] > 0
+    if drafter_name == "model":  # self-draft: acceptance ~1 by construction
+        assert summary["spec_acceptance_rate"] > 0.9

@@ -1219,6 +1219,100 @@ def test_nx007_class_body_publish_flagged():
     assert len(findings) == 1 and "durability barrier" in findings[0].message
 
 
+# -- NX013 drafter parity coverage ----------------------------------------------
+
+SPEC_SRC = """
+DRAFTERS = {
+    "ngram": NGramDrafter,
+    "model": ModelDrafter,
+}
+"""
+
+
+def _spec_project(tmp_path, spec_src=SPEC_SRC, tests=None):
+    pkg = tmp_path / "pkg" / "serving"
+    pkg.mkdir(parents=True)
+    (pkg / "speculative.py").write_text(textwrap.dedent(spec_src))
+    if tests is not None:
+        tests_dir = tmp_path / "tests"
+        tests_dir.mkdir()
+        for name, src in tests.items():
+            (tests_dir / name).write_text(textwrap.dedent(src))
+    rules = [r for r in all_rules() if r.rule_id == "NX013"]
+    return lint_paths([str(tmp_path / "pkg")], root=str(tmp_path), rules=rules)
+
+
+def test_nx013_collects_literal_registry_keys():
+    import ast as _ast
+
+    from tools.nxlint.rules_serving import registered_drafters
+
+    assert set(registered_drafters(_ast.parse(textwrap.dedent(SPEC_SRC)))) == {
+        "ngram",
+        "model",
+    }
+    # annotated assignment shape too (the shipped registry is annotated)
+    annotated = "DRAFTERS: dict = {'lookup': X}\n"
+    assert set(registered_drafters(_ast.parse(annotated))) == {"lookup"}
+
+
+def test_nx013_fully_tested_registry_passes(tmp_path):
+    findings = _spec_project(
+        tmp_path,
+        tests={
+            "test_spec.py": """
+            def test_parity():
+                run("ngram"); run('model')
+            """,
+        },
+    )
+    assert findings == []
+
+
+def test_nx013_untested_drafter_flagged(tmp_path):
+    findings = _spec_project(
+        tmp_path, tests={"test_spec.py": 'NAMES = ["ngram"]\n'}
+    )
+    assert [f.rule_id for f in findings] == ["NX013"]
+    assert "'model'" in findings[0].message
+    assert "parity test" in findings[0].message
+
+
+def test_nx013_missing_tests_dir_fails_closed(tmp_path):
+    findings = _spec_project(tmp_path, tests=None)
+    assert [f.rule_id for f in findings] == ["NX013"]
+    assert "no test files found" in findings[0].message
+
+
+def test_nx013_unrecognizable_registry_fails_closed(tmp_path):
+    findings = _spec_project(
+        tmp_path,
+        spec_src="DRAFTERS = build_registry()\n",
+        tests={"test_spec.py": "pass\n"},
+    )
+    assert [f.rule_id for f in findings] == ["NX013"]
+    assert "fails closed" in findings[0].message
+
+
+def test_nx013_non_literal_keys_fail_closed(tmp_path):
+    """Computed keys defeat the AST read — the registry contract says
+    literal keys, so a computed one must surface, not silently pass."""
+    findings = _spec_project(
+        tmp_path,
+        spec_src="DRAFTERS = {NGramDrafter.name: NGramDrafter}\n",
+        tests={"test_spec.py": "run('ngram')\n"},
+    )
+    assert [f.rule_id for f in findings] == ["NX013"]
+
+
+def test_nx013_absent_module_out_of_scope(tmp_path):
+    pkg = tmp_path / "other"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text("x = 1\n")
+    rules = [r for r in all_rules() if r.rule_id == "NX013"]
+    assert lint_paths([str(pkg)], root=str(tmp_path), rules=rules) == []
+
+
 # -- NX008 params hot-swap discipline -------------------------------------------
 
 

@@ -39,6 +39,8 @@ REQUIRED = [
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates
     "tpu_nexus/serving/recovery.py",
+    "tpu_nexus/serving/speculative.py",         # drafting + verify-k acceptance
+
     "tpu_nexus/supervisor/taxonomy.py",
 ]
 

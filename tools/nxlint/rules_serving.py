@@ -4,6 +4,10 @@ retirement path depends on.
 NX005  request-state totality (serving/request.py + serving/engine.py)
 NX006  serving except discipline: every handler re-raises, classifies
        through supervisor.taxonomy, or carries a BLE001 justification
+NX013  drafter parity coverage: every Drafter registered in
+       serving/speculative.py DRAFTERS must be named by a test under
+       tests/ (the NX009 fails-closed pattern — an undrilled drafter is
+       an unproven acceptance oracle)
 """
 
 from __future__ import annotations
@@ -342,3 +346,91 @@ class ServingExceptDisciplineRule(Rule):
         visitor = _ServingExceptVisitor(self, module)
         visitor.visit(module.tree)
         yield from visitor.findings
+
+
+# -- NX013: drafter parity coverage --------------------------------------------
+
+SPECULATIVE_PATH = "serving/speculative.py"
+DRAFTER_REGISTRY = "DRAFTERS"
+
+
+def registered_drafters(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Drafter name -> the AST node declaring it: string keys of the
+    module-level ``DRAFTERS`` dict literal (possibly annotated).  Non-
+    literal keys are deliberately NOT resolved — the registry's contract
+    (documented at the assignment) is literal keys precisely so this rule
+    can read it as plain AST."""
+    drafters: Dict[str, ast.AST] = {}
+    for stmt in tree.body:
+        value = None
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == DRAFTER_REGISTRY for t in stmt.targets
+        ):
+            value = stmt.value
+        elif (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and stmt.target.id == DRAFTER_REGISTRY
+        ):
+            value = stmt.value
+        if isinstance(value, ast.Dict):
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    drafters.setdefault(key.value, key)
+    return drafters
+
+
+@register
+class DrafterParityRule(Rule):
+    """NX013: a registered drafter nobody tests is an acceptance oracle
+    nobody has proven.  The speculative engine's safety argument is
+    "accepted stream == one-shot greedy generate" — per DRAFTER, because
+    each drafter exercises a different acceptance/rollback pattern (ngram
+    pads weak guesses, a model drafter replays its own cache) — so every
+    ``DRAFTERS`` entry in serving/speculative.py must be named by at
+    least one test under tests/.  Literal-string approximation and
+    fails-closed semantics exactly mirror NX009 (rules_faults.py): an
+    unrecognizable registry shape or a missing tests/ directory is itself
+    a finding."""
+
+    rule_id = "NX013"
+    description = "every registered Drafter must be named by a test under tests/"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        import os
+
+        from tools.nxlint.rules_faults import TESTS_DIR, _test_corpus
+
+        module = project.find_module(SPECULATIVE_PATH)
+        if module is None or module.tree is None:
+            return  # project doesn't contain the serving tree (tools subtree)
+        drafters = registered_drafters(module.tree)
+        if not drafters:
+            yield self.finding(
+                module,
+                module.tree,
+                f"no {DRAFTER_REGISTRY} registry found in {module.rel_path} "
+                "— the drafter extraction no longer matches the registry "
+                "shape (rule fails closed; fix registered_drafters)",
+            )
+            return
+        corpus = _test_corpus(project.root)
+        if corpus is None:
+            yield self.finding(
+                module,
+                module.tree,
+                f"no test files found under {os.path.join(project.root, TESTS_DIR)} "
+                "— drafter parity coverage unverifiable (rule fails closed)",
+            )
+            return
+        for name in sorted(drafters):
+            if f'"{name}"' in corpus or f"'{name}'" in corpus:
+                continue
+            yield self.finding(
+                module,
+                drafters[name],
+                f"drafter '{name}' is registered but no test under "
+                f"{TESTS_DIR}/ names it — add a parity test (accepted "
+                "stream must equal one-shot greedy generate) exercising "
+                "the drafter",
+            )

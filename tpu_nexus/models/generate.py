@@ -127,6 +127,7 @@ def cached_attention(
     v_scale: Optional[jax.Array] = None,
     block_tables: Optional[jax.Array] = None,
     logical_limit: Optional[int] = None,
+    q_starts: Optional[jax.Array] = None,
     impl: str = "auto",
 ) -> jax.Array:
     """GQA attention of a short query block against a fixed-size cache.
@@ -167,6 +168,14 @@ def cached_attention(
     limit: fully-dead tail blocks are skipped exactly by the
     ``pl.when`` clamp, contributing nothing to the online softmax.)
 
+    Ragged-q mode (``q_starts`` [B] int32 — the speculative verify step):
+    each batch row's query block sits at its OWN position — row ``b``'s
+    queries occupy slots ``[q_starts[b], q_starts[b] + q_len)`` and query
+    row ``j`` attends exactly the slots ``<= q_starts[b] + j`` admitted by
+    the ``prompt_lengths``/``width`` window.  The default (``None``) keeps
+    today's uniform semantics: every row's block ends at ``kv_len - 1``.
+    ``kv_len`` stays the batch-max live depth (the kernel's DMA clamp).
+
     Dispatch (``impl``): ``"auto"`` routes supported shapes on TPU to the
     fused split-KV pallas kernel (ops/decode_attention.py) and everything
     else to the masked XLA einsum below; ``"pallas"`` forces the kernel
@@ -193,6 +202,7 @@ def cached_attention(
             q, k, v, kv_len,
             prompt_lengths=prompt_lengths, prompt_width=prompt_width,
             k_scale=k_scale, v_scale=v_scale, block_tables=block_tables,
+            q_starts=q_starts,
         )
 
     if block_tables is not None:
@@ -234,7 +244,13 @@ def cached_attention(
             (k_pos[None, :] < prompt_lengths[:, None])
             | ((k_pos[None, :] >= prompt_width) & (k_pos[None, :] < kv_len))
         )[:, None, None, None, :]  # [B, 1, 1, 1, max_len]
-    if sq > 1:
+    if q_starts is not None:
+        # ragged-q clamp: row b's query j was written at q_starts[b] + j
+        # and sees exactly [0, q_starts[b] + j] — per-row, for the
+        # speculative verify step where every slot's cursor differs
+        row_last = q_starts.astype(jnp.int32)[:, None] + jnp.arange(sq)[None, :]  # [B, q_len]
+        mask = mask & (k_pos[None, None, :] <= row_last[:, :, None])[:, None, None, :, :]
+    elif sq > 1:
         # causal clamp inside the query block: row j's last visible slot
         # is kv_len - q_len + j (the slot it was just written to)
         row_last = kv_len - sq + jnp.arange(sq)  # [q_len]
@@ -633,6 +649,153 @@ def extend_step(
         hidden, jnp.broadcast_to(last, (b, 1, hidden.shape[-1])), axis=1
     )[:, 0]
     logits = jnp.einsum("be,ev->bv", hid, _head(params, cfg))
+    return logits, cache
+
+
+def verify_step(
+    params: Dict[str, Any],
+    cache: Cache,
+    tokens: jax.Array,
+    pos: jax.Array,
+    cfg: ModelConfig,
+    unroll_layers: Optional[bool] = None,
+    decode_kernel: str = "auto",
+    block_tables: Optional[jax.Array] = None,
+    logical_limit: Optional[int] = None,
+) -> Tuple[jax.Array, Cache]:
+    """Multi-token verification step: the target half of speculative
+    decoding (tpu_nexus/serving/speculative.py).
+
+    ``tokens`` [B, W] is each slot's ``[last_accepted, d_1, ..., d_{W-1}]``
+    — the last emitted token (whose KV is not yet written, exactly the
+    per-slot :func:`decode_step` contract) followed by W-1 draft
+    candidates.  ``pos`` [B] is each slot's cursor: row ``b`` writes its W
+    tokens' KV at logical positions ``pos[b] + [0, W)`` and query row
+    ``j`` attends ``[0, pos[b] + j]`` — per-row ragged, via
+    :func:`cached_attention`'s ``q_starts`` mode, so slots at different
+    depths verify in ONE call just as they decode in one call.  Returns
+    logits [B, W, vocab] — row ``j``'s logits are the target
+    distribution after consuming drafts ``<= j``, so the caller's greedy
+    argmax over row ``j`` is the token that SHOULD follow draft ``j``
+    (the verify-and-accept oracle) — and the updated cache.
+
+    With W = 1 this is exactly the per-slot :func:`decode_step` (the
+    engine's k=0 path stays on decode_step; the equivalence is pinned by
+    tests).  Rollback is the CALLER's job and is free at the cache level:
+    rejected tokens' KV rows sit ABOVE the clamped cursor, where the mask
+    never reads and the next accepted token overwrites.
+
+    Paged mode (``block_tables`` [B, n_log], per-slot pos): writes scatter
+    through the table like the paged :func:`decode_step`; positions past
+    the table's real blocks (a draft window overshooting the request's
+    allocation) divert to the scratch block — never a neighbour's KV.
+    COW is the caller's job, as everywhere."""
+    cfg = _decode_cfg(cfg)
+    ct = cfg.dtype
+    b, w = tokens.shape
+    pos = jnp.asarray(pos, jnp.int32).reshape(b)
+    paged = block_tables is not None
+    bt = block_tables.astype(jnp.int32) if paged else None
+    positions = pos[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]  # [B, W]
+    if paged:
+        page_size = cache["k"].shape[2]
+        n_log = bt.shape[1]
+        max_len = n_log * page_size
+        # per-row write addresses through the table; overshoot past the
+        # table row (clamped deref) diverts to scratch explicitly
+        _lb = jnp.minimum(positions // page_size, n_log - 1)
+        _phys = jnp.take_along_axis(bt, _lb, axis=1)  # [B, W]
+        _phys = jnp.where(positions < max_len, _phys, 0)
+        _off = positions % page_size
+    else:
+        max_len = cache["k"].shape[2]
+    cos, sin = rope_tables(positions, cfg.head_dim, cfg.rope_theta)
+    kv_quant = "k_s" in cache
+    # attention mask: purely the per-row ragged-q clamp s <= pos[b] + j
+    # (lens=0/width=0 disable the prompt/tail window — slot caches are
+    # contiguous, the same degeneration the per-slot decode_step uses);
+    # kv_len is only the batch-max DMA clamp
+    att_lens = jnp.zeros((b,), jnp.int32)
+    att_width = 0
+    att_kv_len = jnp.max(pos) + w
+    n_layers = cache["k"].shape[0]
+    if unroll_layers is None:
+        unroll_layers = n_layers <= 32
+    x = params["embed"]["tokens"].astype(ct)[tokens]  # [B, W, E]
+
+    def _cache_write(arr, update, li):
+        # update [B, W, Hkv|1, D|1]: scatter each row's W tokens at its
+        # own cursor window.  Contiguous: per-row scatter (out-of-bounds
+        # rows past max_len are dropped by XLA scatter semantics, same as
+        # the per-slot decode write).  Paged: through the table, with
+        # overshoot diverted to the scratch sink above.
+        if paged:
+            return arr.at[li, _phys, _off].set(update)
+        rows = jnp.arange(b, dtype=jnp.int32)[:, None]
+        return arr.at[li, rows, positions].set(update)
+
+    def _cache_read(arr, li):
+        if isinstance(li, int):
+            return arr[li]
+        return jax.lax.dynamic_index_in_dim(arr, li, 0, keepdims=False)
+
+    def layer_body(x, c, layer, li):
+        h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
+        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
+        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
+        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = _rope(q, cos, sin)
+        k = _rope(k, cos, sin)
+        if kv_quant:
+            (k, k_s), (v, v_s) = _quantize_kv(k), _quantize_kv(v)
+            c = dict(
+                c,
+                k_s=_cache_write(c["k_s"], k_s, li),
+                v_s=_cache_write(c["v_s"], v_s, li),
+            )
+        c = dict(
+            c,
+            k=_cache_write(c["k"], k, li),
+            v=_cache_write(c["v"], v, li),
+        )
+        ck = _cache_read(c["k"], li)
+        cv = _cache_read(c["v"], li)
+        scales = (
+            dict(k_scale=_cache_read(c["k_s"], li), v_scale=_cache_read(c["v_s"], li))
+            if kv_quant
+            else {}
+        )
+        o = cached_attention(
+            q, ck, cv, att_kv_len,
+            prompt_lengths=att_lens, prompt_width=att_width,
+            block_tables=bt, logical_limit=logical_limit,
+            q_starts=pos, impl=decode_kernel, **scales,
+        )
+        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = _ffn_block(x, layer, cfg)
+        return x, c
+
+    if unroll_layers:
+        c = cache
+        for li in range(n_layers):
+            layer = jax.tree.map(lambda a, _li=li: a[_li], params["layers"])
+            x, c = layer_body(x, c, layer, li)
+        cache = c
+    else:
+
+        def body(carry, xs):
+            x, c = carry
+            layer, li = xs
+            x, c = layer_body(x, c, layer, li)
+            return (x, c), None
+
+        (x, cache), _ = jax.lax.scan(
+            body,
+            (x, cache),
+            (params["layers"], jnp.arange(n_layers, dtype=jnp.int32)),
+        )
+    hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
+    logits = jnp.einsum("bse,ev->bsv", hidden, _head(params, cfg))
     return logits, cache
 
 

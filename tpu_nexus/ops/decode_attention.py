@@ -124,17 +124,21 @@ def decode_supported(q, k, k_scale=None, v_scale=None, block_tables=None) -> boo
 
 def _decode_kernel(
     lens_ref, meta_ref, *refs,
-    quant: bool, paged: bool, sq: int, group: int, block_k: int, n_kv: int,
-    s_k: int, scale: float,
+    quant: bool, paged: bool, ragged_q: bool, sq: int, group: int,
+    block_k: int, n_kv: int, s_k: int, scale: float,
 ):
     """One (batch, KV head, KV block) grid step of the online softmax.
 
-    ``refs`` is ``[bt_ref,] q_ref, k_ref, v_ref, [ks_ref, vs_ref,] o_ref,
-    acc_ref, m_ref, l_ref`` — the block-table prefetch ref present only in
-    paged mode (consumed by the index maps, not the body: slot positions
-    are logical either way), scale refs only in int8 mode.  The carry
-    (acc/m/l) persists across the minor-most KV axis; o flushes once on
-    the final KV step."""
+    ``refs`` is ``[qs_ref,] [bt_ref,] q_ref, k_ref, v_ref, [ks_ref,
+    vs_ref,] o_ref, acc_ref, m_ref, l_ref`` — the per-row query-start
+    prefetch ref present only in ragged-q mode (speculative verify: each
+    batch row's query block sits at its OWN position), the block-table
+    prefetch ref only in paged mode (consumed by the index maps, not the
+    body: slot positions are logical either way), scale refs only in int8
+    mode.  The carry (acc/m/l) persists across the minor-most KV axis; o
+    flushes once on the final KV step."""
+    if ragged_q:
+        qs_ref, refs = refs[0], refs[1:]
     if paged:
         refs = refs[1:]  # bt_ref: index-map-only
     q_ref, k_ref, v_ref, *rest = refs
@@ -180,7 +184,13 @@ def _decode_kernel(
         row_j = jnp.minimum(
             jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // group, sq - 1
         )
-        live = (s_pos < lens_b) | ((s_pos >= width) & (s_pos <= last_pos - (sq - 1) + row_j))
+        # row j's last visible slot: uniform mode derives it from the
+        # global last_pos (every row's query block ends at last_pos);
+        # ragged-q mode reads the row's OWN query start (speculative
+        # verify — per-slot cursors differ, so row b query j sits at
+        # qs[b] + j and must see exactly [0, qs[b] + j])
+        row_start = qs_ref[bi] if ragged_q else last_pos - (sq - 1)
+        live = (s_pos < lens_b) | ((s_pos >= width) & (s_pos <= row_start + row_j))
         scores = jnp.where(live, scores, _NEG_INF)
         m = m_ref[...]
         m_new = jnp.maximum(m, jnp.max(scores, axis=1, keepdims=True))
@@ -224,6 +234,7 @@ def decode_attention(
     v_scale: Optional[jax.Array] = None,
     *,
     block_tables: Optional[jax.Array] = None,
+    q_starts: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
@@ -243,6 +254,16 @@ def decode_attention(
     lives at physical ``(block_tables[b, s // page_size], s % page_size)``
     and all position semantics (``kv_len``, ``prompt_lengths``) stay
     logical.
+
+    Ragged-q mode (``q_starts`` [B] int32, speculative verify): batch row
+    ``b``'s query block occupies slots ``[q_starts[b], q_starts[b] +
+    q_len)`` — per-row, unlike the default where every row's block ends
+    at ``kv_len - 1`` — and query row ``j`` attends exactly ``[0,
+    q_starts[b] + j]`` (combined with the ``prompt_lengths``/``width``
+    window as usual).  ``kv_len`` still names the DEEPEST live slot + 1
+    across the batch (``max(q_starts) + q_len``): it only drives the DMA
+    clamp.  Passing ``q_starts = kv_len - q_len`` broadcast is exactly
+    the uniform behavior.
 
     ``interpret`` defaults to True off-TPU so the kernel is testable on
     the CPU mesh (pallas interpreter mode)."""
@@ -273,6 +294,8 @@ def decode_attention(
         problems.append(f"q_len {sq} outside [1, {MAX_DECODE_Q_LEN}]")
     if (k_scale is None) != (v_scale is None):
         problems.append("int8 cache mode needs BOTH k_scale and v_scale")
+    if q_starts is not None and q_starts.shape != (b,):
+        problems.append(f"q_starts shape {q_starts.shape} != ({b},)")
     if problems:
         raise ValueError(
             "decode_attention unsupported shapes: " + "; ".join(problems)
@@ -323,31 +346,31 @@ def decode_attention(
     meta = jnp.stack([last_pos, width])
 
     # dead KV blocks clamp to the last live block: the revisit optimization
-    # elides their DMA, so cache traffic tracks kv_len, not max_len
+    # elides their DMA, so cache traffic tracks kv_len, not max_len.
+    # Index maps take the prefetch refs as varargs because the operand set
+    # varies by mode ([lens, meta] + q_starts? + block_tables?): meta is
+    # always refs[1], the block-table row (paged) always the LAST ref.
     if paged:
         # dereference the prefetched block-table row: logical grid step ki
         # of batch row bi fetches its own physical page.  Dead logical
         # blocks clamp to the last GLOBALLY live logical index — rows past
         # their own live length hit their scratch-padded table entries,
         # which is masked compute over an elided (revisited) DMA.
-        def _kv_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
-            return (bt_ref[bi * n_log + jnp.minimum(ki, meta_ref[0] // block_k)], 0, h)
+        def _kv_index(bi, h, ki, *refs):
+            return (refs[-1][bi * n_log + jnp.minimum(ki, refs[1][0] // block_k)], 0, h)
 
-        def _scale_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
-            return (bt_ref[bi * n_log + jnp.minimum(ki, meta_ref[0] // block_k)], h, 0)
-
-        def _q_index(bi, h, ki, lens_ref, meta_ref, bt_ref):
-            return (bi, h, 0, 0)
+        def _scale_index(bi, h, ki, *refs):
+            return (refs[-1][bi * n_log + jnp.minimum(ki, refs[1][0] // block_k)], h, 0)
 
     else:
-        def _kv_index(bi, h, ki, lens_ref, meta_ref):
-            return (bi, jnp.minimum(ki, meta_ref[0] // block_k), h)
+        def _kv_index(bi, h, ki, *refs):
+            return (bi, jnp.minimum(ki, refs[1][0] // block_k), h)
 
-        def _scale_index(bi, h, ki, lens_ref, meta_ref):
-            return (bi, h, jnp.minimum(ki, meta_ref[0] // block_k))
+        def _scale_index(bi, h, ki, *refs):
+            return (bi, h, jnp.minimum(ki, refs[1][0] // block_k))
 
-        def _q_index(bi, h, ki, lens_ref, meta_ref):
-            return (bi, h, 0, 0)
+    def _q_index(bi, h, ki, *refs):
+        return (bi, h, 0, 0)
 
     in_specs = [
         pl.BlockSpec((1, 1, r_pad, d), _q_index),
@@ -369,12 +392,16 @@ def decode_attention(
         ]
 
     prefetch = [lens, meta]
+    ragged_q = q_starts is not None
+    if ragged_q:
+        prefetch.append(q_starts.astype(jnp.int32))
     if paged:
         prefetch.append(block_tables.astype(jnp.int32).reshape(-1))
 
     out = pl.pallas_call(
         functools.partial(
-            _decode_kernel, quant=quant, paged=paged, sq=sq, group=group,
+            _decode_kernel, quant=quant, paged=paged, ragged_q=ragged_q,
+            sq=sq, group=group,
             block_k=block_k, n_kv=n_kv, s_k=s_k, scale=float(scale),
         ),
         out_shape=jax.ShapeDtypeStruct((b, hkv, r_pad, d), q.dtype),

@@ -18,6 +18,9 @@ Layering (each module imports only downward):
                        guard, bounded queue, deadline sweep, block gate
 * ``metrics``        — TTFT/TPOT/queue-depth/occupancy/shed/fault counters
                        + token-occupancy / prefix-hit / COW telemetry
+* ``speculative``    — drafting subsystem (ISSUE 11): Drafter interface,
+                       prompt-lookup ngram + draft-model drafters, the
+                       verify-k acceptance oracle (greedy token-identity)
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
 * ``engine``         — ModelExecutor / PagedModelExecutor (jitted compute)
                        + ServingEngine (host loop: fault isolation,
@@ -57,6 +60,13 @@ from tpu_nexus.serving.fleet import (
     ServingFleet,
 )
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.speculative import (
+    DRAFTERS,
+    Drafter,
+    ModelDrafter,
+    NGramDrafter,
+    accept_tokens,
+)
 from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
     ACTIVE_STATES,
@@ -74,7 +84,9 @@ __all__ = [
     "BlockError",
     "CAUSE_REPLICA_LOST",
     "CheckpointWatcher",
+    "DRAFTERS",
     "DeviceStateLost",
+    "Drafter",
     "EngineReplica",
     "FifoScheduler",
     "FleetError",
@@ -82,7 +94,9 @@ __all__ = [
     "IllegalTransition",
     "KVBlockManager",
     "KVSlotManager",
+    "ModelDrafter",
     "ModelExecutor",
+    "NGramDrafter",
     "PagedCacheManager",
     "PagedModelExecutor",
     "PrefixIndex",
@@ -100,6 +114,7 @@ __all__ = [
     "StepFaultPolicy",
     "TERMINAL_STATES",
     "TRANSITIONS",
+    "accept_tokens",
     "init_cache",
     "init_paged_cache",
     "percentile",

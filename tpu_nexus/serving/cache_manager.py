@@ -127,6 +127,10 @@ class KVSlotManager:
         self._free: List[int] = list(range(num_slots))  # min-heap: lowest id first
         #: slot -> owning request_id, in admission order (oldest first)
         self._owner: "OrderedDict[int, str]" = OrderedDict()
+        #: slot -> recorded live token count (OPTIONAL — populated by the
+        #: speculative engine so rollback is auditable; the plain decode
+        #: path never records and verify_consistent tolerates absence)
+        self._len: Dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -162,7 +166,46 @@ class KVSlotManager:
         if slot not in self._owner:
             raise SlotError(f"slot {slot} is not allocated (double free?)")
         del self._owner[slot]
+        self._len.pop(slot, None)
         heapq.heappush(self._free, slot)
+
+    def set_length(self, slot: int, n: int) -> None:
+        """Record ``slot``'s live token count — the KV write high-water
+        mark the speculative engine audits rollback against.  Recording is
+        opt-in: the plain decode path never calls this and pays nothing."""
+        if slot not in self._owner:
+            raise SlotError(f"set_length of unallocated slot {slot}")
+        if not 0 <= n <= self.max_len:
+            raise SlotError(
+                f"slot {slot} length {n} outside [0, max_len={self.max_len}]"
+            )
+        self._len[slot] = n
+
+    def length(self, slot: int) -> Optional[int]:
+        return self._len.get(slot)
+
+    def truncate(self, slot: int, new_len: int) -> int:
+        """Roll back ``slot``'s recorded live length to ``new_len``
+        (speculative verify rejected a draft suffix: the KV rows above the
+        clamped cursor are garbage the mask never reads).  Shrink-only —
+        growing through truncate means the caller's cursor accounting went
+        backwards, an engine bug surfaced loudly.  Returns the number of
+        rolled-back rows."""
+        if slot not in self._owner:
+            raise SlotError(f"truncate of unallocated slot {slot}")
+        current = self._len.get(slot)
+        if current is None:
+            raise SlotError(
+                f"truncate of slot {slot} with no recorded length — "
+                "set_length the write high-water mark first"
+            )
+        if not 0 <= new_len <= current:
+            raise SlotError(
+                f"truncate of slot {slot} to {new_len} outside [0, "
+                f"recorded {current}] — rollback can only shrink"
+            )
+        self._len[slot] = new_len
+        return current - new_len
 
     def eviction_candidate(self) -> Optional[int]:
         """Youngest busy slot (most recent admission), or None when idle."""
@@ -190,6 +233,14 @@ class KVSlotManager:
         owners = list(self._owner.values())
         if len(set(owners)) != len(owners):
             raise SlotError(f"request owns multiple slots: {owners}")
+        stray = set(self._len) - owned
+        if stray:
+            raise SlotError(f"lengths recorded for unowned slots: {sorted(stray)}")
+        for slot, n in self._len.items():
+            if not 0 <= n <= self.max_len:
+                raise SlotError(
+                    f"slot {slot} recorded length {n} outside [0, {self.max_len}]"
+                )
 
 
 # -- paged KV: blocks, prefix sharing, copy-on-write ---------------------------
@@ -332,6 +383,63 @@ class KVBlockManager:
         owned[owned.index(src)] = dst
         self._decref(src)
         return dst
+
+    def truncate_request(self, request_id: str, keep: int) -> List[int]:
+        """Drop ``request_id``'s block references past the first ``keep``
+        (logical order — ``_owned`` lists blocks in table order: shared
+        prefix first, exclusive tail after, COW replaces in place).  The
+        speculative-rollback primitive: a verify overshoot wrote only
+        rejected garbage into the tail blocks, so they return to the free
+        list.  Every dropped block must be EXCLUSIVE (refcount 1, not
+        indexed): decode-region blocks always are, and truncating a
+        shared/indexed block would hand cached prefix KV back to the
+        allocator — an engine bug surfaced loudly.  Returns the dropped
+        physical blocks, in logical order."""
+        owned = self._owned.get(request_id, [])
+        if not 0 <= keep <= len(owned):
+            raise BlockError(
+                f"truncate of {request_id} to {keep} blocks outside "
+                f"[0, {len(owned)} owned]"
+            )
+        dropped = owned[keep:]
+        for block in dropped:
+            if block in self._indexed or self._ref.get(block, 0) != 1:
+                raise BlockError(
+                    f"truncate of {request_id} would release shared/indexed "
+                    f"block {block} (refcount {self._ref.get(block, 0)}) — "
+                    "only exclusive decode-tail blocks roll back"
+                )
+        for block in dropped:
+            self._decref(block)
+        del owned[keep:]
+        if not owned:
+            self._owned.pop(request_id, None)
+        return dropped
+
+    def reclaim(self, request_id: str, n: int) -> List[int]:
+        """Re-grow ``request_id``'s tail by ``n`` fresh exclusive blocks,
+        CONSUMING its own reservation credits — the regrowth half of
+        speculative rollback.  Truncated blocks were returned to the free
+        list but earmarked (``reserve``), so this can never fail against
+        concurrent admissions: the credits were excluded from every
+        ``can_admit`` headroom in between."""
+        if n < 0:
+            raise ValueError(f"cannot reclaim {n} blocks")
+        credits = self._reserved.get(request_id, 0)
+        if n > credits:
+            raise BlockError(
+                f"reclaim({n}) for {request_id} exceeds its {credits} "
+                "reservation credits — regrowth must be covered by a prior "
+                "truncate/reserve"
+            )
+        blocks = [self._take() for _ in range(n)]
+        self._owned.setdefault(request_id, []).extend(blocks)
+        if n:
+            self._reserved[request_id] = credits - n
+            if not self._reserved[request_id]:
+                del self._reserved[request_id]
+            self.reserved_total -= n
+        return blocks
 
     def index_ref(self, block: int) -> None:
         """The prefix index caches ``block`` (one extra reference)."""
@@ -777,6 +885,39 @@ class PagedCacheManager:
                 block_row[logical] = dst
                 copies.append((block, dst, logical))
         return copies
+
+    def truncate(self, request_id: str, new_len: int) -> List[int]:
+        """Speculative rollback (ISSUE 11): clamp ``request_id``'s KV
+        footprint to ``new_len`` live tokens, releasing owned tail blocks
+        past ``blocks_needed(new_len)`` back to the free list — they hold
+        ONLY rejected-draft garbage.  Each released block is replaced by a
+        reservation credit for this request, so the release is
+        pool-neutral for admissions (credits are excluded from every
+        ``can_admit`` headroom) and :meth:`extend` regrowth is GUARANTEED
+        — the same pay-up-front discipline as the COW reservation.
+        Returns the released physical blocks, logical order; the caller
+        scrubs its table-row entries to :data:`SCRATCH_BLOCK`."""
+        keep = self.blocks_needed(max(new_len, 1))
+        owned = self.manager.request_blocks(request_id)
+        if keep >= len(owned):
+            return []
+        dropped = self.manager.truncate_request(request_id, keep)
+        self.manager.reserve(request_id, len(dropped))
+        return dropped
+
+    def extend(self, request_id: str, need_len: int) -> List[Tuple[int, int]]:
+        """Regrow ``request_id``'s block-table coverage to ``need_len``
+        tokens from its own truncate credits (see :meth:`truncate`) —
+        called before a verify dispatch whose write window crosses past a
+        previously rolled-back block.  Returns ``(logical_index,
+        physical_block)`` pairs for the caller's table row; empty when
+        coverage already suffices."""
+        have = len(self.manager.request_blocks(request_id))
+        need = self.blocks_needed(min(need_len, self.max_len)) - have
+        if need <= 0:
+            return []
+        blocks = self.manager.reclaim(request_id, need)
+        return [(have + i, block) for i, block in enumerate(blocks)]
 
     def register_prompt(self, request_id: str, prompt: Sequence[int], block_row) -> int:
         """Cache the request's full prompt blocks for future admissions

@@ -60,6 +60,7 @@ from tpu_nexus.serving.request import (
     RequestState,
 )
 from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
+from tpu_nexus.serving.speculative import accept_tokens
 
 logger = logging.getLogger(__name__)
 
@@ -254,13 +255,14 @@ class ModelExecutor(_ExecutorCommon):
         top_p: float = 1.0,
         seed: int = 0,
     ) -> None:
-        from tpu_nexus.models.generate import decode_step, prefill
+        from tpu_nexus.models.generate import decode_step, prefill, verify_step
 
         jax = self._init_common(
             params, cfg, num_slots=num_slots, max_len=max_len,
             kv_quant=kv_quant, decode_kernel=decode_kernel,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
         )
+        jnp = jax.numpy
         self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
 
         def _begin(params, cache, padded, lengths, slot, key):
@@ -290,6 +292,18 @@ class ModelExecutor(_ExecutorCommon):
             return self._sample(logits, key), cache
 
         self._step = jax.jit(_step, donate_argnums=self._donate)
+
+        def _verify(params, cache, block, cursors):
+            # multi-query speculative verify (greedy-only — the engine
+            # rejects speculation under sampling at construction): one
+            # call scores every slot's [last_token, drafts...] block and
+            # returns the per-row greedy argmax, the acceptance oracle
+            logits, cache = verify_step(
+                params, cache, block, cursors, cfg, decode_kernel=decode_kernel
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._verify = jax.jit(_verify, donate_argnums=self._donate)
 
     def _fresh_cache(self):
         return init_cache(self.cfg, self.num_slots, self.max_len, self.kv_quant)
@@ -328,6 +342,32 @@ class ModelExecutor(_ExecutorCommon):
         except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
             self._guard_cache(exc)
         return np.asarray(next_tokens)
+
+    def verify(self, tokens: np.ndarray, cursors: np.ndarray, drafts: np.ndarray) -> np.ndarray:
+        """Speculative verify over all slots: score ``[tokens[b], drafts
+        [b]]`` (q_len = k+1) at each slot's cursor in ONE jitted call;
+        returns the target's greedy tokens [num_slots, k+1] — row j is
+        the argmax conditioned on drafts < j (the acceptance oracle)."""
+        jnp = self._jax.numpy
+        if self.temperature != 0.0:
+            raise RuntimeError(
+                "speculative verify is greedy-only (temperature == 0); "
+                "rejection sampling has not landed"
+            )
+        block = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None], np.asarray(drafts, np.int32)],
+            axis=1,
+        )
+        try:
+            greedy, self.cache = self._verify(
+                self.params,
+                self.cache,
+                jnp.asarray(block),
+                jnp.asarray(cursors, jnp.int32),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return np.asarray(greedy)
 
 
 class PagedModelExecutor(_ExecutorCommon):
@@ -374,7 +414,12 @@ class PagedModelExecutor(_ExecutorCommon):
         top_p: float = 1.0,
         seed: int = 0,
     ) -> None:
-        from tpu_nexus.models.generate import decode_step, extend_step, prefill
+        from tpu_nexus.models.generate import (
+            decode_step,
+            extend_step,
+            prefill,
+            verify_step,
+        )
         from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
 
         jax = self._init_common(
@@ -446,6 +491,18 @@ class PagedModelExecutor(_ExecutorCommon):
             return self._sample(logits, key), cache
 
         self._step = jax.jit(_step, donate_argnums=self._donate)
+
+        def _verify(params, cache, block, cursors, tables):
+            # speculative multi-query verify through the block tables
+            # (greedy-only; see ModelExecutor._verify)
+            logits, cache = verify_step(
+                params, cache, block, cursors, cfg,
+                decode_kernel=decode_kernel, block_tables=tables,
+                logical_limit=max_len,
+            )
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+        self._verify = jax.jit(_verify, donate_argnums=self._donate)
 
         def _cow(cache, src, dst):
             # copy-on-write block copy: one whole-block slice per leaf
@@ -528,6 +585,37 @@ class PagedModelExecutor(_ExecutorCommon):
             self._guard_cache(exc)
         return np.asarray(next_tokens)
 
+    def verify(
+        self,
+        tokens: np.ndarray,
+        cursors: np.ndarray,
+        drafts: np.ndarray,
+        tables: np.ndarray,
+    ) -> np.ndarray:
+        """Paged speculative verify: same contract as
+        :meth:`ModelExecutor.verify` plus the per-slot block tables."""
+        jnp = self._jax.numpy
+        if self.temperature != 0.0:
+            raise RuntimeError(
+                "speculative verify is greedy-only (temperature == 0); "
+                "rejection sampling has not landed"
+            )
+        block = np.concatenate(
+            [np.asarray(tokens, np.int32)[:, None], np.asarray(drafts, np.int32)],
+            axis=1,
+        )
+        try:
+            greedy, self.cache = self._verify(
+                self.params,
+                self.cache,
+                jnp.asarray(block),
+                jnp.asarray(cursors, jnp.int32),
+                jnp.asarray(tables, jnp.int32),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return np.asarray(greedy)
+
 
 class ServingEngine:
     """Host half: the continuous-batching state machine (see module doc).
@@ -558,8 +646,35 @@ class ServingEngine:
         clock: Callable[[], float] = time.monotonic,
         fault_policy: Optional[StepFaultPolicy] = None,
         retired_log_limit: int = 10_000,
+        spec_k: int = 0,
+        drafter: Optional[Any] = None,
     ) -> None:
         self.executor = executor
+        #: speculative decoding (ISSUE 11): propose spec_k draft tokens
+        #: per slot each step, verify them in ONE q_len=spec_k+1 call,
+        #: emit the accepted prefix + correction.  0 keeps the decode
+        #: loop EXACTLY as before (the k=0 path is byte-identical).
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_k:
+            from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
+
+            if drafter is None:
+                raise ValueError("spec_k > 0 requires a drafter")
+            if spec_k + 1 > MAX_DECODE_Q_LEN:
+                raise ValueError(
+                    f"spec_k {spec_k} exceeds the decode kernel's verify "
+                    f"width (q_len = spec_k + 1 <= {MAX_DECODE_Q_LEN})"
+                )
+            if getattr(executor, "temperature", 0.0) != 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only for now "
+                    "(temperature must be 0 until rejection sampling lands)"
+                )
+        elif drafter is not None:
+            raise ValueError("a drafter without spec_k > 0 would never run")
+        self.spec_k = spec_k
+        self.drafter = drafter
         self.slots = KVSlotManager(executor.num_slots, executor.max_len)
         #: block-granular accounting when the executor is paged (exposes
         #: page_size/num_blocks); None keeps the slot-granular contract
@@ -754,6 +869,12 @@ class ServingEngine:
         # request — the youngest admission, whose arrival changed the
         # device footprint — and re-attempts with the survivors.  Bounded:
         # each pass either succeeds or shrinks the batch by one.
+        # Speculative mode (spec_k > 0) swaps the single-token dispatch
+        # for propose → multi-query verify → accept-and-roll-back; the
+        # k=0 branch below is untouched, byte-for-byte today's loop.
+        if self.spec_k:
+            decoded = self._spec_decode()
+            return self._finish_step(admitted, decoded, retired_before)
         decoded = 0
         next_tokens = None
         while self._active:
@@ -789,6 +910,13 @@ class ServingEngine:
                     # total_len <= max_len, kept as the runtime backstop
                     self._retire(req, RequestState.EVICTED, cause=CAUSE_OVERFLOW)
 
+        return self._finish_step(admitted, decoded, retired_before)
+
+    def _finish_step(
+        self, admitted: int, decoded: int, retired_before: int
+    ) -> Dict[str, int]:
+        """Shared tail of one engine iteration: scheduler tick, occupancy
+        gauges, the observability counts."""
         self.scheduler.tick()
         if self.paged is not None:
             # HBM actually held: blocks in use (live requests + cached
@@ -809,6 +937,127 @@ class ServingEngine:
             "decoded": decoded,
             "retired": len(self.retired) - retired_before,
         }
+
+    def _propose_safe(self, k: int) -> np.ndarray:
+        """Run the drafter's proposal round with the fault boundary drafts
+        deserve: they are HINTS — correctness never depends on them (the
+        verify's own argmax decides every emitted token) — so a drafter
+        failure (a draft MODEL's device fault, a desynced lookup) must
+        never cost a request, let alone the step.  Degrade to zero drafts
+        for this step (the verify still emits >= 1 correct token per
+        slot), count it, and keep serving; a drafter that faults every
+        step shows up as serving.draft_faults + acceptance 0, not an
+        outage.  This is deliberately NOT the StepFaultPolicy: retrying a
+        draft buys nothing a zero draft doesn't."""
+        try:
+            return self.drafter.propose(
+                self._tokens, self._cursors, tuple(self._active), k
+            )
+        except (RuntimeError, DeviceStateLost) as exc:  # noqa: BLE001 - drafts are hints: a draft-side fault degrades to no-draft (counted + logged), never to a failed request — the verify argmax alone decides emitted tokens
+            logger.warning(
+                "drafter %s failed to propose (%s); decoding this step "
+                "without drafts", getattr(self.drafter, "name", "?"), exc,
+            )
+            self.metrics.draft_fault()
+            return np.zeros((self.executor.num_slots, k), np.int32)
+
+    def _verify_thunk(self, drafts: np.ndarray):
+        """The speculative verify dispatch the fault policy retries —
+        paged mode adds the per-slot block tables."""
+        if self.paged is None:
+            return self.executor.verify(self._tokens, self._cursors, drafts)
+        return self.executor.verify(
+            self._tokens, self._cursors, drafts, self._tables
+        )
+
+    def _spec_decode(self) -> int:
+        """One speculative engine iteration over the live slots (ISSUE
+        11): drafter proposes k candidates per slot, ONE multi-query
+        verify dispatch scores them all (fault-isolated exactly like the
+        plain step), and each slot emits its longest accepted prefix plus
+        the target's correction token — by construction the same tokens
+        greedy decoding would emit, just fewer device steps apart.
+
+        Rollback: the per-slot cursor advances only past ACCEPTED tokens;
+        rejected rows sit above it, masked and overwritten (contiguous) or
+        released back to the pool with regrowth credits (paged —
+        :meth:`PagedCacheManager.truncate`/``extend``, audited by
+        ``verify_consistent``)."""
+        if not self._active:
+            return 0
+        k = self.spec_k
+        drafts = self._propose_safe(k)
+        if self.paged is not None:
+            # the verify window writes positions [cursor, cursor + k]; a
+            # prior rollback may have released the request's tail blocks,
+            # so regrow coverage (guaranteed: regrowth consumes the
+            # request's own truncate credits) before the dispatch.  The
+            # window is clamped to total_len — positions past the
+            # request's allocation divert to the scratch sink in-kernel.
+            for slot, req in self._active.items():
+                need = min(int(self._cursors[slot]) + 1 + k, req.total_len)
+                for logical, block in self.paged.extend(req.request_id, need):
+                    self._tables[slot][logical] = block
+        greedy = None
+        while self._active:
+            try:
+                greedy = self._dispatch(lambda: self._verify_thunk(drafts))
+                break
+            except DeviceStateLost as lost:
+                self._fail_batch(lost)
+                break
+            except StepFault as fault:
+                victim_slot = self.slots.eviction_candidate()
+                assert victim_slot is not None  # _active nonempty => owned slot
+                victim = self._active[victim_slot]
+                logger.warning(
+                    "verify fault [%s] retired request %s (slot %d); "
+                    "%d request(s) keep decoding: %s",
+                    fault.cause, victim.request_id, victim_slot,
+                    len(self._active) - 1, fault.original,
+                )
+                self._retire(victim, RequestState.FAILED, cause=fault.cause)
+        decoded = 0
+        if greedy is None:
+            return 0
+        now = self._clock()
+        for slot, req in list(self._active.items()):
+            c = int(self._cursors[slot])
+            remaining = req.max_new_tokens - len(req.output_tokens)
+            emitted, n_draft = accept_tokens(drafts[slot], greedy[slot], remaining)
+            e = len(emitted)
+            dt = None if req.last_token_at is None else now - req.last_token_at
+            for tok in emitted:
+                req.emit(tok, now)
+            self._cursors[slot] = c + e
+            self._tokens[slot] = emitted[-1]
+            self.metrics.spec_tokens(dt, e)
+            self.metrics.spec_verify(proposed=k, accepted=n_draft)
+            self.drafter.observe(slot, emitted)
+            decoded += e
+            # rollback audit: the verify wrote KV through position c + k
+            # (draft overshoot); only [.., c + e) survives as live state.
+            # Contiguous: record high-water then clamp (verify_consistent
+            # checks the books).  Paged: additionally release garbage-only
+            # tail blocks with regrowth credits and scrub the table row.
+            written = min(c + 1 + k, self.slots.max_len)
+            self.slots.set_length(slot, written)
+            self.slots.truncate(slot, c + e)
+            if self.paged is not None:
+                released = self.paged.truncate(req.request_id, c + e)
+                if released:
+                    keep = len(self.paged.manager.request_blocks(req.request_id))
+                    row = self._tables[slot]
+                    for i in range(keep, keep + len(released)):
+                        row[i] = SCRATCH_BLOCK
+                    self.metrics.spec_rollback_blocks(len(released))
+            if req.done:
+                self._retire(req, RequestState.FINISHED)
+            elif int(self._cursors[slot]) >= self.slots.max_len:
+                # cache overflow — unreachable when submit() enforced
+                # total_len <= max_len, kept as the runtime backstop
+                self._retire(req, RequestState.EVICTED, cause=CAUSE_OVERFLOW)
+        return decoded
 
     def run_until_drained(self, max_steps: int = 1_000_000) -> None:
         """Step until queue and slots are empty; ``max_steps`` is the
@@ -1092,9 +1341,23 @@ class ServingEngine:
             table_row=row, tail_start=plan.tail_start, copies=copies,
         )
 
+    def _spec_cost(self, req: Request) -> int:
+        """Admission cost with a PREFILLING drafter (speculative mode,
+        ``drafter.prefills_prompt``): the draft model prefills the FULL
+        prompt into its own contiguous cache inside the same admission,
+        so the scheduler's prefill-token budget must price BOTH forward
+        passes — target (paged: the unshared tail) + draft (always the
+        whole prompt; the draft cache has no prefix sharing)."""
+        base = (
+            self._paged_cost(req) if self.paged is not None else req.prompt_len
+        )
+        return base + req.prompt_len
+
     def _admit(self) -> int:
         gate = self._paged_gate if self.paged is not None else None
         cost = self._paged_cost if self.paged is not None else None
+        if self.spec_k and getattr(self.drafter, "prefills_prompt", False):
+            cost = self._spec_cost
         admitted = self.scheduler.admit(self.slots.free_count, gate, cost)
         for req in admitted:
             slot = self.slots.allocate(req.request_id)
@@ -1136,6 +1399,24 @@ class ServingEngine:
                     self.metrics.blocks_cow(n_cow)
                 if shared:
                     self.metrics.prefix_hit(shared)
+            if self.drafter is not None:
+                # the drafter's slot state mirrors the request's tenancy:
+                # begin BEFORE any retire path can run, observe the
+                # prefill's first token like every later accepted token.
+                # Same hint boundary as _propose_safe: a draft-side fault
+                # here (the draft MODEL's prefill hitting a device error)
+                # costs this request its drafts, never its admission —
+                # stale/absent draft state only yields rejected proposals.
+                try:
+                    self.drafter.begin(slot, req.prompt)
+                    self.drafter.observe(slot, [first_token])
+                except (RuntimeError, DeviceStateLost) as exc:  # noqa: BLE001 - drafts are hints: a failed draft prefill degrades that slot to no-draft proposals (counted + logged), the TARGET admission proceeds untouched
+                    logger.warning(
+                        "drafter %s failed to begin slot %d (%s); the "
+                        "request decodes with degraded drafts",
+                        getattr(self.drafter, "name", "?"), slot, exc,
+                    )
+                    self.metrics.draft_fault()
             req.emit(first_token, self._clock())
             self.metrics.first_token(req)
             if req.done:  # max_new_tokens == 1: prefill produced everything
@@ -1145,6 +1426,10 @@ class ServingEngine:
             self._active[slot] = req
             self._cursors[slot] = req.prompt_len
             self._tokens[slot] = req.output_tokens[-1]
+            if self.spec_k:
+                # seed the rollback audit: prompt + the pending first
+                # token's future write = the slot's live coverage
+                self.slots.set_length(slot, req.prompt_len)
         return len(admitted)
 
     def _fail_batch(self, lost: DeviceStateLost, extra: Optional[Request] = None) -> None:
@@ -1190,6 +1475,8 @@ class ServingEngine:
             self._cursors[req.slot] = 0
             if self._tables is not None:
                 self._tables[req.slot] = SCRATCH_BLOCK
+            if self.drafter is not None:
+                self.drafter.retire(req.slot)
         if self.paged is not None:
             self._plans.pop(req.request_id, None)  # un-begun admission
             self._pending_stats.pop(req.request_id, None)  # failed begin

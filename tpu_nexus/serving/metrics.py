@@ -61,6 +61,18 @@ class ServingMetrics:
         self.prefix_hits = 0
         self.prefix_shared_tokens = 0
         self.blocks_cow_total = 0
+        #: speculative decoding (ISSUE 11): draft tokens proposed /
+        #: accepted-AND-emitted, and paged-KV blocks released by verify
+        #: rollback.  tokens_out and the TPOT samples count only ACCEPTED
+        #: tokens — a proposed-but-rejected draft never inflates
+        #: throughput or cadence metrics.
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_rollback_blocks_total = 0
+        #: drafter failures degraded to no-draft steps (drafts are hints:
+        #: a draft-side fault never costs a request — it costs acceptance,
+        #: and THIS counter is how that shows up on a dashboard)
+        self.draft_faults = 0
         #: last-step token-level occupancy sample (summary convenience;
         #: the gauge stream is the production signal)
         self.token_occupancy = 0.0
@@ -129,6 +141,44 @@ class ServingMetrics:
         self._m.count("serving.prefix_hit")
         self._m.count("serving.prefix_shared_tokens", value=shared_tokens)
 
+    def spec_tokens(self, dt: Optional[float], n: int) -> None:
+        """``n`` ACCEPTED tokens emitted by one speculative verify for one
+        request, ``dt`` seconds since the request's previous token (None
+        for a first-ever batch).  Counted as ``n`` tokens and ``n`` TPOT
+        samples of ``dt / n`` each — mean-preserving, so a verify that
+        lands 4 tokens in one 8 ms step reads as 2 ms/token, not as one
+        8 ms sample plus three fake zeros (which would crater the p50)."""
+        self.tokens_out += n
+        if dt is None or n < 1:
+            return
+        per_token = dt / n
+        for _ in range(n):
+            self.tpot_s.append(per_token)
+            self._m.histogram("serving.tpot_seconds", per_token)
+
+    def spec_verify(self, proposed: int, accepted: int) -> None:
+        """One slot's verify outcome: ``proposed`` draft tokens scored,
+        ``accepted`` of them emitted.  The ratio is the honest acceptance
+        rate — padding guesses count as proposed, capped emissions do not
+        count as accepted."""
+        self.spec_proposed += proposed
+        self.spec_accepted += accepted
+        self._m.count("serving.spec_proposed", value=proposed)
+        if accepted:
+            self._m.count("serving.spec_accepted", value=accepted)
+
+    def draft_fault(self) -> None:
+        """One drafter failure degraded to a no-draft step/slot (see the
+        engine's ``_propose_safe`` hint boundary)."""
+        self.draft_faults += 1
+        self._m.count("serving.draft_faults")
+
+    def spec_rollback_blocks(self, n: int) -> None:
+        """``n`` paged-KV blocks held ONLY rejected-draft garbage after a
+        verify and were released (with regrowth credits) by the rollback."""
+        self.spec_rollback_blocks_total += n
+        self._m.count("serving.spec_rollback_blocks", value=n)
+
     def weight_swap(self) -> None:
         """One completed hot weight swap (the engine finished a quiesce and
         installed new verified weights — a rolling-update progress tick)."""
@@ -169,6 +219,13 @@ class ServingMetrics:
             "prefix_hits": self.prefix_hits,
             "prefix_shared_tokens": self.prefix_shared_tokens,
             "blocks_cow": self.blocks_cow_total,
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_acceptance_rate": (
+                self.spec_accepted / self.spec_proposed if self.spec_proposed else 0.0
+            ),
+            "spec_rollback_blocks": self.spec_rollback_blocks_total,
+            "draft_faults": self.draft_faults,
             "weight_swaps": self.weight_swaps_total,
             "token_occupancy": self.token_occupancy,
             "ttft_p50_s": percentile(self.ttft_s, 50),

@@ -155,8 +155,11 @@ class FifoScheduler:
         prefill tail — the budget bounds actual prefill work interleaved
         per step, and a prefix hit's shared tokens are served by block
         reference, so a long shared prompt must not serialize a fan-out
-        burst to one admission per step.  ``cost`` runs BEFORE ``gate``
-        for each head."""
+        burst to one admission per step.  The speculative engine with a
+        PREFILLING drafter charges the draft model's full-prompt prefill
+        on top (``ServingEngine._spec_cost``) — two forward passes per
+        admission is two forward passes of budget.  ``cost`` runs BEFORE
+        ``gate`` for each head."""
         admitted: List[Request] = []
         budget = self.cfg.prefill_token_budget
         while self._queue and len(admitted) < free_slots:

@@ -368,6 +368,19 @@ class FaultyExecutor:
             self._fire()
         return self.inner.step(tokens, cursors, *args)
 
+    def verify(self, tokens, cursors, drafts, *args, **kwargs):
+        # the speculative engine's decode dispatch (ISSUE 11): drafts —
+        # and the paged table operand — pass through UNCHANGED, and the
+        # call counts on the SAME step counter as step(), so
+        # NEXUS_FAULT_STEP targets the Nth decode dispatch whether the
+        # engine speculates or not (a spec-on chaos drill needs no new
+        # env contract)
+        count = self.step_calls
+        self.step_calls += 1
+        if self._in_window(count, self.at_step):
+            self._fire()
+        return self.inner.verify(tokens, cursors, drafts, *args, **kwargs)
+
 
 def flip_committed_leaf(step_dir: str) -> str:
     """Flip one byte of a committed payload file — silent media corruption

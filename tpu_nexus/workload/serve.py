@@ -101,6 +101,25 @@ class ServeConfig:
     #: the like-for-like budget of the contiguous cache); set it BELOW
     #: that to overcommit on prefix sharing (NEXUS_KV_BLOCKS)
     kv_blocks: int = 0
+    #: engine mode only — speculative decoding (ISSUE 11): > 0 proposes
+    #: this many draft tokens per slot per step and verifies them in ONE
+    #: q_len = spec_k+1 multi-query decode call, emitting the longest
+    #: accepted prefix + correction — token-identical to greedy decode by
+    #: construction, up to spec_k+1 tokens per device step.  Greedy-only:
+    #: temperature > 0 with speculation is REJECTED at parse until
+    #: rejection sampling lands.  0 = off (NEXUS_SPEC_K)
+    spec_k: int = 0
+    #: engine mode only — which drafter proposes the candidates:
+    #: "ngram" (self-speculative prompt-lookup over the request's own
+    #: prompt + generated tokens — no extra model) or "model" (a draft
+    #: model run through the existing executor jits; NEXUS_SPEC_DRAFT_PRESET
+    #: names its weights preset, empty = self-draft with the serving
+    #: params, a correctness/e2e configuration).  Validated against
+    #: serving.speculative.DRAFTERS at parse (NEXUS_SPEC_DRAFTER)
+    spec_drafter: str = "ngram"
+    #: draft-model preset for spec_drafter="model"; "" = the target's own
+    #: params (NEXUS_SPEC_DRAFT_PRESET)
+    spec_draft_preset: str = ""
     #: engine mode only — train-to-serve continuous deployment (ISSUE 9):
     #: every this-many seconds re-check ``latest_verified_step(quarantine=
     #: False)`` under ``checkpoint_dir`` and, on a NEW verified step,
@@ -152,11 +171,39 @@ class ServeConfig:
             "drain_grace_s",
             "page_size",
             "kv_blocks",
+            "spec_k",
             "reload_check_interval_s",
         ):
             if getattr(self, field_name) < 0:
                 raise ValueError(
                     f"{field_name} must be >= 0, got {getattr(self, field_name)}"
+                )
+        if self.spec_k:
+            from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
+            from tpu_nexus.serving.speculative import DRAFTERS
+
+            if self.spec_k + 1 > MAX_DECODE_Q_LEN:
+                raise ValueError(
+                    f"spec_k {self.spec_k} exceeds the decode kernel's "
+                    f"verify width (spec_k + 1 <= {MAX_DECODE_Q_LEN})"
+                )
+            if self.temperature > 0.0:
+                # the acceptance rule is greedy-argmax identity; accepting
+                # drafts under sampling needs rejection sampling, which
+                # has not landed — refuse at parse, not mid-serve
+                raise ValueError(
+                    "speculative decoding (NEXUS_SPEC_K > 0) is greedy-only "
+                    "for now: temperature > 0 requires rejection sampling"
+                )
+            if self.spec_drafter not in DRAFTERS:
+                raise ValueError(
+                    f"unknown spec_drafter {self.spec_drafter!r}; use one "
+                    f"of {sorted(DRAFTERS)}"
+                )
+            if self.spec_draft_preset and self.spec_drafter != "model":
+                raise ValueError(
+                    "spec_draft_preset (NEXUS_SPEC_DRAFT_PRESET) only "
+                    "applies to spec_drafter='model'"
                 )
         if self.reload_check_interval_s and not self.checkpoint_dir:
             raise ValueError(
@@ -203,6 +250,9 @@ class ServeConfig:
             drain_grace_s=float(e.get("NEXUS_DRAIN_GRACE_S", "5.0")),
             page_size=int(e.get("NEXUS_PAGE_SIZE", "0")),
             kv_blocks=int(e.get("NEXUS_KV_BLOCKS", "0")),
+            spec_k=int(e.get("NEXUS_SPEC_K", "0")),
+            spec_drafter=e.get("NEXUS_SPEC_DRAFTER", "ngram"),
+            spec_draft_preset=e.get("NEXUS_SPEC_DRAFT_PRESET", ""),
             reload_check_interval_s=float(e.get("NEXUS_RELOAD_CHECK_S", "0")),
         )
 
@@ -508,9 +558,45 @@ def _serve_engine_loop(
         )
     else:
         executor = ModelExecutor(params, mcfg, **executor_kwargs)
+    drafter = None
+    if cfg.spec_k:
+        # speculative decoding (NEXUS_SPEC_K > 0, greedy-only — validated
+        # at parse): ngram needs no weights; the model drafter reuses the
+        # contiguous executor jits over the draft preset's weights (empty
+        # preset = self-draft with the serving params, the e2e smoke
+        # configuration whose acceptance is ~1.0 by construction)
+        from tpu_nexus.serving.speculative import ModelDrafter, NGramDrafter
+
+        if cfg.spec_drafter == "ngram":
+            drafter = NGramDrafter(cfg.batch_size)
+        else:
+            draft_params, draft_cfg = params, mcfg
+            if cfg.spec_draft_preset:
+                draft_adapter = get_adapter(cfg.spec_draft_preset)
+                draft_adapter = adapter_for(draft_adapter)
+                draft_cfg = draft_adapter.config
+                if draft_cfg.vocab_size != mcfg.vocab_size:
+                    # a draft over a different vocab proposes token ids
+                    # the target can't even embed — a config bug, not a
+                    # low-acceptance day
+                    raise ValueError(
+                        f"spec_draft_preset {cfg.spec_draft_preset!r} vocab "
+                        f"{draft_cfg.vocab_size} != serving model vocab "
+                        f"{mcfg.vocab_size}"
+                    )
+                draft_params = draft_adapter.init(
+                    jax.random.PRNGKey(cfg.seed)
+                )
+            draft_executor = ModelExecutor(
+                draft_params, draft_cfg,
+                **dict(executor_kwargs, kv_quant=""),
+            )
+            drafter = ModelDrafter(draft_executor)
     engine = ServingEngine(
         executor,
         scheduler=FifoScheduler(SchedulerConfig(max_queue=cfg.queue_limit)),
+        spec_k=cfg.spec_k,
+        drafter=drafter,
     )
 
     reporter.running()
@@ -637,6 +723,7 @@ def _serve_engine_loop(
     return {
         "requests": len(done),
         "finished": len(finished),
+        "spec_k": cfg.spec_k,
         "restored_from": restored_from,
         "serving_step": serving_step,
         # one source of truth for completed swaps: the engine's counter
