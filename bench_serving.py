@@ -31,6 +31,15 @@ same acceptance rate buys ~(1 + accepted/step) instead.  Artifact:
 ``NEXUS_SPEC_BENCH_K`` / ``NEXUS_SPEC_BENCH_GEN`` /
 ``NEXUS_SPEC_BENCH_REQUESTS``.
 
+``--overlap`` / ``--decode-steps`` (ISSUE 12) benches the HOST TAX: the
+same mixed-length request set through the synchronous k=1 engine, the
+overlapped-dispatch engine (decode step N+1 dispatched while N's tokens
+are in flight, deferred readback), and overlapped + in-jit multi-step
+decode (``lax.scan`` of k steps per dispatch) — greedy outputs asserted
+token-identical across all three modes, so the ratio is pure dispatch
+hiding.  Artifact: ``NEXUS_SERVING_ASYNC_OUT``, default
+BENCH_SERVING_ASYNC_r09.json.  Knob: ``NEXUS_OVERLAP_BENCH_STEPS``.
+
 ``--shared-prefix`` (ISSUE 6) instead benches the PAGED engine on the
 millions-of-users workload: one long system prompt, high fan-out, short
 unique tails.  Both engines get the SAME KV HBM budget (``slots ×
@@ -92,49 +101,71 @@ def bench_model() -> LlamaConfig:
     )
 
 
-def make_requests(rng):
+def make_requests(rng, n=None):
     reqs = []
-    for _ in range(N_REQUESTS):
-        n = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
+    for _ in range(N_REQUESTS if n is None else n):
+        plen = int(rng.integers(PROMPT_RANGE[0], PROMPT_RANGE[1] + 1))
         reqs.append(
             {
-                "prompt": rng.integers(1, 256, size=n).astype(np.int32),
+                "prompt": rng.integers(1, 256, size=plen).astype(np.int32),
                 "gen": int(rng.choice(GEN_CHOICES)),
             }
         )
     return reqs
 
 
-def run_engine_offline(params, cfg, requests):
-    """All requests queued at t=0: pure completed-tokens/s."""
-    executor = ModelExecutor(params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED)
-    engine = ServingEngine(executor)
-    # warmup: one request per prefill bucket in play + the decode step
-    for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
-        engine.submit(np.arange(1, width + 1, dtype=np.int32), 2)
-    engine.run_until_drained()
-    engine.metrics = ServingMetrics()
-    n_warm = len(engine.retired)
-
-    t0 = time.perf_counter()
-    for i, r in enumerate(requests):
-        engine.submit(r["prompt"], r["gen"], request_id=f"off-{i}")
-    engine.run_until_drained()
-    elapsed = time.perf_counter() - t0
-    done = engine.retired[n_warm:]
-    tokens = sum(
-        len(r.output_tokens) for r in done if r.state == RequestState.FINISHED
+def _mode_engine(params, cfg, overlap, decode_steps):
+    """One warmed-up engine in the requested dispatch mode (sync k=1 is
+    byte-for-byte the pre-ISSUE-12 loop — the before side of the bench)."""
+    executor = ModelExecutor(
+        params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED,
+        decode_steps=decode_steps,
     )
-    return tokens, elapsed, engine.steps
-
-
-def run_engine_poisson(params, cfg, requests, rng):
-    """Open-loop Poisson arrivals: the latency SLO view (TTFT/TPOT)."""
-    executor = ModelExecutor(params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED)
-    engine = ServingEngine(executor)
+    engine = ServingEngine(executor, overlap=overlap)
+    # warmup: one request per prefill bucket in play + the decode dispatch
     for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
         engine.submit(np.arange(1, width + 1, dtype=np.int32), 2)
     engine.run_until_drained()
+    return engine
+
+
+def run_engine_offline(params, cfg, requests, overlap=False, decode_steps=1, repeats=1):
+    """All requests queued at t=0: pure completed-tokens/s.  Returns the
+    per-request output streams too, so the overlap bench can assert the
+    new modes token-identical to the synchronous oracle.  ``repeats``
+    re-runs the measured pass and keeps the best timing (the overlap
+    bench's sub-second passes are noisy on a shared CI box); outputs of
+    EVERY repeat go into the identity check."""
+    engine = _mode_engine(params, cfg, overlap, decode_steps)
+    best = None
+    outputs = {}
+    for rep in range(repeats):
+        engine.metrics = ServingMetrics()
+        n_warm = len(engine.retired)
+        steps_before = engine.steps
+        t0 = time.perf_counter()
+        for i, r in enumerate(requests):
+            engine.submit(r["prompt"], r["gen"], request_id=f"off{rep}-{i}")
+        engine.run_until_drained()
+        elapsed = time.perf_counter() - t0
+        done = engine.retired[n_warm:]
+        tokens = sum(
+            len(r.output_tokens) for r in done if r.state == RequestState.FINISHED
+        )
+        # keyed by the FULL rep-qualified id: every repeat participates in
+        # the cross-mode identity check (a divergence in any repeat —
+        # e.g. state carried over the reused engine — must fail the
+        # assert, not be overwritten by a clean later repeat)
+        outputs.update((r.request_id, list(r.output_tokens)) for r in done)
+        run = (tokens, elapsed, engine.steps - steps_before)
+        if best is None or tokens / elapsed > best[0] / best[1]:
+            best = run
+    return (*best, outputs)
+
+
+def run_engine_poisson(params, cfg, requests, rng, overlap=False, decode_steps=1):
+    """Open-loop Poisson arrivals: the latency SLO view (TTFT/TPOT)."""
+    engine = _mode_engine(params, cfg, overlap, decode_steps)
     engine.metrics = metrics = ServingMetrics()
 
     offsets = np.cumsum(rng.exponential(1.0 / ARRIVAL_RPS, size=len(requests)))
@@ -454,13 +485,149 @@ def main_shared_prefix():
     print(json.dumps(result))
 
 
+# -- overlapped dispatch workload (ISSUE 12) -----------------------------------
+
+OVERLAP_DECODE_STEPS = int(os.environ.get("NEXUS_OVERLAP_BENCH_STEPS", "8"))
+OVERLAP_REQUESTS = int(os.environ.get("NEXUS_OVERLAP_BENCH_REQUESTS", "144"))
+
+
+def overlap_bench_model() -> LlamaConfig:
+    """DELIBERATELY dispatch-bound (the opposite of :func:`bench_model`'s
+    sizing note): the host-tax bench must measure the thing the refactor
+    removes, so the per-step device compute is made SMALL relative to the
+    fixed per-dispatch framework cost (~0.5 ms on this CPU backend).  On
+    real serving hardware this regime is the NORM, not a trick: a TPU
+    decode step for a small model is tens of microseconds of device time
+    behind the same fixed host dispatch cost."""
+    return LlamaConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, intermediate=128, max_seq_len=2 * MAX_LEN, remat=False,
+    )
+
+
+def main_overlap():
+    """``--overlap`` / ``--decode-steps``: the host-tax bench.  The SAME
+    mixed-length request set through the byte-identical synchronous k=1
+    engine (before) and the three new modes — overlapped dispatch alone,
+    in-jit multi-step decode alone, and both composed — with greedy
+    outputs asserted token-identical across ALL modes, so any speedup is
+    pure dispatch accounting, not different work.  TTFT/TPOT ride the
+    existing Poisson driver for the before and after modes.
+
+    Honest framing: on this CPU backend the "device" executes on the host
+    cores, so DEFERRED READBACK alone cannot win (there is no independent
+    device to overlap with — expect overlap ~<= 1x here; its payoff needs
+    genuinely asynchronous hardware).  What CPU CAN measure is the
+    k-step scan amortizing the fixed per-dispatch cost k-fold — the same
+    fixed cost a TPU host pays per step — so the multistep ratios below
+    are the honest CPU-observable floor of the host-tax removal."""
+    rng = np.random.default_rng(SEED)
+    cfg = overlap_bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+    requests = make_requests(rng, n=OVERLAP_REQUESTS)
+
+    modes = {
+        "sync": dict(overlap=False, decode_steps=1),
+        "overlap": dict(overlap=True, decode_steps=1),
+        "multistep": dict(overlap=False, decode_steps=OVERLAP_DECODE_STEPS),
+        "overlap_multistep": dict(overlap=True, decode_steps=OVERLAP_DECODE_STEPS),
+    }
+    offline = {}
+    outputs = {}
+    for name, kw in modes.items():
+        tokens, elapsed, steps, outs = run_engine_offline(
+            params, cfg, requests, repeats=3, **kw
+        )
+        offline[name] = {
+            "tokens": tokens,
+            "elapsed_s": round(elapsed, 4),
+            "engine_steps": steps,
+            "tokens_per_second": round(tokens / elapsed, 2) if elapsed else 0.0,
+        }
+        outputs[name] = outs
+    for name in ("overlap", "multistep", "overlap_multistep"):
+        assert outputs[name] == outputs["sync"], (
+            f"{name} outputs diverge from the synchronous oracle"
+        )
+
+    poisson = {
+        name: run_engine_poisson(
+            params, cfg, requests, np.random.default_rng(SEED + 1), **modes[name]
+        )
+        for name in ("sync", "overlap_multistep")
+    }
+    base_tps = offline["sync"]["tokens_per_second"]
+
+    def ratio(name):
+        return (
+            round(offline[name]["tokens_per_second"] / base_tps, 3)
+            if base_tps
+            else 0.0
+        )
+
+    best_mode = max(
+        ("overlap", "multistep", "overlap_multistep"), key=ratio
+    )
+    result = {
+        "metric": "overlapped_engine_tokens_per_second_ratio",
+        # the headline: the best NEW mode vs the synchronous loop.  On
+        # this CPU backend that is multistep (see note); on async
+        # hardware the composition is the expected winner.
+        "value": ratio(best_mode),
+        "best_mode": best_mode,
+        "unit": "x_tokens_per_second_vs_sync_engine",
+        "decode_steps": OVERLAP_DECODE_STEPS,
+        "overlap_only_ratio": ratio("overlap"),
+        "multistep_only_ratio": ratio("multistep"),
+        "overlap_multistep_ratio": ratio("overlap_multistep"),
+        "token_identical": True,  # asserted above, across all four modes
+        "offline": offline,
+        "poisson": {
+            name: {
+                "arrival_rps": ARRIVAL_RPS,
+                "ttft_p50_s": round(p["ttft_p50_s"], 5),
+                "ttft_p99_s": round(p["ttft_p99_s"], 5),
+                "tpot_p50_s": round(p["tpot_p50_s"], 5),
+                "tpot_p99_s": round(p["tpot_p99_s"], 5),
+            }
+            for name, p in poisson.items()
+        },
+        "workload": {
+            "requests": OVERLAP_REQUESTS,
+            "slots": NUM_SLOTS,
+            "prompt_len_range": list(PROMPT_RANGE),
+            "gen_tokens_choices": list(GEN_CHOICES),
+            "best_of": 3,
+        },
+        "note": (
+            "dispatch-bound CPU bench (model sized so fixed per-dispatch "
+            "cost dominates device compute — the normal TPU serving "
+            "regime).  The k-step in-jit scan amortizes that fixed cost "
+            "k-fold: the CPU-observable win.  Deferred readback (overlap) "
+            "alone CANNOT win on CPU — the 'device' runs on the host "
+            "cores, so there is nothing independent to overlap with; its "
+            "~0.7-0.8x here prices the pipeline bookkeeping + one-step-"
+            "late slot refill, and its payoff needs genuinely async "
+            "hardware.  Composed, overlap costs a slice of the multistep "
+            "win on CPU for the same reason."
+        ),
+        "seed": SEED,
+        "model": "llama-overlap-2L-h64 (dispatch-bound by design)",
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_SERVING_ASYNC_OUT", "BENCH_SERVING_ASYNC_r09.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     rng = np.random.default_rng(SEED)
     cfg = bench_model()
     params = llama_init(jax.random.PRNGKey(SEED), cfg)
     requests = make_requests(rng)
 
-    engine_tokens, engine_s, engine_steps = run_engine_offline(params, cfg, requests)
+    engine_tokens, engine_s, engine_steps, _ = run_engine_offline(params, cfg, requests)
     lock_tokens, lock_s = run_lockstep(params, cfg, requests)
     poisson = run_engine_poisson(params, cfg, requests, rng)
 
@@ -501,5 +668,7 @@ if __name__ == "__main__":
         main_shared_prefix()
     elif "--spec-k" in sys.argv[1:]:
         main_speculative()
+    elif "--overlap" in sys.argv[1:] or "--decode-steps" in sys.argv[1:]:
+        main_overlap()
     else:
         main()

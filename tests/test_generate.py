@@ -287,3 +287,107 @@ def test_decode_unrolled_matches_scan_exactly():
                     np.asarray(c_un[key]), np.asarray(c_sc[key]),
                     rtol=1e-5, atol=1e-5, err_msg=str((key, kv_quant, ragged)),
                 )
+
+
+class TestDecodeScan:
+    """In-jit multi-step decode (ISSUE 12): the k-step lax.scan must equal
+    k sequential jitted decode_steps, freeze rows on budget/stop without
+    corrupting their live KV, and validate its inputs."""
+
+    @pytest.fixture(scope="class")
+    def scan_setup(self):
+        import functools
+
+        from tpu_nexus.models.generate import decode_scan
+
+        cfg = dataclasses.replace(LlamaConfig.tiny(), vocab_size=64)
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        B, S, T = 3, 8, 6
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (B, S), 1, cfg.vocab_size)
+        cache, logits = prefill(params, prompt, cfg, max_len=S + T)
+        first = jnp.argmax(logits, -1).astype(jnp.int32)
+        step1 = jax.jit(lambda c, t, p: decode_step(params, c, t, p, cfg))
+        seq = [np.asarray(first)]
+        c, tok, pos = cache, first, jnp.full((B,), S, jnp.int32)
+        for _ in range(T - 1):
+            lg, c = step1(c, tok, pos)
+            tok = jnp.argmax(lg, -1).astype(jnp.int32)
+            seq.append(np.asarray(tok))
+            pos = pos + 1
+        scan = jax.jit(
+            functools.partial(decode_scan, cfg=cfg, num_steps=5),
+            static_argnames=("stop_token",),
+        )
+        return cfg, params, cache, first, np.stack(seq, 1), step1, scan, S
+
+    def test_ragged_budgets_match_sequential(self, scan_setup):
+        cfg, params, cache, first, seq, step1, scan, S = scan_setup
+        B = first.shape[0]
+        limits = np.array([5, 2, 3], np.int32)
+        toks, counts, last_tok, last_pos, _ = scan(
+            params, cache, first, jnp.full((B,), S, jnp.int32), jnp.asarray(limits)
+        )
+        toks, counts = np.asarray(toks), np.asarray(counts)
+        np.testing.assert_array_equal(counts, np.minimum(limits, 5))
+        for b in range(B):
+            np.testing.assert_array_equal(
+                toks[b, : counts[b]], seq[b, 1 : 1 + counts[b]]
+            )
+        # the carries continue the stream: last REAL token + next position
+        for b in range(B):
+            assert int(np.asarray(last_tok)[b]) == seq[b, counts[b]]
+        np.testing.assert_array_equal(np.asarray(last_pos), S + counts)
+
+    def test_frozen_rows_leave_live_kv_bit_clean(self, scan_setup):
+        """A frozen row's suppressed writes must not touch its live rows:
+        continuing from the scan cache equals continuing from a reference
+        cache that never over-decoded."""
+        cfg, params, cache, first, seq, step1, scan, S = scan_setup
+        B = first.shape[0]
+        limits = np.array([5, 2, 3], np.int32)
+        _, counts, last_tok, last_pos, c2 = scan(
+            params, cache, first, jnp.full((B,), S, jnp.int32), jnp.asarray(limits)
+        )
+        counts = np.asarray(counts)
+        lg2, _ = step1(c2, last_tok, last_pos)
+        got = np.asarray(jnp.argmax(lg2, -1))
+        for b in range(B):
+            cc, tok, pos = cache, first, jnp.full((B,), S, jnp.int32)
+            for _ in range(int(counts[b])):
+                lg, cc = step1(cc, tok, pos)
+                tok = jnp.argmax(lg, -1).astype(jnp.int32)
+                pos = pos + 1
+            want = np.asarray(jnp.argmax(step1(cc, tok, pos)[0], -1))
+            assert got[b] == want[b], b
+
+    def test_stop_token_freezes_in_device(self, scan_setup):
+        cfg, params, cache, first, seq, step1, scan, S = scan_setup
+        B = first.shape[0]
+        stop = int(seq[0, 2])
+        toks, counts, _, _, _ = scan(
+            params, cache, first, jnp.full((B,), S, jnp.int32),
+            jnp.full((B,), 5, jnp.int32), stop_token=stop,
+        )
+        toks, counts = np.asarray(toks), np.asarray(counts)
+        for b in range(B):
+            hit = np.where(seq[b, 1:6] == stop)[0]
+            expect = (hit[0] + 1) if hit.size else 5
+            assert counts[b] == expect, b
+            if hit.size:
+                assert toks[b, counts[b] - 1] == stop
+
+    def test_validation(self, scan_setup):
+        from tpu_nexus.models.generate import decode_scan
+
+        cfg, params, cache, first, seq, step1, scan, S = scan_setup
+        B = first.shape[0]
+        with pytest.raises(ValueError, match="num_steps"):
+            decode_scan(
+                params, cache, first, jnp.full((B,), S, jnp.int32),
+                jnp.full((B,), 1, jnp.int32), cfg, num_steps=0,
+            )
+        with pytest.raises(ValueError, match="write_mask.*per-slot"):
+            decode_step(
+                params, cache, first, jnp.asarray(S, jnp.int32), cfg,
+                write_mask=jnp.ones((B,), bool),
+            )

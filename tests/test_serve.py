@@ -234,3 +234,56 @@ class TestServeEngine:
         summary = run_serve_engine(cfg, store=store, ctx=CTX)
         assert summary["restored_from"] == 2
         assert store.read_checkpoint(CTX.algorithm, CTX.run_id).lifecycle_stage == LifecycleStage.COMPLETED
+
+
+class TestOverlapConfig:
+    """NEXUS_OVERLAP / NEXUS_DECODE_STEPS / NEXUS_STOP_TOKEN (ISSUE 12)."""
+
+    def test_overlap_env_parsed(self):
+        env = {
+            "NEXUS_MODEL_PRESET": "tiny",
+            "NEXUS_OVERLAP": "1",
+            "NEXUS_DECODE_STEPS": "4",
+            "NEXUS_STOP_TOKEN": "7",
+        }
+        cfg = ServeConfig.from_env(env)
+        assert cfg.overlap_dispatch is True
+        assert (cfg.decode_steps, cfg.stop_token) == (4, 7)
+        assert ServeConfig.from_env({"NEXUS_MODEL_PRESET": "tiny"}).overlap_dispatch is False
+        assert ServeConfig.from_env(
+            {"NEXUS_MODEL_PRESET": "tiny", "NEXUS_OVERLAP": "0"}
+        ).overlap_dispatch is False
+
+    def test_decode_steps_validation(self):
+        with pytest.raises(ValueError, match="decode_steps"):
+            ServeConfig(decode_steps=0)
+        with pytest.raises(ValueError, match="stop_token"):
+            ServeConfig(stop_token=-2)
+
+    def test_spec_k_mutually_exclusive_with_overlap_and_multistep(self):
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServeConfig(spec_k=2, overlap_dispatch=True)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServeConfig(spec_k=2, decode_steps=3)
+        with pytest.raises(ValueError, match="stop_token"):
+            ServeConfig(spec_k=2, stop_token=5)
+        # each alone is fine
+        assert ServeConfig(spec_k=2).spec_k == 2
+        assert ServeConfig(overlap_dispatch=True, decode_steps=3).decode_steps == 3
+
+    def test_overlap_engine_ledger_protocol(self):
+        """NEXUS_OVERLAP + NEXUS_DECODE_STEPS through the full serve loop:
+        same ledger contract, all requests finish, throughput recorded."""
+        store = _seeded_store()
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=6, rounds=2, heartbeat_every=2,
+            overlap_dispatch=True, decode_steps=3,
+        )
+        summary = run_serve_engine(cfg, store=store, ctx=CTX)
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED
+        assert summary["requests"] == summary["finished"] == 4
+        assert summary["tokens_out"] == 4 * 6
+        assert summary["decoded_tokens_per_second"] > 0
+        assert summary["tpot_p50_s"] > 0  # mean-preserving batched samples

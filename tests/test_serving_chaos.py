@@ -744,3 +744,196 @@ class TestDrainLedger:
         assert summary["finished"] == summary["requests"] == 2
         row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
         assert row.lifecycle_stage == LifecycleStage.COMPLETED
+
+
+# -- deferred-dispatch chaos (ISSUE 12) ------------------------------------------
+
+
+class TestDeferredDispatchFaults:
+    """Faults under overlapped dispatch surface at the DEFERRED
+    materialization — exactly one step late — with the same
+    one-fault-one-request contract as the synchronous loop."""
+
+    def _engine(self, executor, decode_steps=1):
+        policy = StepFaultPolicy(sleep=lambda s: None, rng=random.Random(0))
+        return ServingEngine(
+            executor,
+            scheduler=FifoScheduler(SchedulerConfig()),
+            metrics=ServingMetrics(),
+            fault_policy=policy,
+            clock=StepClock(),
+            overlap=True,
+        )
+
+    def _drive(self, eng, max_steps=2000):
+        while eng.has_work:
+            assert eng.steps < max_steps, "engine did not drain"
+            eng.step()
+            eng.slots.verify_consistent()
+            eng._pipeline.verify_consistent()
+
+    def test_hbm_oom_surfaces_one_step_late_retiring_dispatch_youngest(self):
+        fake = FakeExecutor(2, 64)
+        faulty = FaultyExecutor(fake, "step-hbm-oom", at_step=1)
+        eng = self._engine(faulty)
+        a = eng.submit(np.array([10]), 8)
+        b = eng.submit(np.array([20]), 8)
+        eng.step()  # dispatch #0 rides ahead
+        eng.step()  # dispatch #1 faults AT THE CALL — held on the pending
+        assert a.state == RequestState.DECODING
+        assert b.state == RequestState.DECODING  # nothing surfaced yet
+        eng.step()  # the deferred materialization surfaces the fault
+        assert b.state == RequestState.FAILED  # dispatch-time youngest
+        assert b.cause == "hbm-oom"
+        assert a.state != RequestState.FAILED
+        self._drive(eng)
+        assert a.state == RequestState.FINISHED
+        assert a.output_tokens == [11 + i for i in range(8)]  # survivor exact
+        assert eng.metrics.step_faults == {"hbm-oom": 1}
+        assert eng.metrics.retired_causes == {"hbm-oom": 1}
+        assert eng.slots.free_count == 2 and eng._pipeline.depth == 0
+
+    def test_transient_ici_heals_at_materialization(self):
+        fake = FakeExecutor(2, 64)
+        faulty = FaultyExecutor(fake, "step-ici", at_step=1, times=2)
+        eng = self._engine(faulty)
+        a = eng.submit(np.array([10]), 6)
+        b = eng.submit(np.array([20]), 6)
+        self._drive(eng)
+        assert a.state == b.state == RequestState.FINISHED
+        assert a.output_tokens == [11 + i for i in range(6)]
+        assert b.output_tokens == [21 + i for i in range(6)]
+        assert eng.metrics.step_faults == {}  # healed, nobody retired
+        assert eng.metrics.step_retries >= 1
+        assert eng.fault_policy.faults_seen >= 1
+
+    def test_device_state_lost_fails_batch_and_clears_the_pipeline(self):
+        from tpu_nexus.serving import DeviceStateLost
+        from tpu_nexus.workload.faults import MSG_ICI
+
+        class StateLosingScanExecutor(FakeExecutor):
+            def __init__(self, num_slots, max_len, lose_at):
+                super().__init__(num_slots, max_len)
+                self.lose_at = lose_at
+                self.scan_count = 0
+
+            def step_scan(self, *args, **kwargs):
+                call = self.scan_count
+                self.scan_count += 1
+                if call == self.lose_at:
+                    raise DeviceStateLost(RuntimeError(MSG_ICI))
+                return super().step_scan(*args, **kwargs)
+
+        eng = self._engine(StateLosingScanExecutor(2, 64, lose_at=2))
+        doomed = [eng.submit(np.array([5 * (i + 1)]), 10) for i in range(2)]
+        later = eng.submit(np.array([30]), 4)  # queued behind the batch
+        self._drive(eng)
+        for r in doomed:
+            assert r.state == RequestState.FAILED
+            assert r.cause == "ici-link-failure"
+        assert later.state == RequestState.FINISHED
+        assert later.output_tokens == [31 + i for i in range(4)]
+        assert eng.slots.free_count == 2
+        assert eng._pipeline.depth == 0 and eng._pipeline.deferred_slots == 0
+
+    def test_held_device_loss_resolves_before_next_admission(self):
+        """A DeviceStateLost captured at dispatch must materialize at the
+        TOP of the next step — BEFORE admission — or a request admitted in
+        the gap prefills against the silently-reinstalled (zeroed) cache
+        and is then wrongly failed by _fail_batch despite the device being
+        healthy again (review finding on the phase ordering)."""
+        from tpu_nexus.serving import DeviceStateLost
+        from tpu_nexus.workload.faults import MSG_ICI
+
+        class StateLosingScanExecutor(FakeExecutor):
+            def __init__(self, num_slots, max_len, lose_at):
+                super().__init__(num_slots, max_len)
+                self.lose_at = lose_at
+                self.scan_count = 0
+
+            def step_scan(self, *args, **kwargs):
+                call = self.scan_count
+                self.scan_count += 1
+                if call == self.lose_at:
+                    raise DeviceStateLost(RuntimeError(MSG_ICI))
+                return super().step_scan(*args, **kwargs)
+
+        eng = self._engine(StateLosingScanExecutor(2, 64, lose_at=1))
+        doomed = eng.submit(np.array([10]), 8)
+        eng.step()  # dispatch #0 rides ahead
+        eng.step()  # dispatch #1 raises DeviceStateLost — HELD
+        late = eng.submit(np.array([30]), 4)  # arrives while the fault is held
+        eng.step()  # fault resolves FIRST, then admission sees clean state
+        assert doomed.state == RequestState.FAILED
+        assert doomed.cause == "ici-link-failure"
+        assert late.state != RequestState.FAILED  # never caught in the blast
+        self._drive(eng)
+        assert late.state == RequestState.FINISHED
+        assert late.output_tokens == [31 + i for i in range(4)]
+
+    def test_real_model_deferred_fault_survivors_match_generate(self):
+        """The HBM-OOM drill against the REAL jitted scan path, overlap +
+        decode_steps=2: the implicated request retires one step late, and
+        every survivor's greedy tokens stay identical to one-shot
+        ``generate`` (the deferred retry must not corrupt the cache)."""
+        import jax
+        import jax.numpy as jnp
+
+        from tpu_nexus.models import LlamaConfig
+        from tpu_nexus.models.generate import generate
+        from tpu_nexus.models.llama import llama_init
+        from tpu_nexus.serving import ModelExecutor
+
+        cfg = LlamaConfig.tiny()
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        B, S, T = 3, 8, 6
+        rng = np.random.default_rng(13)
+        prompts = rng.integers(1, cfg.vocab_size, size=(B, S)).astype(np.int32)
+        executor = ModelExecutor(
+            params, cfg, num_slots=B, max_len=S + T, decode_steps=2
+        )
+        faulty = FaultyExecutor(executor, "step-hbm-oom", at_step=1)
+        eng = self._engine(faulty)
+        reqs = [eng.submit(prompts[i], T, request_id=f"r{i}") for i in range(B)]
+        self._drive(eng)
+        assert reqs[2].state == RequestState.FAILED  # youngest implicated
+        assert reqs[2].cause == "hbm-oom"
+        for i in (0, 1):
+            assert reqs[i].state == RequestState.FINISHED
+            solo = np.asarray(
+                generate(
+                    params, jnp.asarray(prompts[i : i + 1]), cfg,
+                    max_new_tokens=T, max_len=S + T,
+                )
+            )[0]
+            np.testing.assert_array_equal(np.asarray(reqs[i].output_tokens), solo)
+
+    def test_overlap_drain_ledger_lands_preempted_with_all_terminal(self):
+        """The deferred drain/SIGTERM acceptance at the serve-loop level:
+        a lifecycle cancel mid-serve in OVERLAP mode still lands an honest
+        PREEMPTED row, every request terminal, and the fence means no
+        in-flight token was silently dropped before the drain decisions."""
+        from tpu_nexus.workload.serve import run_serve_engine
+
+        store = _seeded_store()
+        lifecycle = LifecycleContext()
+        cfg = _serve_cfg(overlap_dispatch=True, decode_steps=2, gen_tokens=24)
+
+        def prompts():
+            rng = np.random.default_rng(3)
+            n = 0
+            while True:
+                if n == 2:  # warmup batch + round-1 batch delivered
+                    lifecycle.cancel(reason="SIGTERM")
+                yield rng.integers(1, 64, size=(cfg.batch_size, cfg.prompt_len))
+                n += 1
+
+        summary = run_serve_engine(
+            cfg, store=store, ctx=CTX, prompts=prompts(), lifecycle=lifecycle
+        )
+        assert summary["drained"] is True
+        row = store.read_checkpoint(CTX.algorithm, CTX.run_id)
+        assert row.lifecycle_stage == LifecycleStage.PREEMPTED
+        assert "SIGTERM" in row.algorithm_failure_cause
+        details = json.loads(row.algorithm_failure_details)
+        assert sum(details["retired_causes"].values()) == summary["requests"]

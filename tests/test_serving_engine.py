@@ -42,12 +42,24 @@ from tpu_nexus.serving.engine import RETIREMENT_ACTIONS, _prefill_buckets
 class FakeExecutor:
     """Deterministic device stand-in: first token = last prompt token + 1,
     every decode step increments.  Lets the invariant fuzzer run hundreds
-    of scenarios without compiling anything."""
+    of scenarios without compiling anything.
 
-    def __init__(self, num_slots: int, max_len: int) -> None:
+    ``step_scan`` mirrors the real executors' deferred/multi-step contract
+    (ISSUE 12) in plain numpy — merge host overrides over the previous
+    call's carries, emit up to ``limits[b]`` incrementing tokens per row,
+    freeze on ``stop_token`` — so the overlap fuzz runs the SAME engine
+    code paths without compiling anything."""
+
+    def __init__(
+        self, num_slots: int, max_len: int, decode_steps: int = 1,
+        stop_token: int = -1,
+    ) -> None:
         self.num_slots = num_slots
         self.max_len = max_len
+        self.decode_steps = decode_steps
+        self.stop_token = stop_token
         self.begins = []  # (slot, prompt_len) audit trail
+        self.scan_calls = 0
 
     def begin(self, slot, prompt):
         self.begins.append((slot, len(prompt)))
@@ -55,6 +67,26 @@ class FakeExecutor:
 
     def step(self, tokens, cursors):
         return np.asarray(tokens) + 1
+
+    def step_scan(self, prev_tokens, prev_cursors, override, tokens, cursors, limits, *args):
+        self.scan_calls += 1
+        tok = np.where(override, tokens, prev_tokens).astype(np.int64)
+        pos = np.where(override, cursors, prev_cursors).astype(np.int64)
+        limits = np.asarray(limits)
+        k = self.decode_steps
+        toks = np.zeros((self.num_slots, k), np.int64)
+        counts = np.zeros(self.num_slots, np.int64)
+        alive = np.ones(self.num_slots, bool)
+        for i in range(k):
+            active = alive & (counts < limits)
+            nxt = tok + 1
+            toks[:, i] = np.where(active, nxt, tok)
+            tok = np.where(active, nxt, tok)
+            if self.stop_token >= 0:
+                alive &= ~(active & (nxt == self.stop_token))
+            counts += active
+            pos += active
+        return toks, counts, tok, pos
 
 
 def make_engine(num_slots=2, max_len=64, sched_cfg=None, metrics=None):

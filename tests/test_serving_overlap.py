@@ -1,0 +1,416 @@
+"""Overlapped dispatch + in-jit multi-step decode (ISSUE 12).
+
+Layers, cheapest first:
+
+* DispatchPipeline ledger units (serving/overlap.py);
+* engine behavior against the deterministic FakeExecutor: one-step-late
+  materialization, slot refill, deferred drain ("no request may lose its
+  final in-flight token"), mode validation;
+* a seeded fuzz: random traffic × random cancels × {overlap} ×
+  {decode_steps}, asserting after EVERY step that slot AND pipeline
+  accounting are consistent, and at the end that every request is
+  terminal and every non-cancelled output equals the synchronous oracle
+  run of the same schedule;
+* the token-identity gate: real-model greedy outputs of the new engine
+  modes pinned token-identical to one-shot ``generate`` across
+  {contiguous, paged} × {bf16, int8-KV} × {xla, pallas-interpret}
+  (pallas rows in f32 — the PR 6 near-tie precedent: the reordering is
+  layout noise, not a semantics difference), plus in-device stop-token
+  detection against the sync oracle.
+"""
+
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.serving import (
+    DispatchPipeline,
+    ModelExecutor,
+    PagedModelExecutor,
+    PendingStep,
+    PipelineError,
+    RequestState,
+    ServingEngine,
+)
+
+from tests.test_serving_engine import FakeExecutor
+
+
+def make_engine(num_slots=2, max_len=64, decode_steps=1, overlap=True, stop_token=-1):
+    fake = FakeExecutor(
+        num_slots, max_len, decode_steps=decode_steps, stop_token=stop_token
+    )
+    return ServingEngine(fake, overlap=overlap)
+
+
+def drive(eng, max_steps=2000):
+    while eng.has_work:
+        assert eng.steps < max_steps, "engine did not drain"
+        eng.step()
+        eng.slots.verify_consistent()
+        eng._pipeline.verify_consistent()
+
+
+def expected_tokens(prompt, n):
+    first = (int(prompt[-1]) + 1) % 1000
+    return [first + i for i in range(n)]
+
+
+# -- DispatchPipeline ledger units ---------------------------------------------
+
+
+class TestDispatchPipeline:
+    def _pending(self, slots, assumed):
+        return PendingStep(
+            thunk=lambda: None,
+            snapshot={s: object() for s in slots},
+            order=list(slots),
+            cursor_base=np.zeros(4, np.int64),
+            assumed=np.asarray(assumed),
+        )
+
+    def test_push_credits_inflight_and_clears_overrides(self):
+        pipe = DispatchPipeline(4)
+        pipe.note_override(1)
+        p = self._pending([0, 1], [2, 3, 0, 0])
+        pipe.push(p)
+        assert pipe.overridden == set()
+        assert list(pipe.inflight) == [2, 3, 0, 0]
+        assert pipe.deferred_slots == 2
+        pipe.credit(p, 0)
+        pipe.credit(p, 1)
+        assert pipe.deferred_slots == 0
+        pipe.verify_consistent()
+
+    def test_note_retired_zeroes_and_overrides(self):
+        pipe = DispatchPipeline(4)
+        pipe.push(self._pending([2], [0, 0, 5, 0]))
+        pipe.note_retired(2)
+        assert pipe.inflight[2] == 0
+        assert 2 in pipe.overridden
+        assert pipe.override_mask().tolist() == [False, False, True, False]
+
+    def test_pop_empty_raises(self):
+        with pytest.raises(PipelineError, match="no pending"):
+            DispatchPipeline(2).pop()
+
+    def test_verify_catches_stray_inflight(self):
+        pipe = DispatchPipeline(2)
+        pipe.inflight[1] = 3  # budget with no covering dispatch
+        with pytest.raises(PipelineError, match="no pending dispatch"):
+            pipe.verify_consistent()
+
+    def test_verify_catches_depth_runaway(self):
+        pipe = DispatchPipeline(2)
+        for _ in range(3):
+            pipe.push(self._pending([], [0, 0]))
+        with pytest.raises(PipelineError, match="depth"):
+            pipe.verify_consistent()
+
+    def test_clear_resets_everything(self):
+        pipe = DispatchPipeline(2)
+        pipe.push(self._pending([0], [4, 0]))
+        pipe.note_override(1)
+        pipe.clear()
+        assert pipe.depth == 0 and pipe.deferred_slots == 0
+        assert pipe.overridden == set()
+
+
+# -- engine behavior against the fake ------------------------------------------
+
+
+class TestOverlappedEngine:
+    def test_finishes_with_identical_tokens(self):
+        eng = make_engine()
+        req = eng.submit(np.array([7]), 5)
+        drive(eng)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == expected_tokens([7], 5)
+
+    def test_materialization_is_one_step_late(self):
+        eng = make_engine()
+        req = eng.submit(np.array([7]), 4)
+        eng.step()  # admit + first token + dispatch #1 (nothing materialized)
+        assert len(req.output_tokens) == 1
+        assert eng._pipeline.depth == 1 and eng._pipeline.deferred_slots == 1
+        eng.step()  # dispatch #2, materialize #1
+        assert len(req.output_tokens) == 2
+        assert eng.metrics.deferred_slots == 1
+
+    def test_sync_mode_never_uses_the_pipeline(self):
+        eng = make_engine(overlap=False, decode_steps=1)
+        eng.submit(np.array([7]), 5)
+        drive(eng)
+        assert eng.executor.scan_calls == 0
+        assert eng._pipeline.depth == 0 and eng._pipeline.deferred_slots == 0
+
+    def test_multistep_amortizes_dispatches(self):
+        eng = make_engine(decode_steps=4, overlap=False)
+        req = eng.submit(np.array([7]), 9)  # 1 prefill token + 8 scanned
+        drive(eng)
+        assert req.output_tokens == expected_tokens([7], 9)
+        assert eng.executor.scan_calls == 2  # ceil(8 / 4), not 8
+
+    def test_deferred_drain_keeps_the_final_in_flight_token(self):
+        """The drain/SIGTERM acceptance: a request whose FINAL token is
+        riding an unmaterialized dispatch must finish, not evict, even at
+        zero grace — the fence materializes before any drain decision."""
+        eng = make_engine()
+        req = eng.submit(np.array([7]), 3)
+        eng.step()  # token 1 (prefill) + dispatch carrying token 2
+        eng.step()  # dispatch token 3, materialize token 2
+        assert len(req.output_tokens) == 2
+        assert eng._pipeline.deferred_slots == 1  # the FINAL token in flight
+        summary = eng.drain(grace_s=0.0)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == expected_tokens([7], 3)
+        assert summary["drain_evicted"] == 0
+        assert eng._pipeline.depth == 0
+
+    def test_cancel_between_dispatch_and_materialize_skips_the_lane(self):
+        eng = make_engine()
+        a = eng.submit(np.array([7]), 8)
+        b = eng.submit(np.array([17]), 8)
+        eng.step()
+        eng.step()
+        frozen = len(a.output_tokens)
+        eng.cancel(a.request_id)
+        eng.step()  # cancel sweep retires a; pending lane for a is skipped
+        assert a.state == RequestState.CANCELLED
+        assert len(a.output_tokens) == frozen  # nothing emitted post-cancel
+        drive(eng)
+        assert b.state == RequestState.FINISHED
+        assert b.output_tokens == expected_tokens([17], 8)
+
+    def test_slot_refill_overrides_the_device_carry(self):
+        """A freed slot's next tenant must decode from ITS OWN first token,
+        not the previous tenant's stale device carry."""
+        eng = make_engine(num_slots=1)
+        a = eng.submit(np.array([7]), 3)
+        b = eng.submit(np.array([307]), 3)
+        drive(eng)
+        assert a.output_tokens == expected_tokens([7], 3)
+        assert b.output_tokens == expected_tokens([307], 3)
+
+    def test_stop_token_freezes_and_finishes(self):
+        prompt = np.array([7])
+        stop = expected_tokens(prompt, 9)[3]
+        eng = make_engine(decode_steps=3, stop_token=stop)
+        req = eng.submit(prompt, 9)
+        drive(eng)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == expected_tokens(prompt, 4)  # stop emitted
+
+    def test_stop_token_on_first_token_finishes_at_admission(self):
+        prompt = np.array([7])
+        stop = (int(prompt[-1]) + 1) % 1000
+        eng = make_engine(stop_token=stop)
+        req = eng.submit(prompt, 9)
+        drive(eng)
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == [stop]
+
+    def test_spec_and_overlap_mutually_exclusive(self):
+        fake = FakeExecutor(2, 64)
+        with pytest.raises(ValueError, match="mutually exclusive"):
+            ServingEngine(fake, spec_k=2, drafter=object(), overlap=True)
+
+    def test_overlap_requires_step_scan(self):
+        class Bare:
+            num_slots, max_len = 2, 64
+
+        with pytest.raises(ValueError, match="step_scan"):
+            ServingEngine(Bare(), overlap=True)
+
+    def test_quiesce_and_swap_fence_the_pipeline(self):
+        eng = make_engine()
+        req = eng.submit(np.array([7]), 6)
+        eng.step()
+        eng.step()
+        assert eng._pipeline.depth == 1
+        eng.quiesce(grace_s=1000.0, max_steps=100)
+        assert eng._pipeline.depth == 0
+        assert req.state == RequestState.FINISHED
+        assert req.output_tokens == expected_tokens([7], 6)
+        eng.swap_params = eng.swap_params  # the engine-level seam
+        eng.resume_admission()
+
+
+# -- fuzz: overlap/multi-step vs the synchronous oracle -------------------------
+
+
+def _run_schedule(overlap, decode_steps, seed):
+    rng = random.Random(seed)
+    n_requests = rng.randint(3, 10)
+    specs = [
+        (rng.randint(1, 900), rng.randint(1, 12)) for _ in range(n_requests)
+    ]
+    cancel_at = {
+        i: rng.randint(1, 6) for i in range(n_requests) if rng.random() < 0.25
+    }
+    eng = make_engine(
+        num_slots=rng.choice([1, 2, 3]), decode_steps=decode_steps,
+        overlap=overlap,
+    )
+    reqs = []
+    step = 0
+    submitted = 0
+    while submitted < n_requests or eng.has_work:
+        while submitted < n_requests and rng.random() < 0.7:
+            tok, gen = specs[submitted]
+            reqs.append(eng.submit(np.array([tok]), gen))
+            submitted += 1
+        for i, r in enumerate(reqs):
+            if cancel_at.get(i) == step:
+                eng.cancel(r.request_id)
+        eng.step()
+        eng.slots.verify_consistent()
+        eng._pipeline.verify_consistent()
+        step += 1
+        assert step < 1000, "fuzz engine did not drain"
+    return specs, reqs
+
+
+@pytest.mark.parametrize("decode_steps", [1, 3])
+def test_overlap_fuzz_matches_oracle(decode_steps):
+    """Random traffic + random cancels: every request terminal, pipeline
+    drained, and non-cancelled outputs EXACTLY the deterministic fake's
+    sequence — one-step-late materialization loses and invents nothing."""
+    for seed in range(12):
+        specs, reqs = _run_schedule(True, decode_steps, seed)
+        for (tok, gen), req in zip(specs, reqs):
+            assert req.is_terminal()
+            full = expected_tokens([tok], gen)
+            if req.state == RequestState.FINISHED:
+                assert req.output_tokens == full, (seed, req.request_id)
+            else:  # cancelled mid-flight: a clean prefix, never garbage
+                assert req.state == RequestState.CANCELLED
+                assert req.output_tokens == full[: len(req.output_tokens)]
+
+
+# -- token-identity gate: real model, all layouts/dtypes/kernels ---------------
+
+
+def _interpret_works() -> bool:
+    from tpu_nexus.ops.decode_attention import decode_attention
+
+    try:
+        q = jnp.ones((1, 1, 2, 8), jnp.float32)
+        kv = jnp.ones((1, 16, 2, 8), jnp.float32)
+        decode_attention(q, kv, kv, jnp.asarray(4, jnp.int32), interpret=True)
+        return True
+    except Exception:  # noqa: BLE001 - any interpreter failure means "skip env"
+        return False
+
+
+_CAN_INTERPRET = _interpret_works()
+
+CFG = LlamaConfig.tiny()
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+# pallas rows run f32 — the PR 6 precedent: the kernel's online-softmax
+# split order is layout noise (~1e-7 in f32) that in bf16 can flip a
+# near-tied argmax; the OVERLAP/MULTI-STEP semantics under test are
+# dtype-independent.
+CFG_F32 = dataclasses.replace(CFG, dtype=jnp.float32)
+
+
+def _cfg_for(kernel: str) -> LlamaConfig:
+    return CFG if kernel == "xla" else CFG_F32
+
+
+def _kernels():
+    yield "xla"
+    if _CAN_INTERPRET:
+        yield "pallas"
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("kernel", list(_kernels()))
+@pytest.mark.parametrize("paged", [False, True])
+def test_overlap_multistep_matches_generate(paged, kernel, kv_quant):
+    """The ISSUE 12 token-identity gate: the fully-composed new mode
+    (overlap + decode_steps=3) over {contiguous, paged} × {bf16, int8-KV}
+    × {xla, pallas-interpret}, with num_slots < requests so slot reuse
+    and mid-flight admission ride the deferred pipeline too."""
+    S, T, N = 8, 5, 4
+    rng = np.random.default_rng(11)
+    lens = [5, 8, 3, 7]
+    prompts = [
+        rng.integers(1, CFG.vocab_size, size=n).astype(np.int32) for n in lens
+    ]
+    cfg = _cfg_for(kernel)
+    kwargs = dict(
+        num_slots=2, max_len=S + T, kv_quant=kv_quant,
+        decode_kernel=kernel, decode_steps=3,
+    )
+    if paged:
+        executor = PagedModelExecutor(PARAMS, cfg, page_size=4, **kwargs)
+    else:
+        executor = ModelExecutor(PARAMS, cfg, **kwargs)
+    eng = ServingEngine(executor, overlap=True)
+    reqs = [eng.submit(p, T) for p in prompts]
+    eng.run_until_drained(max_steps=2000)
+    eng._pipeline.verify_consistent()
+    if paged:
+        eng.paged.verify_consistent()
+    for i, req in enumerate(reqs):
+        solo = np.asarray(
+            generate(
+                PARAMS, jnp.asarray(prompts[i][None]), cfg,
+                max_new_tokens=T, max_len=S + T,
+                kv_quant=kv_quant, decode_kernel=kernel,
+            )
+        )[0]
+        np.testing.assert_array_equal(
+            np.asarray(req.output_tokens), solo,
+            err_msg=f"request {i} (paged={paged} kernel={kernel} kv={kv_quant})",
+        )
+
+
+@pytest.mark.parametrize("overlap,decode_steps", [(True, 1), (False, 4), (True, 4)])
+def test_engine_modes_match_sync_oracle(overlap, decode_steps):
+    """Each new mode against the UNCHANGED synchronous k=1 engine on the
+    same request set (bf16/XLA): the oracle path is byte-identical to the
+    pre-ISSUE-12 engine, so agreement here pins the whole family."""
+    S, T, N = 8, 6, 5
+    rng = np.random.default_rng(3)
+    prompts = rng.integers(1, CFG.vocab_size, size=(N, S)).astype(np.int32)
+
+    def run(ov, k):
+        ex = ModelExecutor(PARAMS, CFG, num_slots=2, max_len=S + T, decode_steps=k)
+        eng = ServingEngine(ex, overlap=ov)
+        reqs = [eng.submit(prompts[i], T) for i in range(N)]
+        eng.run_until_drained(max_steps=2000)
+        return [r.output_tokens for r in reqs]
+
+    assert run(overlap, decode_steps) == run(False, 1)
+
+
+def test_stop_token_real_model_matches_truncated_oracle():
+    """In-device stop detection: outputs are the sync no-stop oracle's
+    stream truncated at (and including) the first stop token."""
+    S, T = 8, 6
+    rng = np.random.default_rng(5)
+    prompts = rng.integers(1, CFG.vocab_size, size=(2, S)).astype(np.int32)
+    ref = np.asarray(
+        generate(PARAMS, jnp.asarray(prompts), CFG, max_new_tokens=T, max_len=S + T)
+    )
+    stop = int(ref[0][2])  # a token that really occurs mid-stream
+    ex = ModelExecutor(
+        PARAMS, CFG, num_slots=2, max_len=S + T, decode_steps=3, stop_token=stop
+    )
+    eng = ServingEngine(ex, overlap=True)
+    reqs = [eng.submit(prompts[i], T) for i in range(2)]
+    eng.run_until_drained(max_steps=2000)
+    for i, req in enumerate(reqs):
+        full = list(ref[i])
+        expect = full[: full.index(stop) + 1] if stop in full else full
+        assert req.output_tokens == expect, i
+        assert req.state == RequestState.FINISHED

@@ -1459,3 +1459,114 @@ SERVING_POD_RECOVERY = {
         f.message for f in lint_source(src, "NX001", rel_path="supervisor/taxonomy.py")
     ]
     assert any("SERVING_POD_RECOVERY references unknown DecisionAction.TO_GHOST" in m for m in messages)
+
+
+# -- NX014 dispatch-loop readback discipline ------------------------------------
+
+
+def _lint_nx014(src, rel_path="tpu_nexus/serving/engine.py"):
+    return lint_source(src, "NX014", rel_path=rel_path)
+
+
+ENGINE_SEAM_SRC = """
+class ServingEngine:
+    def step(self):
+        self._dispatch_scan()
+        self._materialize_one()
+
+    def _materialize_one(self):
+        return tuple(np.asarray(x) for x in self._pending.result)
+"""
+
+
+def test_nx014_materialize_seam_owns_the_readback():
+    assert _lint_nx014(ENGINE_SEAM_SRC) == []
+
+
+def test_nx014_readback_in_dispatch_loop_flagged():
+    src = """
+    class ServingEngine:
+        def step(self):
+            out = self.executor.step_scan(self._tokens, self._cursors)
+            return np.asarray(out[0])
+    """
+    findings = _lint_nx014(src)
+    assert [f.rule_id for f in findings] == ["NX014"]
+    assert "np.asarray" in findings[0].message
+    assert "_materialize" in findings[0].message
+
+
+def test_nx014_item_and_device_get_and_block_until_ready_flagged():
+    src = """
+    class ServingEngine:
+        def a(self):
+            return tokens.item()
+        def b(self):
+            return jax.device_get(tokens)
+        def c(self):
+            tokens.block_until_ready()
+    """
+    findings = _lint_nx014(src)
+    assert [f.rule_id for f in findings] == ["NX014"] * 3
+    blob = "\n".join(f.message for f in findings)
+    for what in (".item()", "device_get", ".block_until_ready()"):
+        assert what in blob, what
+
+
+def test_nx014_jnp_asarray_is_not_a_readback():
+    """jnp.asarray is a device-side convert — a dispatch INPUT; only the
+    numpy aliases force a transfer back to host."""
+    src = """
+    class ServingEngine:
+        def _dispatch_scan(self):
+            return self.executor.step_scan(jnp.asarray(self._tokens))
+    """
+    assert _lint_nx014(src) == []
+
+
+def test_nx014_overlap_module_is_in_scope():
+    src = "def peek(pending):\n    return np.asarray(pending.result[0])\n"
+    findings = _lint_nx014(src, rel_path="tpu_nexus/serving/overlap.py")
+    assert [f.rule_id for f in findings] == ["NX014"]
+
+
+def test_nx014_overlap_materialize_helper_is_seam():
+    src = "def _materialize(pending):\n    return np.asarray(pending.result[0])\n"
+    assert _lint_nx014(src, rel_path="tpu_nexus/serving/overlap.py") == []
+
+
+def test_nx014_other_modules_and_executors_out_of_scope():
+    # executors (module level in engine.py, outside the ServingEngine
+    # class) keep their synchronous blocking entry points — the oracle path
+    src = """
+    class ModelExecutor:
+        def step(self, tokens, cursors):
+            return np.asarray(self._step(tokens, cursors))
+
+    class ServingEngine:
+        def step(self):
+            pass
+    """
+    assert _lint_nx014(src) == []
+    src2 = "def f():\n    return np.asarray(x)\n"
+    assert _lint_nx014(src2, rel_path="tpu_nexus/serving/scheduler.py") == []
+
+
+def test_nx014_missing_engine_class_fails_closed():
+    findings = _lint_nx014("class SomethingElse:\n    pass\n")
+    assert [f.rule_id for f in findings] == ["NX014"]
+    assert "unverifiable" in findings[0].message
+
+
+def test_nx014_repo_engine_is_clean():
+    """The shipped engine + overlap module pass their own rule (the repo
+    gate covers this too; pinned here so a violation names the rule)."""
+    findings = lint_paths(
+        [
+            os.path.join(REPO_ROOT, "tpu_nexus", "serving", "engine.py"),
+            os.path.join(REPO_ROOT, "tpu_nexus", "serving", "overlap.py"),
+        ],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX014"],
+    )
+    assert findings == []

@@ -38,6 +38,7 @@ REQUIRED = [
     "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates
+    "tpu_nexus/serving/overlap.py",             # deferred-dispatch ledgers
     "tpu_nexus/serving/recovery.py",
     "tpu_nexus/serving/speculative.py",         # drafting + verify-k acceptance
 

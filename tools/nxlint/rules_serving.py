@@ -8,6 +8,10 @@ NX013  drafter parity coverage: every Drafter registered in
        serving/speculative.py DRAFTERS must be named by a test under
        tests/ (the NX009 fails-closed pattern — an undrilled drafter is
        an unproven acceptance oracle)
+NX014  no blocking host readback in the engine dispatch loop: step
+       results materialize ONLY inside the sanctioned deferred seam
+       (``_materialize*`` methods) — one stray ``np.asarray``/``.item()``
+       between dispatches silently re-serializes the overlapped engine
 """
 
 from __future__ import annotations
@@ -434,3 +438,113 @@ class DrafterParityRule(Rule):
                 "stream must equal one-shot greedy generate) exercising "
                 "the drafter",
             )
+
+
+# -- NX014: no blocking readback in the engine dispatch loop --------------------
+
+OVERLAP_PATH = "serving/overlap.py"
+ENGINE_CLASS = "ServingEngine"
+
+#: the sanctioned deferred-materialize seam: functions whose name carries
+#: this prefix own the engine's ONLY blocking readback of step results
+MATERIALIZE_PREFIX = "_materialize"
+
+#: method names that force a device value to host (block the dispatcher)
+_BLOCKING_METHODS = frozenset({"item", "block_until_ready"})
+
+#: numpy module aliases whose ``asarray`` IS a host readback of a device
+#: array (``jnp.asarray`` is a device-side convert — a dispatch INPUT —
+#: and deliberately not matched)
+_NP_MODULES = frozenset({"np", "numpy", "onp"})
+
+
+def _blocking_readback(node: ast.Call) -> Optional[str]:
+    """Human-readable name of the blocking-readback call, or None."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _BLOCKING_METHODS:
+            return f".{func.attr}()"
+        if func.attr == "device_get":
+            return "device_get"
+        if (
+            func.attr == "asarray"
+            and isinstance(func.value, ast.Name)
+            and func.value.id in _NP_MODULES
+        ):
+            return "np.asarray"
+    elif isinstance(func, ast.Name) and func.id == "device_get":
+        return "device_get"
+    return None
+
+
+@register
+class DispatchLoopReadbackRule(Rule):
+    """NX014: the engine's dispatch loop must never block on step results
+    outside the sanctioned deferred-materialize seam.  The overlapped
+    engine's whole value is that the host schedules step N+1 while step
+    N's tokens are in flight — ONE stray ``np.asarray`` / ``.item()`` /
+    ``jax.device_get`` / ``.block_until_ready()`` between dispatches
+    re-serializes it silently (the bench regresses, nothing errors).
+    Scope: every method of ``ServingEngine`` (serving/engine.py) plus all
+    of serving/overlap.py (the pending-step bookkeeping, which holds
+    device handles and must treat them as opaque); the seam is any
+    function named ``_materialize*``.  The executors' synchronous entry
+    points (``step``/``begin``/``verify``) are deliberately OUT of scope:
+    they ARE the blocking oracle path the parity tests pin everything
+    against.  Fails closed when the engine class disappears."""
+
+    rule_id = "NX014"
+    description = (
+        "no blocking host readback on step results in the engine dispatch "
+        "loop outside the _materialize* seam"
+    )
+
+    def check_module(self, module: Module) -> Iterator[Finding]:
+        if module.tree is None:
+            return
+        if module.rel_path.endswith(OVERLAP_PATH):
+            yield from self._scan(module, module.tree.body)
+            return
+        if not module.rel_path.endswith(ENGINE_PATH):
+            return
+        engine_cls = next(
+            (
+                n
+                for n in module.tree.body
+                if isinstance(n, ast.ClassDef) and n.name == ENGINE_CLASS
+            ),
+            None,
+        )
+        if engine_cls is None:
+            # fail CLOSED: a renamed engine class must not silently drop
+            # the dispatch loop out of coverage (NX005's contract)
+            yield self.finding(
+                module,
+                module.tree,
+                f"{ENGINE_CLASS} class not found in {module.rel_path} — "
+                "dispatch-loop readback discipline unverifiable",
+            )
+            return
+        yield from self._scan(module, engine_cls.body)
+
+    def _scan(self, module: Module, stmts) -> Iterator[Finding]:
+        stack = list(stmts)
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and node.name.startswith(MATERIALIZE_PREFIX):
+                continue  # the sanctioned seam owns its readbacks
+            if isinstance(node, ast.Call):
+                what = _blocking_readback(node)
+                if what is not None:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"blocking host readback ({what}) in the engine "
+                        "dispatch loop — step results may only materialize "
+                        f"inside a {MATERIALIZE_PREFIX}* method (the "
+                        "deferred seam); anything else silently "
+                        "re-serializes the overlapped engine",
+                    )
+            stack.extend(ast.iter_child_nodes(node))

@@ -321,6 +321,7 @@ def decode_step(
     decode_kernel: str = "auto",
     block_tables: Optional[jax.Array] = None,
     logical_limit: Optional[int] = None,
+    write_mask: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Cache]:
     """One autoregressive step: ``token`` [B] at scalar WRITE position
     ``pos`` → (logits [B, vocab], updated cache).  Mirrors the training
@@ -358,6 +359,17 @@ def decode_step(
     keeps the XLA fallback bit-identical to a contiguous cache of that
     length — see :func:`cached_attention`.
 
+    Frozen-row mode (``write_mask`` [B] bool, per-slot ``pos`` only —
+    the multi-step :func:`decode_scan`): rows with ``write_mask[b] ==
+    False`` SUPPRESS their KV write this step — contiguous rows divert
+    the scatter index past ``max_len`` (dropped by XLA scatter
+    semantics), paged rows divert to the scratch block — and keep their
+    cursor semantics untouched (attention still reads ``[0, pos[b]]``;
+    their logits are garbage the caller discards).  This is how an
+    early-frozen row (budget exhausted / stop token sampled mid-scan)
+    rides the fixed-shape batch without corrupting its own live KV.
+    ``None`` (the default) keeps the existing trace byte-identical.
+
     ``decode_kernel``: attention dispatch — ``"auto"`` (fused pallas
     decode kernel on TPU, XLA fallback elsewhere), ``"pallas"``,
     ``"xla"``; the ``NEXUS_DECODE_KERNEL`` env var replaces the ``auto``
@@ -379,6 +391,8 @@ def decode_step(
     paged = block_tables is not None
     if paged and not per_slot:
         raise ValueError("paged decode (block_tables) requires per-slot vector pos")
+    if write_mask is not None and not per_slot:
+        raise ValueError("write_mask (frozen rows) requires per-slot vector pos")
     bt = block_tables.astype(jnp.int32) if paged else None
     if paged:
         # pooled cache [L, num_blocks, page_size, Hkv, D]: the logical slot
@@ -387,7 +401,16 @@ def decode_step(
         logical_len = bt.shape[1] * page_size
         # per-row write address: logical cursor -> (physical block, offset).
         # Dead lanes (pos 0, scratch-only table row) resolve to block 0.
-        _phys = jnp.take_along_axis(bt, (pos // page_size)[:, None], axis=1)[:, 0]
+        if write_mask is None:
+            _phys = jnp.take_along_axis(bt, (pos // page_size)[:, None], axis=1)[:, 0]
+        else:
+            # frozen rows divert to the scratch block; the clamped deref
+            # keeps the gather in range even for a cursor parked at the
+            # table edge (take_along_axis would otherwise clamp to the
+            # row's LAST live block — a real write into live KV)
+            _lb = jnp.minimum(pos // page_size, bt.shape[1] - 1)
+            _phys = jnp.take_along_axis(bt, _lb[:, None], axis=1)[:, 0]
+            _phys = jnp.where(write_mask & (pos < logical_len), _phys, 0)
         _off = pos % page_size
         max_len = logical_len
     else:
@@ -431,7 +454,10 @@ def decode_step(
         if paged:
             return arr.at[li, _phys, _off].set(update[:, 0])
         if per_slot:
-            return arr.at[li, jnp.arange(b), pos].set(update[:, 0])
+            # frozen rows push their scatter index past max_len, where XLA
+            # drops the update — the contiguous flavor of the scratch sink
+            idx = pos if write_mask is None else jnp.where(write_mask, pos, max_len)
+            return arr.at[li, jnp.arange(b), idx].set(update[:, 0])
         return jax.lax.dynamic_update_slice(arr, update[None], (li, 0, pos, 0, 0))
 
     def _cache_read(arr, li):
@@ -508,6 +534,97 @@ def decode_step(
     hidden = rms_norm(x, params["out_norm"], cfg.norm_eps)
     logits = jnp.einsum("be,ev->bv", hidden[:, 0], _head(params, cfg))
     return logits, cache
+
+
+def decode_scan(
+    params: Dict[str, Any],
+    cache: Cache,
+    token: jax.Array,
+    pos: jax.Array,
+    limit: jax.Array,
+    cfg: ModelConfig,
+    *,
+    num_steps: int,
+    key: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
+    stop_token: int = -1,
+    unroll_layers: Optional[bool] = None,
+    decode_kernel: str = "auto",
+    block_tables: Optional[jax.Array] = None,
+    logical_limit: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, Cache]:
+    """In-jit multi-step decode: a ``lax.scan`` of ``num_steps`` per-slot
+    :func:`decode_step` iterations in ONE traced program — the host
+    dispatches (and reads back) once per ``num_steps`` device steps
+    instead of once per token, which is the whole point (the serving
+    engine's host tax amortizes k-fold; tpu_nexus/serving ISSUE 12).
+
+    ``token`` [B] is each slot's last emitted token (KV not yet written —
+    the per-slot :func:`decode_step` contract), ``pos`` [B] its cursor.
+    ``limit`` [B] int32 is each row's emission budget FOR THIS CALL (the
+    host clamps it to the request's remaining ``max_new_tokens``): a row
+    emits ``min(limit[b], num_steps)`` tokens, fewer if it samples
+    ``stop_token`` (>= 0 enables in-device stop detection; the stop token
+    itself is emitted, then the row freezes).  Frozen rows — budget spent,
+    stopped, or admitted with ``limit 0`` (a dead lane) — stop advancing
+    their cursor and write nothing: their KV writes divert to the scratch
+    sink via :func:`decode_step`'s ``write_mask``, so a frozen row's live
+    cache rows stay bit-clean while the batch scans on.
+
+    Returns ``(tokens [B, num_steps], counts [B], last_token [B],
+    last_pos [B], cache)``: row ``b``'s REAL emissions are its first
+    ``counts[b]`` token columns (freezing is monotone, so real tokens are
+    always a prefix); ``last_token``/``last_pos`` are the carry the NEXT
+    scan (or single step) continues from — the deferred-dispatch engine
+    feeds them straight back as device arrays, no host readback between
+    steps.  Sampling (``temperature > 0``) splits ``key`` once per scan
+    step in-trace; greedy ignores it.  Composes with paged block tables,
+    int8 KV, and both decode kernels exactly as :func:`decode_step` does.
+    """
+    b = token.shape[0]
+    if num_steps < 1:
+        raise ValueError(f"num_steps must be >= 1, got {num_steps}")
+    # static by contract (it selects the traced program, like num_steps):
+    # callers close over it per executor, never pass it as a traced operand
+    stop_token = int(stop_token)
+    if key is None:
+        key = jax.random.PRNGKey(0)  # greedy ignores it; scan xs need an array
+    pos = jnp.asarray(pos, jnp.int32).reshape(b)
+    limit = jnp.asarray(limit, jnp.int32).reshape(b)
+    token = jnp.asarray(token, jnp.int32).reshape(b)
+
+    def body(carry, step_key):
+        cache, tok, p, emitted, alive = carry
+        active = alive & (emitted < limit)
+        logits, cache = decode_step(
+            params, cache, tok, p, cfg,
+            unroll_layers=unroll_layers, decode_kernel=decode_kernel,
+            block_tables=block_tables, logical_limit=logical_limit,
+            write_mask=active,
+        )
+        nxt = sample_logits(logits, step_key, temperature, top_k, top_p)
+        tok = jnp.where(active, nxt, tok)
+        if stop_token >= 0:
+            # the stop token IS emitted (active this step), then the row
+            # freezes — in-device detection, no host round-trip per token
+            alive = alive & ~(active & (nxt == stop_token))
+        emitted = emitted + active.astype(jnp.int32)
+        p = p + active.astype(jnp.int32)
+        return (cache, tok, p, emitted, alive), nxt
+
+    init = (
+        cache,
+        token,
+        pos,
+        jnp.zeros((b,), jnp.int32),
+        jnp.ones((b,), bool),
+    )
+    (cache, tok, p, emitted, _alive), toks = jax.lax.scan(
+        body, init, jax.random.split(key, num_steps)
+    )
+    return jnp.moveaxis(toks, 0, 1), emitted, tok, p, cache
 
 
 def extend_step(

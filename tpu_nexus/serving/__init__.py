@@ -22,6 +22,9 @@ Layering (each module imports only downward):
                        prompt-lookup ngram + draft-model drafters, the
                        verify-k acceptance oracle (greedy token-identity)
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
+* ``overlap``        — deferred-dispatch bookkeeping (ISSUE 12): pending
+                       decode scans, override/inflight ledgers — the host
+                       accounting behind ``ServingEngine(overlap=True)``
 * ``engine``         — ModelExecutor / PagedModelExecutor (jitted compute)
                        + ServingEngine (host loop: fault isolation,
                        deadlines, graceful drain, block-table admission,
@@ -60,6 +63,7 @@ from tpu_nexus.serving.fleet import (
     ServingFleet,
 )
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.overlap import DispatchPipeline, PendingStep, PipelineError
 from tpu_nexus.serving.speculative import (
     DRAFTERS,
     Drafter,
@@ -86,6 +90,7 @@ __all__ = [
     "CheckpointWatcher",
     "DRAFTERS",
     "DeviceStateLost",
+    "DispatchPipeline",
     "Drafter",
     "EngineReplica",
     "FifoScheduler",
@@ -99,6 +104,8 @@ __all__ = [
     "NGramDrafter",
     "PagedCacheManager",
     "PagedModelExecutor",
+    "PendingStep",
+    "PipelineError",
     "PrefixIndex",
     "QueueFull",
     "RETIREMENT_ACTIONS",

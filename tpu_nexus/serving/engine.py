@@ -34,6 +34,18 @@ jitter, request-fatal faults retire ONLY the implicated request as
 over-capacity submits; and :meth:`ServingEngine.drain` implements the
 graceful-preemption protocol (stop admission, finish what fits in the
 grace budget, evict the rest with honest causes).
+
+Overlapped execution (ISSUE 12): ``ServingEngine(overlap=True)`` never
+blocks between device steps — step N+1 dispatches while N's tokens are
+in flight (N's device outputs ARE N+1's operands; host overrides merge
+in-jit) and N's results materialize one step late in the single
+sanctioned readback seam, :meth:`ServingEngine._materialize_one`
+(nxlint NX014).  ``decode_steps > 1`` additionally runs k decode steps
+per dispatch as one ``lax.scan`` with in-device stop detection and
+per-row early freeze (``models/generate.decode_scan``).  The k=1
+synchronous loop below stays byte-identical as the parity oracle; host
+ledgers for the deferral live in ``serving/overlap.py``; semantics,
+fences and latency bounds in docs/SERVING.md "Overlapped execution".
 """
 
 from __future__ import annotations
@@ -54,6 +66,7 @@ from tpu_nexus.serving.cache_manager import (
     init_paged_cache,
 )
 from tpu_nexus.serving.metrics import ServingMetrics
+from tpu_nexus.serving.overlap import DispatchPipeline, PendingStep
 from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
 from tpu_nexus.serving.request import (
     Request,
@@ -120,6 +133,8 @@ class _ExecutorCommon:
         top_k: int,
         top_p: float,
         seed: int,
+        decode_steps: int = 1,
+        stop_token: int = -1,
     ):
         import functools
 
@@ -135,11 +150,19 @@ class _ExecutorCommon:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
         if (top_k or top_p < 1.0) and temperature == 0.0:
             raise ValueError("top_k/top_p truncation requires temperature > 0")
+        if decode_steps < 1:
+            raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
         self.params = params
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_len = max_len
         self.kv_quant = kv_quant
+        #: in-jit multi-step decode (ISSUE 12): tokens per ``step_scan``
+        #: dispatch — static, it selects the traced scan length
+        self.decode_steps = decode_steps
+        #: in-device stop detection: a row that samples this token emits
+        #: it and freezes mid-scan (-1 disables; static like decode_steps)
+        self.stop_token = int(stop_token)
         self.temperature = temperature
         self._buckets = _prefill_buckets(max_len)
         self._key = jax.random.PRNGKey(seed)
@@ -254,13 +277,21 @@ class ModelExecutor(_ExecutorCommon):
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        decode_steps: int = 1,
+        stop_token: int = -1,
     ) -> None:
-        from tpu_nexus.models.generate import decode_step, prefill, verify_step
+        from tpu_nexus.models.generate import (
+            decode_scan,
+            decode_step,
+            prefill,
+            verify_step,
+        )
 
         jax = self._init_common(
             params, cfg, num_slots=num_slots, max_len=max_len,
             kv_quant=kv_quant, decode_kernel=decode_kernel,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            decode_steps=decode_steps, stop_token=stop_token,
         )
         jnp = jax.numpy
         self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
@@ -305,6 +336,24 @@ class ModelExecutor(_ExecutorCommon):
 
         self._verify = jax.jit(_verify, donate_argnums=self._donate)
 
+        def _scan(params, cache, prev_tok, prev_pos, override, tok, pos, limits, key):
+            # deferred/multi-step decode (ISSUE 12): merge the host
+            # overrides (refilled slots) into the PREVIOUS dispatch's
+            # device carries INSIDE the jit — token/cursor state never
+            # visits the host between steps — then scan decode_steps
+            # per-slot steps with per-row budget freeze + in-device stop
+            # detection (models/generate.decode_scan)
+            tok0 = jnp.where(override, tok, prev_tok)
+            pos0 = jnp.where(override, pos, prev_pos)
+            return decode_scan(
+                params, cache, tok0, pos0, limits, cfg,
+                num_steps=self.decode_steps, key=key,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                stop_token=self.stop_token, decode_kernel=decode_kernel,
+            )
+
+        self._scan = jax.jit(_scan, donate_argnums=self._donate)
+
     def _fresh_cache(self):
         return init_cache(self.cfg, self.num_slots, self.max_len, self.kv_quant)
 
@@ -342,6 +391,44 @@ class ModelExecutor(_ExecutorCommon):
         except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
             self._guard_cache(exc)
         return np.asarray(next_tokens)
+
+    def step_scan(
+        self,
+        prev_tokens: Any,
+        prev_cursors: Any,
+        override: np.ndarray,
+        tokens: np.ndarray,
+        cursors: np.ndarray,
+        limits: np.ndarray,
+    ):
+        """One deferred/multi-step decode dispatch (ISSUE 12): scan
+        ``decode_steps`` per-slot steps in one jitted call.  ``prev_*``
+        are the PREVIOUS dispatch's device carries (or host arrays for a
+        cold start); rows where ``override`` is True take the host
+        ``tokens``/``cursors`` instead (admission refilled the slot).
+        ``limits`` [B] caps each row's emissions (0 = frozen dead lane).
+
+        Returns DEVICE arrays ``(tokens [B, k], counts [B], last_token
+        [B], last_pos [B])`` with NO host readback — the engine's
+        ``_materialize_one`` seam owns the blocking ``np.asarray`` exactly
+        one step later (nxlint NX014), which is what lets the host
+        schedule step N+1 while N is still executing."""
+        jnp = self._jax.numpy
+        try:
+            toks, counts, last_tok, last_pos, self.cache = self._scan(
+                self.params,
+                self.cache,
+                jnp.asarray(prev_tokens, jnp.int32),
+                jnp.asarray(prev_cursors, jnp.int32),
+                jnp.asarray(override, bool),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(cursors, jnp.int32),
+                jnp.asarray(limits, jnp.int32),
+                self._next_key(),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return toks, counts, last_tok, last_pos
 
     def verify(self, tokens: np.ndarray, cursors: np.ndarray, drafts: np.ndarray) -> np.ndarray:
         """Speculative verify over all slots: score ``[tokens[b], drafts
@@ -413,8 +500,11 @@ class PagedModelExecutor(_ExecutorCommon):
         top_k: int = 0,
         top_p: float = 1.0,
         seed: int = 0,
+        decode_steps: int = 1,
+        stop_token: int = -1,
     ) -> None:
         from tpu_nexus.models.generate import (
+            decode_scan,
             decode_step,
             extend_step,
             prefill,
@@ -426,6 +516,7 @@ class PagedModelExecutor(_ExecutorCommon):
             params, cfg, num_slots=num_slots, max_len=max_len,
             kv_quant=kv_quant, decode_kernel=decode_kernel,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
+            decode_steps=decode_steps, stop_token=stop_token,
         )
         jnp = jax.numpy
         if page_size < 1:
@@ -503,6 +594,22 @@ class PagedModelExecutor(_ExecutorCommon):
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
         self._verify = jax.jit(_verify, donate_argnums=self._donate)
+
+        def _scan(params, cache, prev_tok, prev_pos, override, tok, pos, limits, tables, key):
+            # paged deferred/multi-step decode: the contiguous _scan with
+            # the per-slot block tables threaded through (frozen rows'
+            # writes divert to the scratch block in-kernel)
+            tok0 = jnp.where(override, tok, prev_tok)
+            pos0 = jnp.where(override, pos, prev_pos)
+            return decode_scan(
+                params, cache, tok0, pos0, limits, cfg,
+                num_steps=self.decode_steps, key=key,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                stop_token=self.stop_token, decode_kernel=decode_kernel,
+                block_tables=tables, logical_limit=max_len,
+            )
+
+        self._scan = jax.jit(_scan, donate_argnums=self._donate)
 
         def _cow(cache, src, dst):
             # copy-on-write block copy: one whole-block slice per leaf
@@ -585,6 +692,37 @@ class PagedModelExecutor(_ExecutorCommon):
             self._guard_cache(exc)
         return np.asarray(next_tokens)
 
+    def step_scan(
+        self,
+        prev_tokens: Any,
+        prev_cursors: Any,
+        override: np.ndarray,
+        tokens: np.ndarray,
+        cursors: np.ndarray,
+        limits: np.ndarray,
+        tables: np.ndarray,
+    ):
+        """Paged deferred/multi-step decode dispatch: same contract as
+        :meth:`ModelExecutor.step_scan` plus the per-slot block tables.
+        Returns DEVICE arrays — no host readback here (nxlint NX014)."""
+        jnp = self._jax.numpy
+        try:
+            toks, counts, last_tok, last_pos, self.cache = self._scan(
+                self.params,
+                self.cache,
+                jnp.asarray(prev_tokens, jnp.int32),
+                jnp.asarray(prev_cursors, jnp.int32),
+                jnp.asarray(override, bool),
+                jnp.asarray(tokens, jnp.int32),
+                jnp.asarray(cursors, jnp.int32),
+                jnp.asarray(limits, jnp.int32),
+                jnp.asarray(tables, jnp.int32),
+                self._next_key(),
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return toks, counts, last_tok, last_pos
+
     def verify(
         self,
         tokens: np.ndarray,
@@ -648,6 +786,7 @@ class ServingEngine:
         retired_log_limit: int = 10_000,
         spec_k: int = 0,
         drafter: Optional[Any] = None,
+        overlap: bool = False,
     ) -> None:
         self.executor = executor
         #: speculative decoding (ISSUE 11): propose spec_k draft tokens
@@ -675,6 +814,37 @@ class ServingEngine:
             raise ValueError("a drafter without spec_k > 0 would never run")
         self.spec_k = spec_k
         self.drafter = drafter
+        #: overlapped dispatch + in-jit multi-step decode (ISSUE 12): the
+        #: executor owns the TRACED knobs (decode_steps selects the scan
+        #: length, stop_token the in-device stop detection — both baked
+        #: into its step_scan jit); the engine only mirrors them for host
+        #: bookkeeping, so the two sides can never disagree
+        self.decode_steps = int(getattr(executor, "decode_steps", 1) or 1)
+        self.overlap = bool(overlap)
+        _stop = int(getattr(executor, "stop_token", -1))
+        self.stop_token: Optional[int] = _stop if _stop >= 0 else None
+        if self.overlap or self.decode_steps > 1:
+            if spec_k:
+                # the acceptance rule (accept_tokens over the verify
+                # readback) runs on HOST — exactly the per-step readback
+                # the deferral exists to hide.  Composing them needs
+                # in-device acceptance; refuse until that lands.
+                raise ValueError(
+                    "speculative decoding (spec_k > 0) is mutually exclusive "
+                    "with overlap/multi-step decode until in-device "
+                    "acceptance lands"
+                )
+            if not hasattr(executor, "step_scan"):
+                raise ValueError(
+                    "overlap/multi-step decode requires an executor exposing "
+                    "step_scan (ModelExecutor/PagedModelExecutor, or a fake "
+                    "implementing the same contract)"
+                )
+        if self.stop_token is not None and spec_k:
+            raise ValueError(
+                "stop_token with speculative decoding is not composed yet: "
+                "the acceptance rule would emit past an accepted stop token"
+            )
         self.slots = KVSlotManager(executor.num_slots, executor.max_len)
         #: block-granular accounting when the executor is paged (exposes
         #: page_size/num_blocks); None keeps the slot-granular contract
@@ -730,6 +900,11 @@ class ServingEngine:
         self._tokens = np.zeros(executor.num_slots, np.int32)
         self._cursors = np.zeros(executor.num_slots, np.int32)
         self._counter = itertools.count()
+        #: deferred-dispatch ledgers (serving/overlap.py): pending decode
+        #: scans + override/inflight accounting.  Allocated in every mode
+        #: (cheap) so the chaos fuzz can assert it stays empty when the
+        #: synchronous oracle path runs.
+        self._pipeline = DispatchPipeline(executor.num_slots)
         self.steps = 0
         #: retirement log in order — what the bench and tests audit;
         #: trimmed from the FRONT past ``retired_log_limit`` so a serving
@@ -815,6 +990,19 @@ class ServingEngine:
         ({admitted, decoded, retired})."""
         self.steps += 1
         retired_before = len(self.retired)
+        deferred_tokens = 0
+
+        # 0. a pending dispatch that FAULTED at the call (overlap mode)
+        # must resolve BEFORE any scheduling decision below: the sweeps
+        # and admission would otherwise run against state the fault
+        # already invalidated — in the DeviceStateLost case the executor
+        # has silently reinstalled a fresh cache and the paged prefix
+        # index is still stale, so a request admitted in the gap would
+        # prefill against zeroed shared blocks and then be failed by
+        # _fail_batch despite the device being healthy again
+        latest = self._pipeline.latest
+        if latest is not None and latest.error is not None:
+            deferred_tokens = self._materialize_one()
 
         # 1. cancellations, queued and in-flight — BEFORE the deadline
         # sweep: a request that is both cancel-requested and past-deadline
@@ -875,6 +1063,17 @@ class ServingEngine:
         if self.spec_k:
             decoded = self._spec_decode()
             return self._finish_step(admitted, decoded, retired_before)
+        if self.overlap or self.decode_steps > 1:
+            # overlapped dispatch / in-jit multi-step (ISSUE 12): dispatch
+            # step N over the live slots, then materialize step N-1 —
+            # emissions, stop detection, retirement — exactly one step
+            # late while N executes.  The synchronous k=1 loop below stays
+            # byte-identical as the oracle.
+            return self._finish_step(
+                admitted,
+                deferred_tokens + self._pipelined_decode(),
+                retired_before,
+            )
         decoded = 0
         next_tokens = None
         while self._active:
@@ -903,7 +1102,9 @@ class ServingEngine:
                 self._tokens[slot] = tok
                 self.metrics.token_interval(req.emit(tok, now))
                 decoded += 1
-                if req.done:
+                if req.done or (
+                    self.stop_token is not None and tok == self.stop_token
+                ):
                     self._retire(req, RequestState.FINISHED)
                 elif int(self._cursors[slot]) >= self.slots.max_len:
                     # cache overflow — unreachable when submit() enforced
@@ -931,12 +1132,213 @@ class ServingEngine:
         self.metrics.step_gauges(
             self.scheduler.pending, self.slots.used_count, self.slots.num_slots,
             live_tokens=live_tokens, token_capacity=token_capacity,
+            deferred_slots=self._pipeline.deferred_slots,
         )
         return {
             "admitted": admitted,
             "decoded": decoded,
             "retired": len(self.retired) - retired_before,
         }
+
+    # -- overlapped dispatch / in-jit multi-step decode (ISSUE 12) -------------
+
+    def _pipelined_decode(self) -> int:
+        """One engine iteration of the deferred path: dispatch a k-step
+        decode scan over the live slots, then materialize the PREVIOUS
+        dispatch (one step late — the readback overlaps with step N's
+        device execution).  ``overlap=False`` with ``decode_steps > 1``
+        materializes immediately: still one host dispatch per k device
+        steps, just without the dispatch-ahead.
+
+        A pending that FAULTED at the dispatch call was already resolved
+        at the TOP of :meth:`step` (phase 0) — before the sweeps and
+        admission, which must never act on state the fault invalidated —
+        so any pending still here has device carries to feed the next
+        dispatch."""
+        decoded = 0
+        dispatched = False
+        if self._active:
+            limits = self._dispatch_limits()
+            if limits.any():
+                self._dispatch_scan(limits)
+                dispatched = True
+        keep = 1 if (self.overlap and dispatched) else 0
+        while self._pipeline.depth > keep:
+            decoded += self._materialize_one()
+        if not self._active and self._pipeline.depth:
+            # materializing N-1 retired the last request (stop token /
+            # final budget) while dispatch N was already out: N's lanes
+            # are all dead (snapshot-identity skip), but leaving it
+            # pending would retain its device arrays + request snapshot
+            # on an idle engine indefinitely — drain it now
+            decoded += self._fence()
+        return decoded
+
+    def _dispatch_limits(self) -> np.ndarray:
+        """Per-slot emission budget for the next dispatch: the request's
+        remaining ``max_new_tokens`` net of tokens already riding
+        unmaterialized dispatches, capped at ``decode_steps``.  Inactive
+        lanes stay 0 — frozen in-device, they write nothing at all."""
+        limits = np.zeros(self.executor.num_slots, np.int32)
+        for slot, req in self._active.items():
+            remaining = (
+                req.max_new_tokens
+                - len(req.output_tokens)
+                - int(self._pipeline.inflight[slot])
+            )
+            limits[slot] = max(0, min(remaining, self.decode_steps))
+        return limits
+
+    def _dispatch_scan(self, limits: np.ndarray) -> None:
+        """Dispatch one ``step_scan`` WITHOUT blocking on its results: the
+        previous dispatch's DEVICE outputs carry the token/cursor state
+        forward (merged with host overrides for refilled slots inside the
+        jit), and the host snapshot needed to reconcile the results one
+        step later rides a :class:`PendingStep`.  A dispatch-time fault
+        (sync backends, the chaos wrapper) is CAPTURED, not handled — it
+        surfaces at materialization through the same recovery policy."""
+        prev = self._pipeline.latest
+        tokens = self._tokens.copy()
+        cursors = self._cursors.copy()
+        if prev is None:
+            # cold start (or post-fence): no device carries — host state
+            # is authoritative for every lane
+            override = np.ones(self.executor.num_slots, bool)
+            prev_tok: Any = tokens
+            prev_pos: Any = cursors
+        else:
+            override = self._pipeline.override_mask()
+            prev_tok, prev_pos = prev.result[2], prev.result[3]
+        executor = self.executor
+        if self.paged is None:
+            def thunk(
+                _pt=prev_tok, _pp=prev_pos, _ov=override,
+                _t=tokens, _c=cursors, _l=limits,
+            ):
+                return executor.step_scan(_pt, _pp, _ov, _t, _c, _l)
+        else:
+            tables = self._tables.copy()
+            def thunk(
+                _pt=prev_tok, _pp=prev_pos, _ov=override,
+                _t=tokens, _c=cursors, _l=limits, _tab=tables,
+            ):
+                return executor.step_scan(_pt, _pp, _ov, _t, _c, _l, _tab)
+        snapshot = dict(self._active)
+        pending = PendingStep(
+            thunk=thunk,
+            snapshot=snapshot,
+            # admission order at DISPATCH time: the fault path's victim is
+            # the youngest request the faulted step actually contained
+            order=[s for s in self.slots.owners() if s in snapshot],
+            # where this dispatch's write window STARTS: the host cursor
+            # is stale by whatever the still-unmaterialized previous
+            # dispatch covers — a lane that survives to materialize here
+            # necessarily got its full assumed budget from that dispatch
+            # (an early-stop retires it first), so the offset is exact
+            cursor_base=cursors.astype(np.int64) + self._pipeline.inflight,
+            assumed=limits.copy(),
+        )
+        try:
+            pending.result = pending.thunk()
+        except (RuntimeError, DeviceStateLost) as exc:  # noqa: BLE001 - deferred seam: the fault is HELD on the pending record and re-raised at materialization through the SAME recovery policy, one step late by design (the chaos contract)
+            pending.error = exc
+        self._pipeline.push(pending)
+
+    def _materialize_one(self) -> int:
+        """THE sanctioned blocking-readback seam (nxlint NX014): pop the
+        oldest pending dispatch, force its device results to host, and
+        apply its emissions — stop detection, retirement sweeps — one step
+        late.  Faults (captured at dispatch, or surfacing only now at the
+        deferred readback on async backends) route through the SAME
+        :class:`StepFaultPolicy` as the synchronous loop: transient causes
+        re-run the captured thunk (a pure function of its operands —
+        token-identical for surviving rows), unrecoverable causes retire
+        the DISPATCH-time youngest request and re-run for the rest."""
+        pending = self._pipeline.pop()
+        first = [True]
+
+        def attempt():
+            if first[0]:
+                first[0] = False
+                if pending.error is not None:
+                    raise pending.error
+                result = pending.result
+            else:
+                result = pending.thunk()
+            # the deferred readback: np.asarray forces the device values —
+            # on async backends this is where a dispatch fault surfaces
+            return tuple(np.asarray(x) for x in result)
+
+        while True:
+            try:
+                toks, counts, _last_tok, _last_pos = self._dispatch(attempt)
+                break
+            except DeviceStateLost as lost:
+                self._fail_batch(lost)
+                return 0
+            except StepFault as fault:
+                victim = None
+                for slot in reversed(pending.order):
+                    if self._active.get(slot) is pending.snapshot[slot]:
+                        victim = pending.snapshot[slot]
+                        break
+                if victim is None:
+                    return 0  # every request of that dispatch already retired
+                survivors = (
+                    sum(
+                        1
+                        for s, r in pending.snapshot.items()
+                        if self._active.get(s) is r
+                    )
+                    - 1
+                )
+                logger.warning(
+                    "deferred step fault [%s] retired request %s (slot %d); "
+                    "%d request(s) keep decoding: %s",
+                    fault.cause, victim.request_id, victim.slot,
+                    survivors, fault.original,
+                )
+                self._retire(victim, RequestState.FAILED, cause=fault.cause)
+        decoded = 0
+        now = self._clock()
+        for slot in pending.order:
+            req = pending.snapshot[slot]
+            if self._active.get(slot) is not req:
+                continue  # retired (cancel/deadline/fault) since dispatch
+            self._pipeline.credit(pending, slot)
+            n = int(counts[slot])
+            if n <= 0:
+                continue
+            dt = None if req.last_token_at is None else now - req.last_token_at
+            emitted = [int(t) for t in toks[slot, :n]]
+            for tok in emitted:
+                req.emit(tok, now)
+            self._cursors[slot] = int(pending.cursor_base[slot]) + n
+            self._tokens[slot] = emitted[-1]
+            # mean-preserving multi-token accounting: n samples of dt/n
+            self.metrics.batch_tokens(dt, n)
+            decoded += n
+            stopped = (
+                self.stop_token is not None and emitted[-1] == self.stop_token
+            )
+            if req.done or stopped:
+                self._retire(req, RequestState.FINISHED)
+            elif int(self._cursors[slot]) >= self.slots.max_len:
+                # cache overflow — unreachable when submit() enforced
+                # total_len <= max_len, kept as the runtime backstop
+                self._retire(req, RequestState.EVICTED, cause=CAUSE_OVERFLOW)
+        return decoded
+
+    def _fence(self) -> int:
+        """Materialize EVERY pending dispatch — the admission/swap/drain
+        boundary fence.  Lifecycle decisions that must not act on stale
+        state (drain shedding, quiesce eviction, weight swaps, abandon
+        accounting) call this first, so no request can lose an in-flight
+        token to a decision that pretended the token didn't exist."""
+        decoded = 0
+        while self._pipeline.depth:
+            decoded += self._materialize_one()
+        return decoded
 
     def _propose_safe(self, k: int) -> np.ndarray:
         """Run the drafter's proposal round with the fault boundary drafts
@@ -1089,6 +1491,10 @@ class ServingEngine:
         ledger report; per-cause counts live in
         ``metrics.retired_causes``."""
         self.draining = True
+        # fence BEFORE any shedding decision: in-flight dispatches carry
+        # real tokens (possibly a request's final one) — materialize them
+        # so the drain never evicts a request that had already finished
+        self._fence()
         for req in self.scheduler.remove_cancelled():
             self._retire(req, RequestState.CANCELLED)
         shed_queue = 0
@@ -1147,6 +1553,7 @@ class ServingEngine:
         of the fleet's rolling update).  Queued requests are untouched:
         they can still run on whatever weights come next.  Returns how
         many were evicted."""
+        self._fence()  # a deferred final token must land before eviction
         evicted = 0
         for req in list(self._active.values()):
             self._retire(req, RequestState.EVICTED, cause=cause)
@@ -1160,6 +1567,9 @@ class ServingEngine:
         time was lost mid-generation), queued ones ``EVICTED`` (they never
         got device time — same wording contract as a drain shed).  Returns
         how many requests were accounted."""
+        # the process is going away — account whatever already made it
+        # back from the device before writing the requests off
+        self._fence()
         n = 0
         for req in self.scheduler.drain_queue():
             self._retire(req, RequestState.EVICTED, cause=cause)
@@ -1207,6 +1617,10 @@ class ServingEngine:
         shared prefix of a new-weights prompt would mix weights through
         the cache instead of the params.  NX008 holds the verified-step
         contract (see the executor-level docstring)."""
+        # fence first: a pending dispatch is literally a device step on the
+        # OLD weights — materialize it (possibly finishing its requests)
+        # before judging whether anything is still in flight
+        self._fence()
         if self._active:
             raise RuntimeError(
                 f"swap_params with {len(self._active)} request(s) in flight "
@@ -1419,13 +1833,19 @@ class ServingEngine:
                     self.metrics.draft_fault()
             req.emit(first_token, self._clock())
             self.metrics.first_token(req)
-            if req.done:  # max_new_tokens == 1: prefill produced everything
+            if req.done or (
+                self.stop_token is not None and first_token == self.stop_token
+            ):  # max_new_tokens == 1, or the prefill sampled the stop token
                 self._retire(req, RequestState.FINISHED)
                 continue
             req.transition(RequestState.DECODING)
             self._active[slot] = req
             self._cursors[slot] = req.prompt_len
             self._tokens[slot] = req.output_tokens[-1]
+            # deferred dispatch: this lane's HOST token/cursor is now
+            # authoritative — the next step_scan merges it over whatever
+            # the device still carries for the slot's previous tenant
+            self._pipeline.note_override(slot)
             if self.spec_k:
                 # seed the rollback audit: prompt + the pending first
                 # token's future write = the slot's live coverage
@@ -1450,6 +1870,9 @@ class ServingEngine:
         self.metrics.step_fault(cause, 0)
         for req in victims:
             self._retire(req, RequestState.FAILED, cause=cause)
+        # every pending result references the CONSUMED device state — drop
+        # them all; the next dispatch starts from host state wholesale
+        self._pipeline.clear()
         if self.paged is not None:
             # the executor reinstalled a ZEROED cache: every cached prefix
             # is garbage now — drop the whole index and invalidate any
@@ -1473,6 +1896,11 @@ class ServingEngine:
             self.slots.free(req.slot)
             self._tokens[req.slot] = 0
             self._cursors[req.slot] = 0
+            # deferred ledger: nothing of this request rides the device
+            # any more for budgeting purposes, and whatever a pending
+            # dispatch still carries for the lane is skipped (snapshot
+            # identity check) at materialization
+            self._pipeline.note_retired(req.slot)
             if self._tables is not None:
                 self._tables[req.slot] = SCRATCH_BLOCK
             if self.drafter is not None:

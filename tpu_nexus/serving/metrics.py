@@ -76,6 +76,9 @@ class ServingMetrics:
         #: last-step token-level occupancy sample (summary convenience;
         #: the gauge stream is the production signal)
         self.token_occupancy = 0.0
+        #: last-step deferred-lane sample (overlapped dispatch, ISSUE 12):
+        #: slots whose tokens are dispatched but not yet materialized
+        self.deferred_slots = 0
         #: completed hot weight swaps (rolling updates, ISSUE 9)
         self.weight_swaps_total = 0
 
@@ -141,12 +144,13 @@ class ServingMetrics:
         self._m.count("serving.prefix_hit")
         self._m.count("serving.prefix_shared_tokens", value=shared_tokens)
 
-    def spec_tokens(self, dt: Optional[float], n: int) -> None:
-        """``n`` ACCEPTED tokens emitted by one speculative verify for one
-        request, ``dt`` seconds since the request's previous token (None
-        for a first-ever batch).  Counted as ``n`` tokens and ``n`` TPOT
-        samples of ``dt / n`` each — mean-preserving, so a verify that
-        lands 4 tokens in one 8 ms step reads as 2 ms/token, not as one
+    def batch_tokens(self, dt: Optional[float], n: int) -> None:
+        """``n`` tokens landed for one request in ONE materialization —
+        a speculative verify's accepted prefix, or a k-step decode scan's
+        emissions — ``dt`` seconds since the request's previous token
+        (None for a first-ever batch).  Counted as ``n`` tokens and ``n``
+        TPOT samples of ``dt / n`` each — mean-preserving, so a step that
+        lands 4 tokens in one 8 ms call reads as 2 ms/token, not as one
         8 ms sample plus three fake zeros (which would crater the p50)."""
         self.tokens_out += n
         if dt is None or n < 1:
@@ -155,6 +159,12 @@ class ServingMetrics:
         for _ in range(n):
             self.tpot_s.append(per_token)
             self._m.histogram("serving.tpot_seconds", per_token)
+
+    def spec_tokens(self, dt: Optional[float], n: int) -> None:
+        """``n`` ACCEPTED tokens emitted by one speculative verify for one
+        request — the same mean-preserving accounting as every other
+        multi-token materialization (:meth:`batch_tokens`)."""
+        self.batch_tokens(dt, n)
 
     def spec_verify(self, proposed: int, accepted: int) -> None:
         """One slot's verify outcome: ``proposed`` draft tokens scored,
@@ -198,9 +208,17 @@ class ServingMetrics:
         num_slots: int,
         live_tokens: Optional[int] = None,
         token_capacity: int = 0,
+        deferred_slots: int = 0,
     ) -> None:
         self._m.gauge("serving.queue_depth", queue_depth)
         self._m.gauge("serving.slot_occupancy", slots_used / max(1, num_slots))
+        # deferred (dispatched-but-unmaterialized) lanes, reported
+        # DISTINCTLY from the materialized occupancy above: under
+        # overlapped dispatch the queue/occupancy gauges reflect the
+        # host's one-step-stale view, and this gauge is the honest marker
+        # of how many slots have tokens still riding the device
+        self.deferred_slots = deferred_slots
+        self._m.gauge("serving.deferred_slots", deferred_slots)
         if live_tokens is not None and token_capacity > 0:
             # the paging story in one gauge: slot occupancy can sit at 1.0
             # while token occupancy is tiny — that gap is the HBM the
@@ -228,6 +246,7 @@ class ServingMetrics:
             "draft_faults": self.draft_faults,
             "weight_swaps": self.weight_swaps_total,
             "token_occupancy": self.token_occupancy,
+            "deferred_slots": self.deferred_slots,
             "ttft_p50_s": percentile(self.ttft_s, 50),
             "ttft_p99_s": percentile(self.ttft_s, 99),
             "tpot_p50_s": percentile(self.tpot_s, 50),

@@ -368,6 +368,20 @@ class FaultyExecutor:
             self._fire()
         return self.inner.step(tokens, cursors, *args)
 
+    def step_scan(self, *args, **kwargs):
+        # the overlapped/multi-step decode dispatch (ISSUE 12): counts on
+        # the SAME step counter as step()/verify(), so NEXUS_FAULT_STEP
+        # targets the Nth decode DISPATCH whether the engine is
+        # synchronous, multi-step, or overlapped.  Firing here raises at
+        # dispatch time; the engine HOLDS the fault on the pending record
+        # and surfaces it at the deferred materialization — one step late,
+        # same one-fault-one-request contract (the chaos tests pin it).
+        count = self.step_calls
+        self.step_calls += 1
+        if self._in_window(count, self.at_step):
+            self._fire()
+        return self.inner.step_scan(*args, **kwargs)
+
     def verify(self, tokens, cursors, drafts, *args, **kwargs):
         # the speculative engine's decode dispatch (ISSUE 11): drafts —
         # and the paged table operand — pass through UNCHANGED, and the

@@ -120,6 +120,25 @@ class ServeConfig:
     #: draft-model preset for spec_drafter="model"; "" = the target's own
     #: params (NEXUS_SPEC_DRAFT_PRESET)
     spec_draft_preset: str = ""
+    #: engine mode only — overlapped dispatch (ISSUE 12): the host
+    #: dispatches decode step N+1 while step N's tokens are still in
+    #: flight and materializes N's results one step late (deferred
+    #: readback; docs/SERVING.md "Overlapped execution").  Greedy outputs
+    #: stay token-identical to the synchronous loop; admission/retirement
+    #: decisions run one step conservative.  Mutually exclusive with
+    #: spec_k until in-device acceptance lands.  (NEXUS_OVERLAP)
+    overlap_dispatch: bool = False
+    #: engine mode only — in-jit multi-step decode (ISSUE 12): each
+    #: dispatch runs this many decode steps as one lax.scan with
+    #: in-device stop detection and per-row early freeze.  > 1 amortizes
+    #: the host dispatch k-fold but delays admission/stop handling by up
+    #: to k-1 device steps — keep it small where TTFT matters.  Mutually
+    #: exclusive with spec_k until composed.  (NEXUS_DECODE_STEPS)
+    decode_steps: int = 1
+    #: engine mode only — stop-token id: a request that samples it emits
+    #: the token and retires FINISHED early (detected in-device on the
+    #: multi-step path); -1 = disabled (NEXUS_STOP_TOKEN)
+    stop_token: int = -1
     #: engine mode only — train-to-serve continuous deployment (ISSUE 9):
     #: every this-many seconds re-check ``latest_verified_step(quarantine=
     #: False)`` under ``checkpoint_dir`` and, on a NEW verified step,
@@ -178,6 +197,31 @@ class ServeConfig:
                 raise ValueError(
                     f"{field_name} must be >= 0, got {getattr(self, field_name)}"
                 )
+        if self.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps (NEXUS_DECODE_STEPS) must be >= 1, got "
+                f"{self.decode_steps}"
+            )
+        if self.stop_token < -1:
+            raise ValueError(
+                f"stop_token (NEXUS_STOP_TOKEN) must be -1 (disabled) or a "
+                f"token id >= 0, got {self.stop_token}"
+            )
+        if self.spec_k and (self.overlap_dispatch or self.decode_steps > 1):
+            # the speculative acceptance rule runs on host — exactly the
+            # per-step readback overlap/multi-step exist to hide; refuse
+            # the composition at parse until in-device acceptance lands
+            raise ValueError(
+                "speculative decoding (NEXUS_SPEC_K > 0) is mutually "
+                "exclusive with NEXUS_OVERLAP/NEXUS_DECODE_STEPS > 1 until "
+                "in-device acceptance lands"
+            )
+        if self.spec_k and self.stop_token >= 0:
+            raise ValueError(
+                "stop_token (NEXUS_STOP_TOKEN) with speculative decoding is "
+                "not composed yet — the acceptance rule would emit past an "
+                "accepted stop token"
+            )
         if self.spec_k:
             from tpu_nexus.ops.decode_attention import MAX_DECODE_Q_LEN
             from tpu_nexus.serving.speculative import DRAFTERS
@@ -254,6 +298,9 @@ class ServeConfig:
             spec_drafter=e.get("NEXUS_SPEC_DRAFTER", "ngram"),
             spec_draft_preset=e.get("NEXUS_SPEC_DRAFT_PRESET", ""),
             reload_check_interval_s=float(e.get("NEXUS_RELOAD_CHECK_S", "0")),
+            overlap_dispatch=e.get("NEXUS_OVERLAP", "") not in ("", "0"),
+            decode_steps=int(e.get("NEXUS_DECODE_STEPS", "1")),
+            stop_token=int(e.get("NEXUS_STOP_TOKEN", "-1")),
         )
 
 
@@ -548,6 +595,10 @@ def _serve_engine_loop(
         top_k=cfg.top_k,
         top_p=cfg.top_p,
         seed=cfg.seed,
+        # in-jit multi-step + in-device stop detection (ISSUE 12): the
+        # executor owns both traced knobs; the engine mirrors them
+        decode_steps=cfg.decode_steps,
+        stop_token=cfg.stop_token,
     )
     if cfg.page_size:
         # paged KV (NEXUS_PAGE_SIZE > 0): block-table decode + ref-counted
@@ -597,6 +648,9 @@ def _serve_engine_loop(
         scheduler=FifoScheduler(SchedulerConfig(max_queue=cfg.queue_limit)),
         spec_k=cfg.spec_k,
         drafter=drafter,
+        # overlapped dispatch (NEXUS_OVERLAP): the host never sits between
+        # device steps — step N+1 dispatches while N's tokens are in flight
+        overlap=cfg.overlap_dispatch,
     )
 
     reporter.running()
