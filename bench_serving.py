@@ -40,6 +40,19 @@ token-identical across all three modes, so the ratio is pure dispatch
 hiding.  Artifact: ``NEXUS_SERVING_ASYNC_OUT``, default
 BENCH_SERVING_ASYNC_r09.json.  Knob: ``NEXUS_OVERLAP_BENCH_STEPS``.
 
+``--mesh tp=N`` (ISSUE 13) benches TENSOR-PARALLEL sharded serving: the
+same offline request set through the single-chip engine and the sharded
+executors (serving/sharded.py) on an N-way virtual CPU mesh, contiguous
+AND paged, with a cross-mode token-identity assert.  Honest framing: a
+virtual CPU "mesh" timeshares the same host cores, so the ratio measures
+the GSPMD partition/dispatch OVERHEAD of sharding, never a TP speedup —
+the artifact's value is the parity row + the dispatch counts (the r9
+precedent: at tiny scale this bench prices host work, and the sharded
+engine must pay the same dispatch count as the single-chip engine).  The
+bench model runs f32: TP psum reordering resolves exact bf16 argmax ties
+differently (docs/SERVING.md "Sharded serving").  Artifact:
+``NEXUS_SERVING_TP_OUT``, default BENCH_SERVING_TP_r10.json.
+
 ``--shared-prefix`` (ISSUE 6) instead benches the PAGED engine on the
 millions-of-users workload: one long system prompt, high fan-out, short
 unique tails.  Both engines get the SAME KV HBM budget (``slots ×
@@ -114,13 +127,31 @@ def make_requests(rng, n=None):
     return reqs
 
 
-def _mode_engine(params, cfg, overlap, decode_steps):
+def _mode_engine(params, cfg, overlap, decode_steps, mesh=None, page_size=0):
     """One warmed-up engine in the requested dispatch mode (sync k=1 is
-    byte-for-byte the pre-ISSUE-12 loop — the before side of the bench)."""
-    executor = ModelExecutor(
-        params, cfg, num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED,
+    byte-for-byte the pre-ISSUE-12 loop — the before side of the bench).
+    ``mesh`` switches to the SHARDED executors (ISSUE 13) on that mesh;
+    ``page_size`` > 0 to the paged flavor."""
+    kwargs = dict(
+        num_slots=NUM_SLOTS, max_len=MAX_LEN, seed=SEED,
         decode_steps=decode_steps,
     )
+    if mesh is not None:
+        from tpu_nexus.serving import (
+            ShardedModelExecutor,
+            ShardedPagedModelExecutor,
+        )
+
+        if page_size:
+            executor = ShardedPagedModelExecutor(
+                params, cfg, mesh=mesh, page_size=page_size, **kwargs
+            )
+        else:
+            executor = ShardedModelExecutor(params, cfg, mesh=mesh, **kwargs)
+    elif page_size:
+        executor = PagedModelExecutor(params, cfg, page_size=page_size, **kwargs)
+    else:
+        executor = ModelExecutor(params, cfg, **kwargs)
     engine = ServingEngine(executor, overlap=overlap)
     # warmup: one request per prefill bucket in play + the decode dispatch
     for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
@@ -129,14 +160,17 @@ def _mode_engine(params, cfg, overlap, decode_steps):
     return engine
 
 
-def run_engine_offline(params, cfg, requests, overlap=False, decode_steps=1, repeats=1):
+def run_engine_offline(
+    params, cfg, requests, overlap=False, decode_steps=1, repeats=1,
+    mesh=None, page_size=0,
+):
     """All requests queued at t=0: pure completed-tokens/s.  Returns the
     per-request output streams too, so the overlap bench can assert the
     new modes token-identical to the synchronous oracle.  ``repeats``
     re-runs the measured pass and keeps the best timing (the overlap
     bench's sub-second passes are noisy on a shared CI box); outputs of
     EVERY repeat go into the identity check."""
-    engine = _mode_engine(params, cfg, overlap, decode_steps)
+    engine = _mode_engine(params, cfg, overlap, decode_steps, mesh, page_size)
     best = None
     outputs = {}
     for rep in range(repeats):
@@ -485,6 +519,115 @@ def main_shared_prefix():
     print(json.dumps(result))
 
 
+# -- tensor-parallel sharded serving workload (ISSUE 13) -----------------------
+
+MESH_PAGE = int(os.environ.get("NEXUS_MESH_BENCH_PAGE", "4"))
+
+
+def mesh_bench_model() -> LlamaConfig:
+    """:func:`bench_model` in f32 with tp-divisible heads: identity is the
+    artifact's headline, and TP psum reordering resolves exact bf16
+    argmax ties differently (the documented near-tie caveat) — f32 keeps
+    the cross-mode assert exact instead of probabilistic."""
+    return LlamaConfig(
+        vocab_size=512, hidden=256, n_layers=4, n_heads=8, n_kv_heads=8,
+        head_dim=32, intermediate=512, max_seq_len=2 * MAX_LEN, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+
+def main_mesh(mesh_spec: str):
+    """``--mesh tp=N``: the same offline request set through the
+    single-chip engine and the SHARDED executors (contiguous + paged) on
+    an N-way mesh, outputs asserted token-identical across all modes.
+    The honest number on a virtual CPU mesh is the parity + the dispatch
+    counts — the "devices" timeshare the host cores, so elapsed prices
+    GSPMD partition overhead, not TP speedup (see module docstring)."""
+    from tpu_nexus.serving.sharded import build_serve_mesh, parse_serve_mesh
+
+    axes = parse_serve_mesh(mesh_spec)
+    mesh = build_serve_mesh(axes)
+    rng = np.random.default_rng(SEED)
+    cfg = mesh_bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+    requests = make_requests(rng)
+
+    modes = {
+        "single_chip": dict(),
+        "sharded": dict(mesh=mesh),
+        "single_chip_paged": dict(page_size=MESH_PAGE),
+        "sharded_paged": dict(mesh=mesh, page_size=MESH_PAGE),
+    }
+    rows = {}
+    outputs = {}
+    for name, kw in modes.items():
+        tokens, elapsed, steps, outs = run_engine_offline(
+            params, cfg, requests, repeats=2, **kw
+        )
+        rows[name] = {
+            "tokens": tokens,
+            "elapsed_s": round(elapsed, 4),
+            "engine_steps": steps,
+            "tokens_per_second": round(tokens / elapsed, 2) if elapsed else 0.0,
+        }
+        outputs[name] = outs
+    for name in ("sharded", "single_chip_paged", "sharded_paged"):
+        assert outputs[name] == outputs["single_chip"], (
+            f"{name} outputs diverge from the single-chip engine"
+        )
+    # the dispatch-count row: sharding must not change the engine's step
+    # accounting — same admissions, same decode iterations
+    assert (
+        rows["sharded"]["engine_steps"] == rows["single_chip"]["engine_steps"]
+    ), "sharding changed the engine's dispatch count"
+
+    base = rows["single_chip"]["tokens_per_second"]
+    result = {
+        "metric": "sharded_engine_tokens_per_second_ratio",
+        "value": (
+            round(rows["sharded"]["tokens_per_second"] / base, 3) if base else 0.0
+        ),
+        "unit": "x_tokens_per_second_vs_single_chip",
+        "mesh": axes,
+        "devices": int(mesh.devices.size),
+        "token_identical": True,  # asserted above, all four modes
+        "dispatch_parity": True,  # asserted above
+        "paged_ratio": (
+            round(
+                rows["sharded_paged"]["tokens_per_second"]
+                / max(rows["single_chip_paged"]["tokens_per_second"], 1e-9),
+                3,
+            )
+        ),
+        "modes": rows,
+        "workload": {
+            "requests": N_REQUESTS,
+            "slots": NUM_SLOTS,
+            "prompt_len_range": list(PROMPT_RANGE),
+            "gen_tokens_choices": list(GEN_CHOICES),
+            "page_size": MESH_PAGE,
+            "best_of": 2,
+        },
+        "note": (
+            "virtual CPU mesh: the N 'devices' timeshare the same host "
+            "cores, so the ratio prices GSPMD partition/dispatch overhead "
+            "— a TP SPEEDUP is not measurable here (the r9 precedent: "
+            "tiny-scale CPU benches measure dispatch).  The artifact's "
+            "value is the token-identity + dispatch-count parity rows: "
+            "the sharded engine does the same scheduling work and emits "
+            "the same tokens.  f32 model: TP psum reordering flips exact "
+            "bf16 argmax ties (docs/SERVING.md)."
+        ),
+        "seed": SEED,
+        "model": "llama-bench-4L-h256-f32 (kv_heads=8, tp-divisible)",
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_SERVING_TP_OUT", "BENCH_SERVING_TP_r10.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 # -- overlapped dispatch workload (ISSUE 12) -----------------------------------
 
 OVERLAP_DECODE_STEPS = int(os.environ.get("NEXUS_OVERLAP_BENCH_STEPS", "8"))
@@ -668,6 +811,10 @@ if __name__ == "__main__":
         main_shared_prefix()
     elif "--spec-k" in sys.argv[1:]:
         main_speculative()
+    elif "--mesh" in sys.argv[1:]:
+        args = sys.argv[1:]
+        after = args[args.index("--mesh") + 1 :]
+        main_mesh(after[0] if after and "=" in after[0] else "tp=4")
     elif "--overlap" in sys.argv[1:] or "--decode-steps" in sys.argv[1:]:
         main_overlap()
     else:

@@ -1,0 +1,523 @@
+"""Tensor-parallel sharded serving (ISSUE 13): token identity + shard-aware
+lifecycle on a multi-device virtual CPU mesh.
+
+Token identity is THE gate: the sharded engine's greedy streams must equal
+the single-chip engine's and one-shot ``generate``'s, across {contiguous,
+paged} x {plain, int8-KV} caches, plus one speculative (ngram) and one
+overlap/multi-step combination — all on 2- and 4-way ``tp`` meshes built
+from the conftest's virtual CPU devices (the same trick the multichip
+training tests use).
+
+Float caveat (the PR 6/9 precedent, documented in docs/SERVING.md): TP
+sharding changes the REDUCTION ORDER of every contraction GSPMD splits
+(wo/w_down partial sums + psum), so at bf16 an EXACTLY-TIED argmax can
+resolve to the co-argmax (observed: two vocab entries both at 2.140625 on
+a random-init tiny model — bf16's 8 mantissa bits make exact ties common
+at toy scale).  The parity matrix therefore runs f32 compute, where it is
+exact over every tested length; this mirrors the paged-pallas and
+verify-k matrices, which went f32 for the same different-traced-program
+reason.
+
+The shard-aware swap (rolling updates): a real orbax checkpoint restores
+to a HOST tree, quiesce/swap/resume lands it PER-SHARD — pinned with
+``jax.transfer_guard_device_to_host("disallow")`` around the swap, the
+runtime flavor of nxlint NX014's static no-readback scope over
+serving/sharded.py.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import LlamaConfig, llama_init
+from tpu_nexus.models.moe import MoeConfig, moe_init
+from tpu_nexus.serving import (
+    ModelExecutor,
+    NGramDrafter,
+    PagedModelExecutor,
+    RequestState,
+    ServingEngine,
+    ServingFleet,
+)
+from tpu_nexus.serving.cache_manager import init_cache, init_paged_cache
+from tpu_nexus.serving.sharded import (
+    SERVING_PARAM_RULES,
+    ShardedModelExecutor,
+    ShardedPagedModelExecutor,
+    ShardingError,
+    build_serve_mesh,
+    kv_cache_sharding,
+    match_partition_rules,
+    parse_serve_mesh,
+    serving_param_shardings,
+    shard_serving_params,
+    validate_serve_mesh,
+)
+from tpu_nexus.workload.serve import ServeConfig
+
+# f32 compute: the parity matrix must be exact (see module docstring); the
+# kv-head count (4) divides both tested tp widths
+CFG = LlamaConfig(
+    vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=4,
+    head_dim=16, intermediate=128, max_seq_len=256, remat=False,
+    dtype=jnp.float32, param_dtype=jnp.float32,
+)
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+PARAMS_NEW = llama_init(jax.random.PRNGKey(7), CFG)
+
+S, T, SLOTS = 8, 10, 3
+RNG = np.random.default_rng(11)
+#: all prompt lengths share ONE prefill bucket (<= 8) to bound compiles
+PROMPTS = [
+    RNG.integers(1, CFG.vocab_size, size=int(RNG.integers(4, S + 1))).astype(np.int32)
+    for _ in range(2 * SLOTS)
+]
+
+
+def _mesh(tp):
+    return build_serve_mesh({"tp": tp})
+
+
+def _ref(params, prompt, n=T, kv_quant=""):
+    return list(
+        np.asarray(
+            generate(
+                params, jnp.asarray(prompt[None]), CFG, max_new_tokens=n,
+                max_len=len(prompt) + n, kv_quant=kv_quant,
+            )
+        )[0]
+    )
+
+
+def _drain(engine, prompts=PROMPTS, n=T):
+    reqs = [engine.submit(p, n, request_id=f"r{i}") for i, p in enumerate(prompts)]
+    engine.run_until_drained(max_steps=5000)
+    assert all(r.state == RequestState.FINISHED for r in reqs)
+    return {r.request_id: list(r.output_tokens) for r in reqs}
+
+
+# -- mesh config (NEXUS_SERVE_MESH) --------------------------------------------
+
+
+class TestParseServeMesh:
+    def test_parses_pairs(self):
+        assert parse_serve_mesh("tp=4") == {"tp": 4}
+        assert parse_serve_mesh(" ep=2, tp=2 ") == {"ep": 2, "tp": 2}
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ShardingError, match="unknown mesh axis 'tpx'"):
+            parse_serve_mesh("tpx=4")
+
+    def test_duplicate_axis_rejected(self):
+        with pytest.raises(ShardingError, match="duplicate"):
+            parse_serve_mesh("tp=2,tp=4")
+
+    @pytest.mark.parametrize("bad", ["tp", "tp=", "4", "tp:4", "tp=four"])
+    def test_malformed_rejected(self, bad):
+        with pytest.raises(ShardingError, match="malformed"):
+            parse_serve_mesh(bad)
+
+    def test_nonpositive_size_rejected(self):
+        with pytest.raises(ShardingError, match="size must be >= 1"):
+            parse_serve_mesh("tp=0")
+
+    def test_empty_rejected(self):
+        with pytest.raises(ShardingError, match="empty"):
+            parse_serve_mesh("  ,  ")
+
+
+class TestValidateServeMesh:
+    def test_ok(self):
+        validate_serve_mesh({"tp": 4}, CFG, n_devices=8)
+
+    def test_mesh_larger_than_devices_rejected(self):
+        with pytest.raises(ShardingError, match="wants 16 devices"):
+            validate_serve_mesh({"tp": 16}, CFG, n_devices=8)
+
+    def test_non_divisible_kv_heads_rejected(self):
+        # LlamaConfig.tiny has 2 KV heads: tp=4 cannot shard them
+        with pytest.raises(ShardingError, match="KV heads"):
+            validate_serve_mesh({"tp": 4}, LlamaConfig.tiny(), n_devices=8)
+
+    def test_non_divisible_mlp_rejected(self):
+        cfg = LlamaConfig(
+            vocab_size=256, hidden=64, n_layers=1, n_heads=4, n_kv_heads=4,
+            head_dim=16, intermediate=130, max_seq_len=64,
+        )
+        with pytest.raises(ShardingError, match="MLP width"):
+            validate_serve_mesh({"tp": 4}, cfg, n_devices=8)
+
+    def test_ep_requires_moe(self):
+        with pytest.raises(ShardingError, match="requires an MoE model"):
+            validate_serve_mesh({"ep": 2}, CFG, n_devices=8)
+
+    def test_ep_divides_experts(self):
+        moe = MoeConfig(
+            vocab_size=64, hidden=32, n_layers=1, n_heads=4, n_kv_heads=4,
+            head_dim=8, intermediate=64, n_experts=3, max_seq_len=64,
+        )
+        with pytest.raises(ShardingError, match="does not divide .* 3 experts"):
+            validate_serve_mesh({"ep": 2}, moe, n_devices=8)
+        validate_serve_mesh({"ep": 3}, moe, n_devices=8)
+
+    def test_build_mesh_device_budget(self):
+        with pytest.raises(ShardingError, match="devices"):
+            build_serve_mesh({"tp": 16})
+        mesh = _mesh(4)
+        assert dict(zip(mesh.axis_names, mesh.devices.shape))["tp"] == 4
+
+
+class TestServeConfigMesh:
+    """ISSUE 13 satellite: NEXUS_SERVE_MESH is parse-validated — unknown
+    axes, non-divisible head counts, and over-sized meshes all fail at
+    ``ServeConfig`` construction, before any device work."""
+
+    def test_valid_mesh_parses(self):
+        cfg = ServeConfig.from_env(
+            {"NEXUS_MODEL_PRESET": "tiny", "NEXUS_SERVE_MESH": "tp=2"}
+        )
+        assert cfg.serve_mesh == "tp=2"
+
+    def test_unknown_axis_fails_at_parse(self):
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            ServeConfig(serve_mesh="tpx=2")
+
+    def test_non_divisible_heads_fail_at_parse(self):
+        # the default tiny model has 2 KV heads
+        with pytest.raises(ValueError, match="KV heads"):
+            ServeConfig(serve_mesh="tp=4")
+
+    def test_oversized_mesh_fails_at_parse(self):
+        with pytest.raises(ValueError, match="devices"):
+            ServeConfig(model=CFG, serve_mesh="tp=64")
+
+
+# -- regex partition rules -----------------------------------------------------
+
+
+class TestPartitionRules:
+    def test_llama_tree_fully_matched(self):
+        axes = match_partition_rules(PARAMS)
+        flat = jax.tree_util.tree_leaves(
+            axes, is_leaf=lambda x: isinstance(x, tuple)
+        )
+        leaves = jax.tree_util.tree_leaves(PARAMS)
+        assert len(flat) == len(leaves)
+        for logical, leaf in zip(flat, leaves):
+            assert len(logical) == leaf.ndim
+
+    def test_untied_head_matched(self):
+        cfg = LlamaConfig(
+            vocab_size=64, hidden=32, n_layers=1, n_heads=2, n_kv_heads=2,
+            head_dim=16, intermediate=64, max_seq_len=64, remat=False,
+            tied_embeddings=False,
+        )
+        params = llama_init(jax.random.PRNGKey(0), cfg)
+        assert "lm_head" in params
+        match_partition_rules(params)  # must not raise
+
+    def test_moe_tree_fully_matched(self):
+        """The rank check is what routes ``layers/w_gate`` to the dense
+        rule for Llama but the expert-stacked rule for MoE."""
+        moe = MoeConfig(
+            vocab_size=64, hidden=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            head_dim=8, intermediate=64, n_experts=4, max_seq_len=64,
+            remat=False,
+        )
+        params = moe_init(jax.random.PRNGKey(0), moe)
+        axes = match_partition_rules(params)
+        assert axes["layers"]["w_gate"] == ("layers", "expert", "embed", "mlp")
+        assert axes["layers"]["router"] == ("layers", "embed", None)
+
+    def test_quantized_tree_fully_matched(self):
+        """int8 weight-only params (QTensor leaves flatten to ``.../0`` q
+        + ``.../1`` scales) still match; scale dims collapsed to 1 by the
+        per-channel recipe replicate instead of claiming a tp slice."""
+        from tpu_nexus.models.quant import quantize_params
+
+        qparams = quantize_params(PARAMS)
+        shardings = serving_param_shardings(qparams, _mesh(4))
+        down = shardings["layers"]["w_down"]
+        # q [L, F, E] shards mlp on tp; its scale [L, 1, E] replicates the
+        # collapsed contraction dim instead of erroring on 1 % 4
+        assert down.q.spec[1] == "tp"
+        assert down.s.spec[1] is None
+
+    def test_unmatched_leaf_raises(self):
+        with pytest.raises(ShardingError, match="no serving partition rule"):
+            match_partition_rules({"mystery": np.zeros((4, 4))})
+
+    def test_scalar_leaves_replicate(self):
+        axes = match_partition_rules({"embed": {"tokens": np.zeros((8, 8))}, "t": np.float32(1.0)})
+        assert axes["t"] == ()
+
+    def test_shardings_layout(self):
+        mesh = _mesh(4)
+        sh = serving_param_shardings(PARAMS, mesh)
+        assert sh["layers"]["wq"].spec == jax.sharding.PartitionSpec(
+            None, None, "tp", None
+        )
+        assert sh["layers"]["attn_norm"].spec == jax.sharding.PartitionSpec(
+            None, None
+        )
+        assert kv_cache_sharding(mesh).spec == jax.sharding.PartitionSpec(
+            None, None, None, "tp", None
+        )
+
+    def test_non_divisible_dim_raises_naming_the_leaf(self):
+        bad = {"embed": {"tokens": np.zeros((250, 64), np.float32)}}  # 250 % 4
+        with pytest.raises(ShardingError, match="embed/tokens.*not divisible"):
+            serving_param_shardings(bad, _mesh(4))
+
+    def test_shard_serving_params_lands_sharded(self):
+        sp = shard_serving_params(PARAMS, _mesh(4))
+        wq = sp["layers"]["wq"]
+        assert not wq.sharding.is_fully_replicated
+        # each chip holds 1 of the 4 heads
+        assert wq.addressable_shards[0].data.shape == (2, 64, 1, 16)
+
+
+# -- shard-aware cache allocation ----------------------------------------------
+
+
+class TestShardedCacheInit:
+    def test_contiguous_allocates_heads_sharded(self):
+        sh = kv_cache_sharding(_mesh(4))
+        cache = init_cache(CFG, 2, 16, shardings=sh)
+        assert cache["k"].sharding.spec[3] == "tp"
+        # per-shard slice: Hkv/4 heads of every slot row
+        assert cache["k"].addressable_shards[0].data.shape == (2, 2, 16, 1, 16)
+
+    def test_paged_allocates_heads_sharded_int8(self):
+        sh = kv_cache_sharding(_mesh(2))
+        cache = init_paged_cache(CFG, 9, 4, kv_quant="int8", shardings=sh)
+        assert cache["k"].dtype == jnp.int8
+        for name in ("k", "v", "k_s", "v_s"):
+            assert cache[name].sharding.spec[3] == "tp", name
+        assert cache["k"].addressable_shards[0].data.shape == (2, 9, 4, 2, 16)
+
+    def test_non_divisible_kv_heads_rejected(self):
+        sh = kv_cache_sharding(_mesh(4))
+        with pytest.raises(ValueError, match="not divisible"):
+            init_cache(LlamaConfig.tiny(), 2, 16, shardings=sh)  # 2 KV heads
+
+
+# -- token identity: the gate --------------------------------------------------
+
+
+class TestTokenIdentity:
+    """Sharded greedy == single-chip greedy == one-shot generate, with
+    staggered slot reuse (twice as many requests as slots)."""
+
+    @pytest.mark.parametrize("kv_quant", ["", "int8"])
+    @pytest.mark.parametrize("paged", [False, True])
+    def test_matrix_tp4(self, paged, kv_quant):
+        kwargs = dict(num_slots=SLOTS, max_len=S + T, kv_quant=kv_quant)
+        if paged:
+            single = PagedModelExecutor(PARAMS, CFG, page_size=4, **kwargs)
+            sharded = ShardedPagedModelExecutor(
+                PARAMS, CFG, mesh=_mesh(4), page_size=4, **kwargs
+            )
+        else:
+            single = ModelExecutor(PARAMS, CFG, **kwargs)
+            sharded = ShardedModelExecutor(PARAMS, CFG, mesh=_mesh(4), **kwargs)
+        base = _drain(ServingEngine(single))
+        multi = _drain(ServingEngine(sharded))
+        assert multi == base
+        for i, p in enumerate(PROMPTS):
+            assert multi[f"r{i}"] == _ref(PARAMS, p, kv_quant=kv_quant), i
+
+    def test_contiguous_tp2(self):
+        sharded = ShardedModelExecutor(
+            PARAMS, CFG, mesh=_mesh(2), num_slots=SLOTS, max_len=S + T
+        )
+        multi = _drain(ServingEngine(sharded))
+        for i, p in enumerate(PROMPTS):
+            assert multi[f"r{i}"] == _ref(PARAMS, p), i
+
+    def test_quantized_weights_tp2(self):
+        """int8 weight-only params (QTensor leaves) shard through the same
+        rules: q on its tp dims, collapsed scale dims replicated — and
+        the sharded engine still matches the single-chip engine token for
+        token (the quantization error is identical on both sides)."""
+        from tpu_nexus.models.quant import quantize_params
+
+        qp = quantize_params(PARAMS)
+        single = _drain(
+            ServingEngine(ModelExecutor(qp, CFG, num_slots=SLOTS, max_len=S + T))
+        )
+        sharded = _drain(
+            ServingEngine(
+                ShardedModelExecutor(
+                    qp, CFG, mesh=_mesh(2), num_slots=SLOTS, max_len=S + T
+                )
+            )
+        )
+        assert sharded == single
+
+    def test_speculative_ngram_tp4(self):
+        """Speculation composes with sharding unchanged: the verify jit
+        carries the same explicit shardings, acceptance stays the greedy
+        oracle, so emitted streams still equal one-shot generate."""
+        sharded = ShardedModelExecutor(
+            PARAMS, CFG, mesh=_mesh(4), num_slots=SLOTS, max_len=S + T
+        )
+        eng = ServingEngine(sharded, spec_k=2, drafter=NGramDrafter(SLOTS))
+        multi = _drain(eng)
+        for i, p in enumerate(PROMPTS):
+            assert multi[f"r{i}"] == _ref(PARAMS, p), i
+        assert eng.metrics.summary()["spec_proposed"] > 0
+
+    def test_overlap_multistep_tp4(self):
+        """Overlap + in-jit multi-step decode over the sharded step_scan:
+        the deferred device carries stay replicated device arrays, fed
+        straight back as the next dispatch's operands."""
+        sharded = ShardedModelExecutor(
+            PARAMS, CFG, mesh=_mesh(4), num_slots=SLOTS, max_len=S + T,
+            decode_steps=4,
+        )
+        eng = ServingEngine(sharded, overlap=True)
+        multi = _drain(eng)
+        for i, p in enumerate(PROMPTS):
+            assert multi[f"r{i}"] == _ref(PARAMS, p), i
+
+
+# -- shard-aware weight swaps (rolling updates) --------------------------------
+
+
+def _checkpointed(tmp_path, params, step=2):
+    from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+
+    ck = TensorCheckpointer(str(tmp_path / "ckpt"))
+    ck.save(step, {"params": params})
+    ck.commit(step)
+    return ck
+
+
+class TestShardedSwap:
+    """ISSUE 13 satellite: rolling update over a SHARDED replica from a
+    real orbax checkpoint — zero host gather on the swap path (transfer
+    guard), in-flight token-identical to generate(OLD), post-swap
+    admissions to generate(NEW)."""
+
+    @pytest.mark.parametrize("tp", [2, 4])
+    def test_swap_lands_sharded_without_host_gather(self, tmp_path, tp):
+        ck = _checkpointed(tmp_path, PARAMS_NEW)
+        try:
+            executor = ShardedModelExecutor(
+                PARAMS, CFG, mesh=_mesh(tp), num_slots=2, max_len=S + T
+            )
+            eng = ServingEngine(executor)
+            inflight = [eng.submit(PROMPTS[i], T, request_id=f"old{i}") for i in range(2)]
+            for _ in range(2):
+                eng.step()
+            assert any(not r.is_terminal() for r in inflight)
+            straddler = eng.submit(PROMPTS[2], T, request_id="straddler")
+
+            eng.quiesce(grace_s=60.0)
+            assert straddler.state == RequestState.QUEUED
+            new_params = ck.restore_params(2)  # NX008: deep-verified restore
+            # the swap itself must NEVER gather device state to host: the
+            # verified HOST tree device_puts straight onto each shard (the
+            # runtime flavor of NX014's static scope over sharded.py)
+            with jax.transfer_guard_device_to_host("disallow"):
+                eng.swap_params(new_params)
+            eng.resume_admission()
+
+            # new params landed SHARDED, same layout as construction
+            wq = eng.executor.params["layers"]["wq"]
+            assert wq.sharding.spec == jax.sharding.PartitionSpec(
+                None, None, "tp", None
+            )
+            for i, req in enumerate(inflight):
+                assert req.state == RequestState.FINISHED
+                assert list(req.output_tokens) == _ref(PARAMS, PROMPTS[i]), i
+            post = eng.submit(PROMPTS[0], T, request_id="post")
+            eng.run_until_drained(max_steps=2000)
+            assert list(post.output_tokens) == _ref(PARAMS_NEW, PROMPTS[0])
+            assert list(straddler.output_tokens) == _ref(PARAMS_NEW, PROMPTS[2])
+            assert eng.weight_swaps == 1
+        finally:
+            ck.close()
+
+    def test_fleet_rolling_update_over_sharded_replicas(self, tmp_path):
+        """The PR 7 fleet machinery drives sharded replicas untouched:
+        ONE host-tree restore serves every replica, each landing it
+        per-shard at its own swap seam; zero requests dropped."""
+        ck = _checkpointed(tmp_path, PARAMS_NEW)
+        try:
+            fleet = ServingFleet()
+            for name in ("rep-0", "rep-1"):
+                executor = ShardedModelExecutor(
+                    PARAMS, CFG, mesh=_mesh(2), num_slots=2, max_len=S + T
+                )
+                fleet.add_replica(name, ServingEngine(executor), step=1)
+            assert fleet.start_rollout(ck, 2, grace_s=60.0)
+            reqs = []
+            for i in range(8):
+                reqs.append(fleet.submit(PROMPTS[i % len(PROMPTS)], T))
+                fleet.tick()
+            for _ in range(500):
+                fleet.tick()
+                if not fleet.rollout_active and not fleet.has_work:
+                    break
+            fleet.run_until_drained()
+            assert fleet.converged(2)
+            assert fleet.rollouts_completed == 1
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            # every replica's params landed sharded on ITS mesh
+            for rep in fleet.replicas.values():
+                wq = rep.engine.executor.params["layers"]["wq"]
+                assert wq.sharding.spec == jax.sharding.PartitionSpec(
+                    None, None, "tp", None
+                )
+            # post-rollout traffic serves the NEW weights, token-exact
+            post = fleet.submit(PROMPTS[1], T)
+            fleet.run_until_drained()
+            assert list(post.output_tokens) == _ref(PARAMS_NEW, PROMPTS[1])
+        finally:
+            ck.close()
+
+    def test_mismatched_swap_still_refused(self):
+        executor = ShardedModelExecutor(
+            PARAMS, CFG, mesh=_mesh(2), num_slots=1, max_len=16
+        )
+        eng = ServingEngine(executor)
+        truncated = jax.tree.map(lambda leaf: leaf[..., :1], PARAMS)
+        with pytest.raises(ValueError, match="shapes"):
+            eng.swap_params(truncated)
+
+
+# -- serve loop e2e ------------------------------------------------------------
+
+
+class TestServeLoopSharded:
+    def test_serve_engine_under_mesh(self):
+        """NEXUS_SERVE_MESH=tp=2 through run_serve_engine: same ledger
+        contract, sharded executors."""
+        from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+        from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+        from tpu_nexus.parallel.distributed import ProcessContext
+        from tpu_nexus.workload.serve import run_serve_engine
+
+        ctx = ProcessContext(
+            run_id="serve-tp", algorithm="llama-serve", process_id=0,
+            num_processes=1, coordinator=None,
+        )
+        store = InMemoryCheckpointStore()
+        store.upsert_checkpoint(
+            CheckpointedRequest(
+                algorithm=ctx.algorithm, id=ctx.run_id,
+                lifecycle_stage=LifecycleStage.BUFFERED,
+            )
+        )
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=3, rounds=1, serve_mesh="tp=2",
+        )
+        summary = run_serve_engine(cfg, store=store, ctx=ctx)
+        row = store.read_checkpoint(ctx.algorithm, ctx.run_id)
+        assert row.lifecycle_stage == LifecycleStage.COMPLETED
+        assert summary["finished"] == 2

@@ -724,6 +724,52 @@ def test_nx012_silent_without_mesh_module():
     assert lint_source('spec = P("bogus")', "NX012") == []
 
 
+def test_nx012_ruletable_values_checked():
+    """ISSUE 13 extension: a RuleTable-annotated logical->mesh-axis dict
+    (parallel/sharding.py's tables, the serving rule table that
+    serving/sharded.py layers on them) has its VALUES checked — spec_for
+    validates only the logical KEYS at runtime, so a typo'd mesh axis in
+    a value would otherwise sail through to GSPMD."""
+    src = """
+    from tpu_nexus.parallel.sharding import RuleTable
+
+    LOGICAL_RULES_SERVE_TP: RuleTable = {
+        "batch": None,
+        "heads": "tpp",
+        "kv_heads": "tp",
+    }
+    """
+    findings = lint_source(src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)])
+    assert len(findings) == 1 and "'tpp'" in findings[0].message
+
+
+def test_nx012_ruletable_tuple_values_and_merge_checked():
+    src = """
+    from tpu_nexus.parallel.sharding import RuleTable
+
+    BASE: RuleTable = {"batch": ("dp", "fsdpp")}
+    DERIVED: RuleTable = {**BASE, "layers": "ppp"}
+    """
+    findings = lint_source(src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)])
+    blob = "\n".join(f.message for f in findings)
+    assert len(findings) == 2
+    assert "'fsdpp'" in blob and "'ppp'" in blob
+
+
+def test_nx012_ruletable_keys_and_plain_dicts_not_checked():
+    """Keys are LOGICAL names (any vocabulary); un-annotated dicts (the
+    serving REGEX rules map regexes to logical axes, not mesh axes) stay
+    out of scope."""
+    src = """
+    from tpu_nexus.parallel.sharding import RuleTable
+
+    OK: RuleTable = {"my_custom_logical_dim": "tp", "other": None}
+    NOT_A_RULETABLE = {"anything": "goes_here"}
+    RULES = (("layers/wq", ("layers", "embed", "heads", "head_dim")),)
+    """
+    assert lint_source(src, "NX012", extra=[("parallel/mesh.py", MESH_SRC)]) == []
+
+
 # -- the tier-1 gate -----------------------------------------------------------
 
 
@@ -1533,6 +1579,28 @@ def test_nx014_overlap_module_is_in_scope():
 def test_nx014_overlap_materialize_helper_is_seam():
     src = "def _materialize(pending):\n    return np.asarray(pending.result[0])\n"
     assert _lint_nx014(src, rel_path="tpu_nexus/serving/overlap.py") == []
+
+
+def test_nx014_sharded_module_is_in_scope():
+    """ISSUE 13: serving/sharded.py is whole-module in scope — a readback
+    on the shard-aware swap path is a host GATHER of sharded params."""
+    src = """
+    class _ShardedExecutorMixin:
+        def _install_params(self, params):
+            staged = np.asarray(self.params)  # the forbidden host gather
+            return self._jax.device_put(params, self._param_shardings)
+    """
+    findings = _lint_nx014(src, rel_path="tpu_nexus/serving/sharded.py")
+    assert [f.rule_id for f in findings] == ["NX014"]
+
+
+def test_nx014_sharded_device_put_is_not_a_readback():
+    src = """
+    class _ShardedExecutorMixin:
+        def _install_params(self, params):
+            return self._jax.device_put(params, self._param_shardings)
+    """
+    assert _lint_nx014(src, rel_path="tpu_nexus/serving/sharded.py") == []
 
 
 def test_nx014_other_modules_and_executors_out_of_scope():

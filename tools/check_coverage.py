@@ -40,6 +40,7 @@ REQUIRED = [
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates
     "tpu_nexus/serving/overlap.py",             # deferred-dispatch ledgers
     "tpu_nexus/serving/recovery.py",
+    "tpu_nexus/serving/sharded.py",             # tensor-parallel executors + shard-aware swaps
     "tpu_nexus/serving/speculative.py",         # drafting + verify-k acceptance
 
     "tpu_nexus/supervisor/taxonomy.py",

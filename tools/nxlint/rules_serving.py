@@ -443,6 +443,12 @@ class DrafterParityRule(Rule):
 # -- NX014: no blocking readback in the engine dispatch loop --------------------
 
 OVERLAP_PATH = "serving/overlap.py"
+#: the sharded executors (ISSUE 13) are ALSO whole-module in scope: their
+#: contract is that params/cache only ever move host->device or
+#: device->device (per-shard device_put at construction and at the
+#: swap_params seam) — one stray readback there is a fleet-wide host
+#: GATHER of a sharded param tree during a rolling update
+SHARDED_PATH = "serving/sharded.py"
 ENGINE_CLASS = "ServingEngine"
 
 #: the sanctioned deferred-materialize seam: functions whose name carries
@@ -487,10 +493,13 @@ class DispatchLoopReadbackRule(Rule):
     re-serializes it silently (the bench regresses, nothing errors).
     Scope: every method of ``ServingEngine`` (serving/engine.py) plus all
     of serving/overlap.py (the pending-step bookkeeping, which holds
-    device handles and must treat them as opaque); the seam is any
-    function named ``_materialize*``.  The executors' synchronous entry
-    points (``step``/``begin``/``verify``) are deliberately OUT of scope:
-    they ARE the blocking oracle path the parity tests pin everything
+    device handles and must treat them as opaque) plus all of
+    serving/sharded.py (ISSUE 13: the shard-aware swap path must land
+    weights per-shard — a readback there is a host GATHER of sharded
+    params mid-rollout); the seam is any function named
+    ``_materialize*``.  The executors' synchronous entry points
+    (``step``/``begin``/``verify``) are deliberately OUT of scope: they
+    ARE the blocking oracle path the parity tests pin everything
     against.  Fails closed when the engine class disappears."""
 
     rule_id = "NX014"
@@ -502,7 +511,9 @@ class DispatchLoopReadbackRule(Rule):
     def check_module(self, module: Module) -> Iterator[Finding]:
         if module.tree is None:
             return
-        if module.rel_path.endswith(OVERLAP_PATH):
+        if module.rel_path.endswith(OVERLAP_PATH) or module.rel_path.endswith(
+            SHARDED_PATH
+        ):
             yield from self._scan(module, module.tree.body)
             return
         if not module.rel_path.endswith(ENGINE_PATH):

@@ -575,13 +575,25 @@ _SPEC_CALL_NAMES = frozenset({"P", "PartitionSpec"})
 _AXIS_KWARGS = frozenset({"axis_name", "axis_names"})
 
 
+#: annotation name that marks a logical-name -> MESH-AXIS mapping dict
+#: (``parallel/sharding.py`` and the serving rule tables): its VALUES are
+#: mesh-axis strings and fall under NX012; its KEYS are logical dimension
+#: names (any vocabulary) and deliberately do not
+_RULETABLE_ANNOTATION = "RuleTable"
+
+
 @register
 class MeshAxisLiteralRule(Rule):
-    """NX012: every string literal naming a mesh axis (``PartitionSpec``/``P``
-    arguments, ``axis_name=`` kwargs on collectives/shard_map) must be one of
-    the axes declared in ``parallel/mesh.py`` ``AXIS_ORDER``.  A typo'd axis
-    string fails only at trace time on a mesh that doesn't bind it — or binds
-    the wrong one."""
+    """NX012: every string literal naming a mesh axis — ``PartitionSpec``/
+    ``P`` arguments, ``axis_name=`` kwargs on collectives/shard_map, and
+    the VALUES of ``RuleTable``-annotated logical->mesh-axis dicts
+    (``parallel/sharding.py``'s tables and the serving rule table that
+    tpu_nexus/serving/sharded.py layers on them, ISSUE 13) — must be one
+    of the axes declared in ``parallel/mesh.py`` ``AXIS_ORDER``.  A
+    typo'd axis string fails only at trace time on a mesh that doesn't
+    bind it — or binds the wrong one; a typo'd RULE-TABLE value is worse:
+    ``spec_for`` only validates the LOGICAL names, so a bad mesh axis
+    sails through to GSPMD."""
 
     rule_id = "NX012"
     description = "mesh-axis string literals must name axes from parallel/mesh.py"
@@ -595,6 +607,8 @@ class MeshAxisLiteralRule(Rule):
             if module.tree is None or module is mesh_module:
                 continue
             for node in ast.walk(module.tree):
+                if isinstance(node, ast.AnnAssign):
+                    yield from self._check_ruletable(module, node, axes)
                 if not isinstance(node, ast.Call):
                     continue
                 name = _terminal_name(node.func)
@@ -604,6 +618,26 @@ class MeshAxisLiteralRule(Rule):
                 for kw in node.keywords:
                     if kw.arg in _AXIS_KWARGS:
                         yield from self._check_strings(module, kw.value, axes)
+
+    def _check_ruletable(
+        self, module: Module, node: ast.AnnAssign, axes: Set[str]
+    ) -> Iterator[Finding]:
+        """``NAME: RuleTable = {...}`` — every string in the dict's VALUES
+        (bare, or inside a tuple of axes) must be a canonical mesh axis.
+        Keys are logical names, not checked.  Non-dict values (an alias of
+        another table) are out of scope for a static pass."""
+        if _terminal_name(node.annotation) != _RULETABLE_ANNOTATION:
+            return
+        value = node.value
+        if isinstance(value, ast.Dict):
+            values = value.values
+        else:
+            return
+        for v in values:
+            # a ``{**BASE, "layers": "pp"}`` merge contributes its own
+            # literal values; the spread base is checked where IT is
+            # defined (same rule, that assignment)
+            yield from self._check_strings(module, v, axes)
 
     def _check_strings(self, module: Module, expr: ast.expr, axes: Set[str]) -> Iterator[Finding]:
         for child in ast.walk(expr):

@@ -27,6 +27,12 @@ TPU-first design:
   positions and pad-slot masks make each row exactly equal to its solo
   decode while the cache update stays a single dynamic slice.  (Never
   LEFT-pad: causal attention would attend pad tokens as real prefix.)
+* **Mesh-agnostic by contract** — nothing here names a mesh axis or
+  issues a collective.  Tensor-parallel serving (ISSUE 13,
+  tpu_nexus/serving/sharded.py) applies ``NamedSharding``s at the
+  executors' JIT boundaries and lets GSPMD partition these very
+  functions; the sharded-vs-single-chip token-identity tests pin that
+  this module needs NO semantic change to run multi-chip.
 """
 
 from __future__ import annotations

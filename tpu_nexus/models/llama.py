@@ -117,6 +117,17 @@ class LlamaConfig:
             head_dim=16, intermediate=128, max_seq_len=256, remat=False,
         )
 
+    @staticmethod
+    def tiny_tp(vocab_size: int = 256) -> "LlamaConfig":
+        """:meth:`tiny` with 4 KV heads: every sharded dimension (heads,
+        kv-heads, mlp, vocab) divides a 4-way ``tp`` mesh, so sharded-
+        serving drills and tests (ISSUE 13, NEXUS_SERVE_MESH=tp=4) run at
+        test scale — tiny's 2 KV heads cap tp at 2."""
+        return LlamaConfig(
+            vocab_size=vocab_size, hidden=64, n_layers=2, n_heads=4, n_kv_heads=4,
+            head_dim=16, intermediate=128, max_seq_len=256, remat=False,
+        )
+
 
 def llama_axes(cfg: LlamaConfig) -> Dict[str, Any]:
     """Logical-axis pytree mirroring :func:`llama_init`'s params structure.

@@ -57,6 +57,27 @@ LOGICAL_RULES_FSDP_TP_PP: RuleTable = {
     "layers": "pp",
 }
 
+#: the SERVING layout (ISSUE 13, tpu_nexus/serving/sharded.py): pure tensor
+#: parallelism over a slice — heads/kv-heads/mlp/vocab sharded on ``tp``
+#: (the KV cache and its decode-attention reads shard along kv_heads for
+#: free), everything token-wise replicated.  No fsdp: decode re-reads every
+#: weight each step, so per-layer all-gathers of fsdp-sharded params would
+#: cost exactly the HBM traffic TP serving exists to divide; no sp: decode
+#: queries are 1-8 tokens.  ``expert`` keeps ``ep`` so an expert-parallel
+#: serve mesh composes for MoE presets.
+LOGICAL_RULES_SERVE_TP: RuleTable = {
+    "batch": None,
+    "seq": None,
+    "embed": None,
+    "mlp": "tp",
+    "heads": "tp",
+    "kv_heads": "tp",
+    "head_dim": None,
+    "vocab": "tp",
+    "expert": "ep",
+    "layers": None,
+}
+
 
 def spec_for(logical_axes: Sequence[Optional[str]], rules: RuleTable) -> P:
     """PartitionSpec for one array given its per-dimension logical names.

@@ -29,6 +29,11 @@ Layering (each module imports only downward):
                        + ServingEngine (host loop: fault isolation,
                        deadlines, graceful drain, block-table admission,
                        the quiesce/swap_params rolling-update seam)
+* ``sharded``        — tensor-parallel executors (ISSUE 13): regex
+                       partition rules over the param tree, heads-sharded
+                       paged/contiguous KV, explicit jit shardings, and
+                       the no-host-gather shard-aware weight swap
+                       (NEXUS_SERVE_MESH)
 * ``fleet``          — ServingFleet replica router + zero-drop rolling
                        weight updates + FleetSupervisor (ISSUE 9: the
                        supervisor's control loop closed over serving —
@@ -63,6 +68,17 @@ from tpu_nexus.serving.fleet import (
     ServingFleet,
 )
 from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.sharded import (
+    SERVING_PARAM_RULES,
+    ShardedModelExecutor,
+    ShardedPagedModelExecutor,
+    ShardingError,
+    build_serve_mesh,
+    parse_serve_mesh,
+    serving_param_shardings,
+    shard_serving_params,
+    validate_serve_mesh,
+)
 from tpu_nexus.serving.overlap import DispatchPipeline, PendingStep, PipelineError
 from tpu_nexus.serving.speculative import (
     DRAFTERS,
@@ -112,17 +128,26 @@ __all__ = [
     "Request",
     "RequestState",
     "SCRATCH_BLOCK",
+    "SERVING_PARAM_RULES",
     "SchedulerConfig",
     "ServingEngine",
     "ServingFleet",
     "ServingMetrics",
+    "ShardedModelExecutor",
+    "ShardedPagedModelExecutor",
+    "ShardingError",
     "SlotError",
     "StepFault",
     "StepFaultPolicy",
     "TERMINAL_STATES",
     "TRANSITIONS",
     "accept_tokens",
+    "build_serve_mesh",
     "init_cache",
     "init_paged_cache",
+    "parse_serve_mesh",
     "percentile",
+    "serving_param_shardings",
+    "shard_serving_params",
+    "validate_serve_mesh",
 ]

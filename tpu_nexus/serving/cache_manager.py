@@ -45,13 +45,73 @@ class SlotError(RuntimeError):
     an engine bug surfaced loudly, never a recoverable traffic condition."""
 
 
-def init_cache(cfg: Any, num_slots: int, max_len: int, kv_quant: str = ""):
+def _kv_shard_count(shardings: Any, cfg: Any) -> int:
+    """How many ways ``shardings`` (a ``NamedSharding`` applied as a pytree
+    prefix to the whole cache dict — the serving/sharded.py contract)
+    splits the KV-HEAD axis (dim 3 of both cache layouts).  Used for the
+    per-shard-aware pool validation below; 1 when that dim is unsharded."""
+    spec = getattr(shardings, "spec", None)
+    mesh = getattr(shardings, "mesh", None)
+    if spec is None or mesh is None or len(spec) <= 3 or spec[3] is None:
+        return 1
+    axes = spec[3] if isinstance(spec[3], tuple) else (spec[3],)
+    n = 1
+    for axis in axes:
+        n *= dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    return n
+
+
+def _alloc_cache(kv_shape: tuple, cfg: Any, kv_quant: str, shardings: Any):
+    """Allocate the zeroed cache dict, DEVICE-SHARDED when ``shardings``
+    (a NamedSharding pytree prefix) is given: the zeros are created inside
+    a jit with ``out_shardings``, so each device materializes only its own
+    ``Hkv / shards`` slice — the pool never exists unsharded anywhere,
+    host or device.  Per-shard HBM is the full pool's bytes divided by the
+    head-shard count (kv-head divisibility is validated by the caller)."""
+    import jax.numpy as jnp
+
+    def build():
+        if kv_quant == "int8":
+            scale_shape = kv_shape[:-1] + (1,)
+            return {
+                "k": jnp.zeros(kv_shape, jnp.int8),
+                "v": jnp.zeros(kv_shape, jnp.int8),
+                "k_s": jnp.zeros(scale_shape, jnp.float32),
+                "v_s": jnp.zeros(scale_shape, jnp.float32),
+            }
+        return {
+            "k": jnp.zeros(kv_shape, cfg.dtype),
+            "v": jnp.zeros(kv_shape, cfg.dtype),
+        }
+
+    if shardings is None:
+        return build()
+    import jax
+
+    shards = _kv_shard_count(shardings, cfg)
+    if cfg.n_kv_heads % shards:
+        raise ValueError(
+            f"KV cache sharding splits the kv-head axis {shards} ways but "
+            f"the model has {cfg.n_kv_heads} KV heads — not divisible; "
+            "shrink the tp axis or pick a head count it divides"
+        )
+    return jax.jit(build, out_shardings=shardings)()
+
+
+def init_cache(
+    cfg: Any, num_slots: int, max_len: int, kv_quant: str = "", shardings: Any = None
+):
     """Zero-initialized decode cache ``{"k","v"[,"k_s","v_s"]}`` shaped
     ``[L, num_slots, max_len, Hkv, D]`` (scales ``[..., 1]`` f32), matching
     what :func:`tpu_nexus.models.generate.prefill` emits row-for-row so a
-    per-request prefill inserts with one dynamic-update-slice."""
-    import jax.numpy as jnp
+    per-request prefill inserts with one dynamic-update-slice.
 
+    ``shardings`` (ISSUE 13, serving/sharded.py): a ``NamedSharding``
+    applied as a pytree prefix to the whole dict — the buffers allocate
+    DEVICE-SHARDED (canonically heads-sharded along ``tp``: dim 3), each
+    chip holding ``Hkv / tp`` heads' worth of the pool; kv-head
+    divisibility is validated here so a bad mesh fails at allocation, not
+    deep inside XLA."""
     if kv_quant not in ("", "int8"):
         raise ValueError(f"unknown kv_quant mode {kv_quant!r}; use 'int8' or ''")
     if num_slots < 1:
@@ -61,27 +121,22 @@ def init_cache(cfg: Any, num_slots: int, max_len: int, kv_quant: str = ""):
             f"max_len must be >= 2 (one prompt + one generated token), got {max_len}"
         )
     kv_shape = (cfg.n_layers, num_slots, max_len, cfg.n_kv_heads, cfg.head_dim)
-    if kv_quant == "int8":
-        scale_shape = kv_shape[:-1] + (1,)
-        return {
-            "k": jnp.zeros(kv_shape, jnp.int8),
-            "v": jnp.zeros(kv_shape, jnp.int8),
-            "k_s": jnp.zeros(scale_shape, jnp.float32),
-            "v_s": jnp.zeros(scale_shape, jnp.float32),
-        }
-    return {
-        "k": jnp.zeros(kv_shape, cfg.dtype),
-        "v": jnp.zeros(kv_shape, cfg.dtype),
-    }
+    return _alloc_cache(kv_shape, cfg, kv_quant, shardings)
 
 
-def init_paged_cache(cfg: Any, num_blocks: int, page_size: int, kv_quant: str = ""):
+def init_paged_cache(
+    cfg: Any, num_blocks: int, page_size: int, kv_quant: str = "", shardings: Any = None
+):
     """Zero-initialized PAGED decode cache ``{"k","v"[,"k_s","v_s"]}``
     shaped ``[L, num_blocks, page_size, Hkv, D]`` (scales ``[..., 1]``
     f32).  Block 0 is the reserved scratch block (see module doc); the
-    usable token capacity is ``(num_blocks - 1) * page_size``."""
-    import jax.numpy as jnp
+    usable token capacity is ``(num_blocks - 1) * page_size``.
 
+    ``shardings`` (ISSUE 13): same contract as :func:`init_cache` — the
+    block pool allocates heads-sharded, so ``num_blocks`` stays a GLOBAL
+    logical count (block tables, refcounts and admission math are
+    mesh-agnostic) while each chip stores only its ``Hkv / tp`` head
+    slice of every block: per-shard HBM = pool bytes / tp."""
     if kv_quant not in ("", "int8"):
         raise ValueError(f"unknown kv_quant mode {kv_quant!r}; use 'int8' or ''")
     if page_size < 1:
@@ -91,18 +146,7 @@ def init_paged_cache(cfg: Any, num_blocks: int, page_size: int, kv_quant: str = 
             f"num_blocks must be >= 2 (scratch block 0 + one usable), got {num_blocks}"
         )
     kv_shape = (cfg.n_layers, num_blocks, page_size, cfg.n_kv_heads, cfg.head_dim)
-    if kv_quant == "int8":
-        scale_shape = kv_shape[:-1] + (1,)
-        return {
-            "k": jnp.zeros(kv_shape, jnp.int8),
-            "v": jnp.zeros(kv_shape, jnp.int8),
-            "k_s": jnp.zeros(scale_shape, jnp.float32),
-            "v_s": jnp.zeros(scale_shape, jnp.float32),
-        }
-    return {
-        "k": jnp.zeros(kv_shape, cfg.dtype),
-        "v": jnp.zeros(kv_shape, cfg.dtype),
-    }
+    return _alloc_cache(kv_shape, cfg, kv_quant, shardings)
 
 
 class KVSlotManager:

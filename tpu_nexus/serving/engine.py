@@ -186,6 +186,26 @@ class _ExecutorCommon:
         self._key, sub = self._jax.random.split(self._key)
         return sub
 
+    def _make_jit(self, fn, *, donate=(), nargs, out, params_arg=0, cache_arg=1):
+        """Build one jitted executor entry point.  The base executors jit
+        plainly; the SHARDED executors (serving/sharded.py, ISSUE 13)
+        override this to pin explicit ``in_shardings``/``out_shardings``
+        on every entry — which is why each call site describes its
+        signature: ``nargs`` positional operands, ``params_arg``/
+        ``cache_arg`` naming where the param tree and the KV cache sit
+        (None = absent), and ``out`` tagging each output ``"cache"`` (the
+        KV buffer, heads-sharded under a mesh) or ``"r"`` (replicated
+        host-facing scalars/tokens)."""
+        del nargs, out, params_arg, cache_arg  # base: single-device jit
+        return self._jax.jit(fn, donate_argnums=donate)
+
+    def _install_params(self, params):
+        """How validated swap_params weights land on the device(s).  Base:
+        params ride jitted calls as plain arguments, nothing to move.  The
+        sharded executors override this with a per-shard ``device_put`` —
+        the NO-HOST-GATHER half of the shard-aware swap contract."""
+        return params
+
     def _bucket(self, prompt_len: int) -> int:
         for w in self._buckets:
             if w >= prompt_len:
@@ -231,7 +251,7 @@ class _ExecutorCommon:
                 "differ from the serving params — wrong checkpoint or "
                 "missing quantization transform"
             )
-        self.params = params
+        self.params = self._install_params(params)
 
     def _guard_cache(self, exc: RuntimeError) -> None:
         """After a faulted jitted call: if the DONATED cache buffer was
@@ -294,7 +314,7 @@ class ModelExecutor(_ExecutorCommon):
             decode_steps=decode_steps, stop_token=stop_token,
         )
         jnp = jax.numpy
-        self.cache = init_cache(cfg, num_slots, max_len, kv_quant)
+        self.cache = self._fresh_cache()
 
         def _begin(params, cache, padded, lengths, slot, key):
             # prefill + slot insert + first-token sample in ONE jitted call
@@ -314,7 +334,9 @@ class ModelExecutor(_ExecutorCommon):
             )
             return cache, self._sample(logits, key)
 
-        self._begin = jax.jit(_begin, donate_argnums=self._donate)
+        self._begin = self._make_jit(
+            _begin, donate=self._donate, nargs=6, out=("cache", "r")
+        )
 
         def _step(params, cache, tokens, cursors, key):
             logits, cache = decode_step(
@@ -322,7 +344,9 @@ class ModelExecutor(_ExecutorCommon):
             )
             return self._sample(logits, key), cache
 
-        self._step = jax.jit(_step, donate_argnums=self._donate)
+        self._step = self._make_jit(
+            _step, donate=self._donate, nargs=5, out=("r", "cache")
+        )
 
         def _verify(params, cache, block, cursors):
             # multi-query speculative verify (greedy-only — the engine
@@ -334,7 +358,9 @@ class ModelExecutor(_ExecutorCommon):
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        self._verify = jax.jit(_verify, donate_argnums=self._donate)
+        self._verify = self._make_jit(
+            _verify, donate=self._donate, nargs=4, out=("r", "cache")
+        )
 
         def _scan(params, cache, prev_tok, prev_pos, override, tok, pos, limits, key):
             # deferred/multi-step decode (ISSUE 12): merge the host
@@ -352,7 +378,10 @@ class ModelExecutor(_ExecutorCommon):
                 stop_token=self.stop_token, decode_kernel=decode_kernel,
             )
 
-        self._scan = jax.jit(_scan, donate_argnums=self._donate)
+        self._scan = self._make_jit(
+            _scan, donate=self._donate, nargs=9,
+            out=("r", "r", "r", "r", "cache"),
+        )
 
     def _fresh_cache(self):
         return init_cache(self.cfg, self.num_slots, self.max_len, self.kv_quant)
@@ -531,7 +560,7 @@ class PagedModelExecutor(_ExecutorCommon):
             # explicit num_blocks sized to the HBM you actually have.
             num_blocks = 1 + num_slots * self.blocks_per_slot
         self.num_blocks = num_blocks
-        self.cache = init_paged_cache(cfg, num_blocks, page_size, kv_quant)
+        self.cache = self._fresh_cache()
         #: prompt tokens that actually ran through a prefill/extend
         #: forward; shared-prefix tokens never count here
         self.prefilled_tokens = 0
@@ -555,7 +584,9 @@ class PagedModelExecutor(_ExecutorCommon):
             }
             return cache, self._sample(logits, key)
 
-        self._begin = jax.jit(_begin, donate_argnums=self._donate)
+        self._begin = self._make_jit(
+            _begin, donate=self._donate, nargs=6, out=("cache", "r")
+        )
 
         def _extend(params, cache, padded, start, lengths, bt_row, key):
             # prefix hit: run only the tail, attending to the shared
@@ -571,7 +602,9 @@ class PagedModelExecutor(_ExecutorCommon):
             )
             return cache, self._sample(logits, key)
 
-        self._extend = jax.jit(_extend, donate_argnums=self._donate)
+        self._extend = self._make_jit(
+            _extend, donate=self._donate, nargs=7, out=("cache", "r")
+        )
 
         def _step(params, cache, tokens, cursors, tables, key):
             logits, cache = decode_step(
@@ -581,7 +614,9 @@ class PagedModelExecutor(_ExecutorCommon):
             )
             return self._sample(logits, key), cache
 
-        self._step = jax.jit(_step, donate_argnums=self._donate)
+        self._step = self._make_jit(
+            _step, donate=self._donate, nargs=6, out=("r", "cache")
+        )
 
         def _verify(params, cache, block, cursors, tables):
             # speculative multi-query verify through the block tables
@@ -593,7 +628,9 @@ class PagedModelExecutor(_ExecutorCommon):
             )
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
-        self._verify = jax.jit(_verify, donate_argnums=self._donate)
+        self._verify = self._make_jit(
+            _verify, donate=self._donate, nargs=5, out=("r", "cache")
+        )
 
         def _scan(params, cache, prev_tok, prev_pos, override, tok, pos, limits, tables, key):
             # paged deferred/multi-step decode: the contiguous _scan with
@@ -609,7 +646,10 @@ class PagedModelExecutor(_ExecutorCommon):
                 block_tables=tables, logical_limit=max_len,
             )
 
-        self._scan = jax.jit(_scan, donate_argnums=self._donate)
+        self._scan = self._make_jit(
+            _scan, donate=self._donate, nargs=10,
+            out=("r", "r", "r", "r", "cache"),
+        )
 
         def _cow(cache, src, dst):
             # copy-on-write block copy: one whole-block slice per leaf
@@ -618,8 +658,9 @@ class PagedModelExecutor(_ExecutorCommon):
                 for name, arr in cache.items()
             }
 
-        self._cow = jax.jit(
-            _cow, donate_argnums=(0,) if self._donate else ()
+        self._cow = self._make_jit(
+            _cow, donate=(0,) if self._donate else (), nargs=3,
+            out=("cache",), params_arg=None, cache_arg=0,
         )
 
     def _fresh_cache(self):

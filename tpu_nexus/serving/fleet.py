@@ -19,7 +19,9 @@ engine.  This module wires the two together:
   requests on the OLD weights (grace-bounded; stragglers evict with an
   honest cause) → swap params → resume.  The weights come from
   ``restore_params`` on a VERIFIED checkpoint step (nxlint NX008), so a
-  torn or rotten candidate can never be served.
+  torn or rotten candidate can never be served.  Sharded replicas
+  (NEXUS_SERVE_MESH, serving/sharded.py) swap WITHOUT a host gather: the
+  restored host tree device_puts per-shard at each replica's swap seam.
 * :class:`CheckpointWatcher` — polls
   :class:`~tpu_nexus.workload.durability.VerifiedStepPoller` (commit-marker
   presence is the trust anchor; a save without its manifest is invisible
@@ -119,7 +121,15 @@ class EngineReplica:
 class _Rollout:
     """One in-flight rolling update: walk ``order`` one replica at a time.
     ``params`` is loaded lazily on the FIRST swap (one verified restore
-    serves the whole fleet) and cached for the remaining replicas."""
+    serves the whole fleet) and cached for the remaining replicas.
+
+    Sharded replicas (ISSUE 13, serving/sharded.py): ``params`` stays the
+    restored HOST tree — each replica's ``swap_params`` lands it through
+    the executor's ``_install_params`` seam, which on a sharded executor
+    is a per-shard ``device_put`` (every chip receives only its slice;
+    the replica's OLD sharded params are never gathered to host).  One
+    restore therefore serves a whole fleet of multi-chip replicas, each
+    slicing the same tree onto its own mesh."""
 
     source: Any  # TensorCheckpointer-shaped: restore_params(step)
     step: int

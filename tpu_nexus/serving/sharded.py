@@ -1,0 +1,484 @@
+"""Tensor-parallel SHARDED serving: the multi-chip executors (ISSUE 13).
+
+The training side has run a pp/dp/fsdp/ep/sp/tp mesh since the multichip
+rounds (MULTICHIP_r05.json); this module ports the model-parallel
+machinery to the serving path so one replica decodes across a slice
+instead of one chip:
+
+* **Regex partition rules** (:data:`SERVING_PARAM_RULES`) map the serving
+  param tree's ``/``-joined leaf paths to LOGICAL axis tuples — the
+  ``match_partition_rules`` pattern (SNIPPETS.md [2]) layered on
+  :mod:`tpu_nexus.parallel.sharding`'s ``RuleTable``/``spec_for``: the
+  regexes know the pytree, the rule table knows the mesh, and swapping
+  the table re-lays the whole model.  The default table
+  (``LOGICAL_RULES_SERVE_TP``) shards heads/kv-heads/mlp/vocab over
+  ``tp`` and replicates everything token-wise (no fsdp: decode re-reads
+  every weight per step, so per-layer all-gathers would cost exactly the
+  HBM traffic TP divides).  Unmatched leaves RAISE — a silently
+  replicated weight defeats the sharding far from the typo.
+* **Sharded executors** (:class:`ShardedModelExecutor` /
+  :class:`ShardedPagedModelExecutor`): the existing executors with every
+  jitted entry point — bucketed prefill+insert, ``extend_step``, decode
+  step, speculative verify, the in-jit multi-step ``step_scan``, the COW
+  block copy — compiled under explicit ``in_shardings``/``out_shardings``
+  (via the :meth:`_make_jit` seam): params sharded per the rules, the KV
+  pool heads-sharded along ``tp`` (dim 3 of both cache layouts — block
+  tables, cursors and every host-override scalar stay replicated), host-
+  facing outputs replicated.  The ENGINE is untouched: the executor
+  contract (``begin``/``step``/``verify``/``step_scan``) is identical,
+  so paging, speculation, overlap, fault isolation and rolling updates
+  all run sharded without knowing it.
+* **Shard-aware lifecycle**: ``init_cache``/``init_paged_cache`` allocate
+  the pool device-sharded (each chip holds ``Hkv / tp`` heads of every
+  slot/block — ``num_blocks`` stays a GLOBAL count, admission math is
+  mesh-agnostic), and ``swap_params`` (PR 7's rolling-update seam)
+  installs verified weights with a per-shard ``device_put`` — the host
+  tree slices straight onto each chip, NEVER gathering the old params to
+  host (nxlint NX014 covers this module; the rollout tests pin it with a
+  device-to-host transfer guard).
+
+Correctness is gated on TOKEN IDENTITY: the sharded engine's greedy
+streams equal the single-chip engine's and one-shot ``generate``'s on a
+multi-device CPU mesh (``tests/test_sharded_serving.py`` — the same
+virtual-device trick the multichip training tests use).
+
+Env contract: ``NEXUS_SERVE_MESH="tp=4"`` (comma-separated ``axis=size``
+pairs validated against ``parallel/mesh.py`` ``AXIS_ORDER`` — unknown
+axes, non-divisible head counts and meshes larger than the device count
+are rejected at ``ServeConfig`` parse).  docs/SERVING.md "Sharded
+serving" has the layout and the RUNBOOK drill.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tpu_nexus.serving.engine import ModelExecutor, PagedModelExecutor
+
+__all__ = [
+    "SERVING_PARAM_RULES",
+    "ShardingError",
+    "ShardedModelExecutor",
+    "ShardedPagedModelExecutor",
+    "build_serve_mesh",
+    "kv_cache_sharding",
+    "match_partition_rules",
+    "parse_serve_mesh",
+    "serving_param_shardings",
+    "shard_serving_params",
+    "validate_serve_mesh",
+]
+
+
+class ShardingError(ValueError):
+    """A serving-sharding config fact: unknown mesh axis, non-divisible
+    head/width counts, a param leaf no rule matches.  ValueError so
+    ``ServeConfig`` parse-time validation reports it like every other bad
+    env value."""
+
+
+#: regex -> logical-axis tuple over ``/``-joined param-tree paths, FIRST
+#: match (with matching rank) wins — the SNIPPETS.md [2]
+#: ``match_partition_rules`` pattern.  Covers BOTH model families (the
+#: Llama dense stack and the MoE expert stack share attention paths; the
+#: rank check disambiguates ``w_gate``/``w_up``/``w_down``, which are
+#: rank-3 dense but rank-4 expert-stacked) and the int8 weight transform
+#: (``QTensor`` leaves flatten to ``<name>/0`` q + ``<name>/1`` scales,
+#: matched by the un-anchored tensor-name regex; scale dims collapsed to
+#: 1 by the per-channel recipe are replicated by
+#: :func:`serving_param_shardings`).  The axis NAMES here are logical —
+#: mesh axes come from the RuleTable (nxlint NX012 gates those).
+SERVING_PARAM_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
+    ("embed/tokens", ("vocab", "embed")),
+    ("layers/attn_norm$", ("layers", "embed")),
+    ("layers/mlp_norm$", ("layers", "embed")),
+    ("layers/wq", ("layers", "embed", "heads", "head_dim")),
+    ("layers/wk", ("layers", "embed", "kv_heads", "head_dim")),
+    ("layers/wv", ("layers", "embed", "kv_heads", "head_dim")),
+    ("layers/wo", ("layers", "heads", "head_dim", "embed")),
+    # dense (Llama) MLP: [L, E, F] / [L, F, E]
+    ("layers/w_gate", ("layers", "embed", "mlp")),
+    ("layers/w_up", ("layers", "embed", "mlp")),
+    ("layers/w_down", ("layers", "mlp", "embed")),
+    # MoE expert stacks carry a leading expert axis: [L, n_exp, E, F]
+    ("layers/w_gate", ("layers", "expert", "embed", "mlp")),
+    ("layers/w_up", ("layers", "expert", "embed", "mlp")),
+    ("layers/w_down", ("layers", "expert", "mlp", "embed")),
+    ("layers/router", ("layers", "embed", None)),  # n_exp is tiny: replicate
+    ("out_norm$", ("embed",)),
+    ("lm_head", ("embed", "vocab")),
+)
+
+#: logical axes of BOTH KV cache layouts — contiguous ``[L, num_slots,
+#: max_len, Hkv, D]`` and paged ``[L, num_blocks, page_size, Hkv, D]``
+#: agree that dim 3 is the kv-head axis (the int8 scale leaves too, with
+#: their trailing 1); one spec serves the whole cache dict as a pytree
+#: prefix.  Slots/blocks and positions are deliberately NOT sharded:
+#: heads-sharding keeps every token's full prefix local to the chip that
+#: owns the head, so decode attention needs NO cross-chip collective.
+KV_CACHE_AXES: Tuple[Optional[str], ...] = (
+    "layers", None, None, "kv_heads", None,
+)
+
+
+# -- mesh config (NEXUS_SERVE_MESH) --------------------------------------------
+
+
+def parse_serve_mesh(spec: str) -> Dict[str, int]:
+    """Parse ``NEXUS_SERVE_MESH`` (``"tp=4"`` / ``"ep=2,tp=2"``) into an
+    axis->size dict, validated against ``parallel/mesh.py`` AXIS_ORDER —
+    an unknown or duplicate axis, or a size < 1, raises at parse time (a
+    typo'd axis silently serving single-chip is the failure mode this
+    exists to prevent)."""
+    from tpu_nexus.parallel.mesh import AXIS_ORDER
+
+    axes: Dict[str, int] = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        m = re.fullmatch(r"([a-z]+)\s*=\s*(-?\d+)", part)
+        if m is None:
+            raise ShardingError(
+                f"malformed NEXUS_SERVE_MESH entry {part!r}; expected "
+                "comma-separated axis=size pairs, e.g. 'tp=4'"
+            )
+        name, size = m.group(1), int(m.group(2))
+        if name not in AXIS_ORDER:
+            raise ShardingError(
+                f"unknown mesh axis {name!r} in NEXUS_SERVE_MESH; "
+                f"parallel/mesh.py declares {', '.join(AXIS_ORDER)}"
+            )
+        if name in axes:
+            raise ShardingError(f"duplicate mesh axis {name!r} in NEXUS_SERVE_MESH")
+        if size < 1:
+            raise ShardingError(
+                f"mesh axis {name!r} size must be >= 1, got {size}"
+            )
+        axes[name] = size
+    if not axes:
+        raise ShardingError("empty NEXUS_SERVE_MESH; expected axis=size pairs")
+    return axes
+
+
+def validate_serve_mesh(
+    axes: Dict[str, int], model_cfg: Any, n_devices: Optional[int] = None
+) -> None:
+    """Fail-fast checks a serve mesh must pass BEFORE any device work:
+    total size fits the available devices, and the tp/ep factors divide
+    the model's sharded dimensions (heads, kv-heads, mlp width, vocab —
+    a non-divisible head count would otherwise die deep inside GSPMD
+    with a shape error naming no config knob)."""
+    size = 1
+    for s in axes.values():
+        size *= s
+    if n_devices is None:
+        import jax
+
+        n_devices = jax.device_count()
+    if size > n_devices:
+        raise ShardingError(
+            f"NEXUS_SERVE_MESH wants {size} devices "
+            f"({', '.join(f'{k}={v}' for k, v in axes.items())}) but only "
+            f"{n_devices} are available"
+        )
+    tp = axes.get("tp", 1)
+    if tp > 1:
+        for attr, what in (
+            ("n_heads", "attention heads"),
+            ("n_kv_heads", "KV heads"),
+            ("intermediate", "MLP width"),
+            ("vocab_size", "vocab"),
+        ):
+            dim = getattr(model_cfg, attr, None)
+            if dim is not None and dim % tp:
+                raise ShardingError(
+                    f"tp={tp} does not divide the model's {dim} {what} "
+                    f"({attr}) — pick a tp that divides every sharded "
+                    "dimension"
+                )
+    ep = axes.get("ep", 1)
+    if ep > 1:
+        n_exp = getattr(model_cfg, "n_experts", None)
+        if n_exp is None:
+            raise ShardingError(
+                f"ep={ep} requires an MoE model (config has no n_experts)"
+            )
+        if n_exp % ep:
+            raise ShardingError(
+                f"ep={ep} does not divide the model's {n_exp} experts"
+            )
+
+
+def build_serve_mesh(axes: Dict[str, int], devices: Optional[Sequence[Any]] = None):
+    """A :class:`jax.sharding.Mesh` over the FIRST ``prod(sizes)`` devices
+    (canonical AXIS_ORDER, unnamed axes size 1).  Serving replicas each
+    own a whole slice, so "the first N" is the deployment contract — the
+    launcher hands each replica pod its own visible devices."""
+    from tpu_nexus.parallel.mesh import AXIS_ORDER, MeshSpec, build_mesh
+
+    for name in axes:
+        if name not in AXIS_ORDER:
+            raise ShardingError(f"unknown mesh axis {name!r}")
+    sizes = {name: int(axes.get(name, 1)) for name in AXIS_ORDER}
+    n = 1
+    for s in sizes.values():
+        n *= s
+    if devices is None:
+        import jax
+
+        devices = jax.devices()
+    if n > len(devices):
+        raise ShardingError(
+            f"serve mesh wants {n} devices, have {len(devices)}"
+        )
+    return build_mesh(MeshSpec(**sizes), devices=list(devices)[:n])
+
+
+# -- regex partition rules over the param tree ---------------------------------
+
+
+def _leaf_paths(tree: Any) -> Tuple[List[str], List[Any], Any]:
+    """``/``-joined leaf path names (SNIPPETS.md [2]'s ``named_tree_map``
+    separator), leaves, treedef.  Registered pytree nodes without key
+    paths (``QTensor``) contribute their flatten index as the path part."""
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = []
+    for key_path, _leaf in flat:
+        parts = []
+        for k in key_path:
+            for attr in ("key", "name", "idx"):
+                if hasattr(k, attr):
+                    parts.append(str(getattr(k, attr)))
+                    break
+            else:  # pragma: no cover - future key-path flavors
+                parts.append(str(k))
+        names.append("/".join(parts))
+    return names, [leaf for _, leaf in flat], treedef
+
+
+def match_partition_rules(
+    params: Any,
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = SERVING_PARAM_RULES,
+) -> Any:
+    """Pytree of logical-axis tuples for ``params``, SNIPPETS.md [2]
+    style: scalars (and 1-element leaves) replicate unconditionally;
+    otherwise the first rule whose regex ``search``-matches the leaf's
+    ``/``-joined path AND whose axis tuple matches the leaf's rank wins
+    (the rank check is what lets one path like ``layers/w_gate`` carry
+    both the dense and the expert-stacked layout).  An unmatched leaf
+    RAISES — silent replication would defeat TP and OOM HBM far from the
+    missing rule."""
+    import numpy as np
+
+    names, leaves, treedef = _leaf_paths(params)
+    compiled = [(re.compile(rx), axes) for rx, axes in rules]
+    out = []
+    for name, leaf in zip(names, leaves):
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) <= 1:
+            out.append(tuple(None for _ in shape))
+            continue
+        for rx, axes in compiled:
+            if rx.search(name) is not None and len(axes) == len(shape):
+                out.append(axes)
+                break
+        else:
+            raise ShardingError(
+                f"no serving partition rule matches param {name!r} "
+                f"(shape {shape}) — add a (regex, logical-axes) row to "
+                "SERVING_PARAM_RULES"
+            )
+    import jax
+
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def serving_param_shardings(
+    params: Any,
+    mesh: Any,
+    rule_table: Optional[Dict[str, Any]] = None,
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = SERVING_PARAM_RULES,
+) -> Any:
+    """Pytree of ``NamedSharding`` mirroring ``params``: regex rules pick
+    each leaf's logical axes, the rule table (default
+    ``LOGICAL_RULES_SERVE_TP``) maps logical -> mesh axes via
+    :func:`~tpu_nexus.parallel.sharding.spec_for`.  Two per-leaf
+    adjustments the generic path can't know: dims of size 1 (int8 scale
+    leaves collapse their contraction dims) drop their assignment —
+    sharding a broadcast dim is meaningless — and a >1 dim whose size the
+    mesh axis does not divide raises HERE, naming the leaf, instead of
+    deep inside GSPMD."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_nexus.parallel.sharding import LOGICAL_RULES_SERVE_TP, spec_for
+
+    table = dict(LOGICAL_RULES_SERVE_TP if rule_table is None else rule_table)
+    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    names, leaves, treedef = _leaf_paths(params)
+    axes_flat = jax.tree_util.tree_leaves(
+        match_partition_rules(params, rules),
+        is_leaf=lambda x: isinstance(x, tuple),
+    )
+
+    def one(name, leaf, logical):
+        spec = list(spec_for(logical, table))
+        shape = tuple(leaf.shape)
+        for i, assigned in enumerate(spec):
+            if assigned is None:
+                continue
+            shards = 1
+            for a in assigned if isinstance(assigned, tuple) else (assigned,):
+                shards *= axis_sizes[a]
+            if shape[i] == 1:
+                spec[i] = None  # collapsed scale/broadcast dim: replicate
+            elif shape[i] % shards:
+                raise ShardingError(
+                    f"dim {i} of param {name!r} (shape {shape}, logical "
+                    f"{logical}) is not divisible by its {shards}-way "
+                    f"{assigned!r} sharding"
+                )
+        return NamedSharding(mesh, P(*spec))
+
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [one(n, leaf, ax) for n, leaf, ax in zip(names, leaves, axes_flat)],
+    )
+
+
+def kv_cache_sharding(mesh: Any, rule_table: Optional[Dict[str, Any]] = None):
+    """The ONE ``NamedSharding`` both cache layouts share (dim 3 =
+    kv-heads on ``tp``; see :data:`KV_CACHE_AXES`), applied as a pytree
+    prefix to the whole cache dict."""
+    from jax.sharding import NamedSharding
+
+    from tpu_nexus.parallel.sharding import LOGICAL_RULES_SERVE_TP, spec_for
+
+    table = dict(LOGICAL_RULES_SERVE_TP if rule_table is None else rule_table)
+    return NamedSharding(mesh, spec_for(KV_CACHE_AXES, table))
+
+
+def shard_serving_params(
+    params: Any,
+    mesh: Any,
+    rule_table: Optional[Dict[str, Any]] = None,
+    rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = SERVING_PARAM_RULES,
+) -> Any:
+    """Device-put ``params`` under the serving rules: each host leaf
+    slices straight onto its shards (one h2d transfer per shard, no
+    full-tree staging device) — the make_shard_fns half of SNIPPETS.md
+    [2], minus the gather fns serving never needs."""
+    import jax
+
+    return jax.device_put(
+        params, serving_param_shardings(params, mesh, rule_table, rules)
+    )
+
+
+# -- sharded executors ---------------------------------------------------------
+
+
+class _ShardedExecutorMixin:
+    """The sharding layer over either executor: owns the mesh + sharding
+    trees, pins every jitted entry point's ``in_shardings``/
+    ``out_shardings`` through the :meth:`_make_jit` seam, allocates the
+    KV pool device-sharded, and lands ``swap_params`` weights with a
+    per-shard ``device_put`` (no host gather — this module is inside
+    nxlint NX014's no-readback scope).  MRO: mixin first, so its hooks
+    shadow the base executor's."""
+
+    def __init__(
+        self,
+        params: Any,
+        cfg: Any,
+        *,
+        mesh: Any,
+        rule_table: Optional[Dict[str, Any]] = None,
+        rules: Sequence[Tuple[str, Tuple[Optional[str], ...]]] = SERVING_PARAM_RULES,
+        **kwargs: Any,
+    ) -> None:
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        self.mesh = mesh
+        axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        # fail-fast on the model facts (head/width divisibility) before
+        # any allocation; mesh size vs devices was checked at mesh build
+        validate_serve_mesh(
+            {k: v for k, v in axis_sizes.items() if v > 1},
+            cfg,
+            n_devices=int(mesh.devices.size),
+        )
+        self._param_shardings = serving_param_shardings(
+            params, mesh, rule_table, rules
+        )
+        self._kv_sharding = kv_cache_sharding(mesh, rule_table)
+        self._repl = NamedSharding(mesh, P())
+        # params land sharded BEFORE the base __init__ builds the jits, so
+        # the very first dispatch runs multi-chip (no lazy reshard)
+        super().__init__(
+            jax.device_put(params, self._param_shardings), cfg, **kwargs
+        )
+        # the PRNG key is a jit operand like any other: pre-place it on
+        # the mesh so sampling dispatches don't re-commit it every step
+        self._key = jax.device_put(self._key, self._repl)
+
+    def _make_jit(self, fn, *, donate=(), nargs, out, params_arg=0, cache_arg=1):
+        # every executor entry point compiles under the Mesh with explicit
+        # shardings: params per the regex rules, KV pool heads-sharded,
+        # all host-facing operands/outputs replicated.  Out-shardings on
+        # the cache keep XLA from "helpfully" resharding it between
+        # dispatches; replicated outputs make the engine's sanctioned
+        # readbacks (np.asarray in the host wrappers) single-gather cheap.
+        ins: List[Any] = [self._repl] * nargs
+        if params_arg is not None:
+            ins[params_arg] = self._param_shardings
+        if cache_arg is not None:
+            ins[cache_arg] = self._kv_sharding
+        outs = tuple(
+            self._kv_sharding if tag == "cache" else self._repl for tag in out
+        )
+        return self._jax.jit(
+            fn,
+            donate_argnums=donate,
+            in_shardings=tuple(ins),
+            out_shardings=outs if len(outs) > 1 else outs[0],
+        )
+
+    def _install_params(self, params: Any) -> Any:
+        # the shard-aware half of the PR 7 swap contract: the verified
+        # host tree slices straight to each chip's shard — the OLD sharded
+        # params are never gathered to host (pinned by the rollout tests
+        # under a device-to-host transfer guard)
+        return self._jax.device_put(params, self._param_shardings)
+
+
+class ShardedModelExecutor(_ShardedExecutorMixin, ModelExecutor):
+    """:class:`~tpu_nexus.serving.engine.ModelExecutor` across a slice:
+    same contract, every jit sharded (see the mixin)."""
+
+    def _fresh_cache(self):
+        from tpu_nexus.serving.cache_manager import init_cache
+
+        return init_cache(
+            self.cfg, self.num_slots, self.max_len, self.kv_quant,
+            shardings=self._kv_sharding,
+        )
+
+
+class ShardedPagedModelExecutor(_ShardedExecutorMixin, PagedModelExecutor):
+    """:class:`~tpu_nexus.serving.engine.PagedModelExecutor` across a
+    slice: the block pool is heads-sharded (``num_blocks`` stays global —
+    block tables, prefix index and COW accounting are mesh-agnostic)."""
+
+    def _fresh_cache(self):
+        from tpu_nexus.serving.cache_manager import init_paged_cache
+
+        return init_paged_cache(
+            self.cfg, self.num_blocks, self.page_size, self.kv_quant,
+            shardings=self._kv_sharding,
+        )

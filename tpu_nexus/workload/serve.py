@@ -139,6 +139,17 @@ class ServeConfig:
     #: the token and retires FINISHED early (detected in-device on the
     #: multi-step path); -1 = disabled (NEXUS_STOP_TOKEN)
     stop_token: int = -1
+    #: engine mode only — tensor-parallel sharded serving (ISSUE 13):
+    #: comma-separated ``axis=size`` pairs over parallel/mesh.py's
+    #: AXIS_ORDER (e.g. "tp=4"), switching the engine to the SHARDED
+    #: executors (serving/sharded.py): params sharded by the regex rule
+    #: table, the KV pool heads-sharded along tp, every jitted entry
+    #: point under explicit in/out shardings, and shard-aware weight
+    #: swaps (rolling updates land per-shard, no host gather).  Unknown
+    #: axes, non-divisible head counts and meshes larger than the device
+    #: count are rejected HERE, at parse.  "" = single-chip (unchanged).
+    #: (NEXUS_SERVE_MESH)
+    serve_mesh: str = ""
     #: engine mode only — train-to-serve continuous deployment (ISSUE 9):
     #: every this-many seconds re-check ``latest_verified_step(quarantine=
     #: False)`` under ``checkpoint_dir`` and, on a NEW verified step,
@@ -249,6 +260,20 @@ class ServeConfig:
                     "spec_draft_preset (NEXUS_SPEC_DRAFT_PRESET) only "
                     "applies to spec_drafter='model'"
                 )
+        if self.serve_mesh:
+            from tpu_nexus.serving.sharded import (
+                parse_serve_mesh,
+                validate_serve_mesh,
+            )
+
+            # parse + validate the WHOLE mesh contract here: unknown axes,
+            # duplicate axes and bad sizes (parse_serve_mesh), mesh size
+            # vs the actually-available devices and tp/ep divisibility of
+            # the model's head/width counts (validate_serve_mesh) — a bad
+            # NEXUS_SERVE_MESH must fail before any device work starts
+            axes = parse_serve_mesh(self.serve_mesh)
+            model_cfg = getattr(self.model, "config", self.model)
+            validate_serve_mesh(axes, model_cfg)
         if self.reload_check_interval_s and not self.checkpoint_dir:
             raise ValueError(
                 "reload_check_interval_s (NEXUS_RELOAD_CHECK_S) requires "
@@ -297,6 +322,7 @@ class ServeConfig:
             spec_k=int(e.get("NEXUS_SPEC_K", "0")),
             spec_drafter=e.get("NEXUS_SPEC_DRAFTER", "ngram"),
             spec_draft_preset=e.get("NEXUS_SPEC_DRAFT_PRESET", ""),
+            serve_mesh=e.get("NEXUS_SERVE_MESH", ""),
             reload_check_interval_s=float(e.get("NEXUS_RELOAD_CHECK_S", "0")),
             overlap_dispatch=e.get("NEXUS_OVERLAP", "") not in ("", "0"),
             decode_steps=int(e.get("NEXUS_DECODE_STEPS", "1")),
@@ -600,7 +626,29 @@ def _serve_engine_loop(
         decode_steps=cfg.decode_steps,
         stop_token=cfg.stop_token,
     )
-    if cfg.page_size:
+    if cfg.serve_mesh:
+        # tensor-parallel sharded serving (NEXUS_SERVE_MESH, ISSUE 13):
+        # same engine, sharded executors — params laid out by the regex
+        # rule table, the KV pool heads-sharded along tp, and rolling
+        # weight swaps landing per-shard without a host gather
+        from tpu_nexus.serving.sharded import (
+            ShardedModelExecutor,
+            ShardedPagedModelExecutor,
+            build_serve_mesh,
+            parse_serve_mesh,
+        )
+
+        mesh = build_serve_mesh(parse_serve_mesh(cfg.serve_mesh))
+        if cfg.page_size:
+            executor = ShardedPagedModelExecutor(
+                params, mcfg, mesh=mesh, page_size=cfg.page_size,
+                num_blocks=cfg.kv_blocks, **executor_kwargs,
+            )
+        else:
+            executor = ShardedModelExecutor(
+                params, mcfg, mesh=mesh, **executor_kwargs
+            )
+    elif cfg.page_size:
         # paged KV (NEXUS_PAGE_SIZE > 0): block-table decode + ref-counted
         # shared-prefix reuse; NEXUS_KV_BLOCKS caps the physical pool
         executor = PagedModelExecutor(
