@@ -27,6 +27,21 @@ import logging  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _isolate_trace_dir(tmp_path_factory):
+    """Tracing is default-on (serving/tracing.py), so engines built by
+    tests dump incident artifacts at fault/drain seams.  Point the default
+    dump dir at a per-session pytest tmp dir instead of the shared
+    ``<tmp>/tpu-nexus-traces`` — test runs must not accumulate files in a
+    production-shaped location.  Tests that set NEXUS_TRACE_DIR (or pass
+    an explicit dump_dir) still win."""
+    import os
+
+    if "NEXUS_TRACE_DIR" not in os.environ:
+        os.environ["NEXUS_TRACE_DIR"] = str(tmp_path_factory.mktemp("nexus-traces"))
+    yield
+
+
 @pytest.fixture(autouse=True)
 def _restore_tpu_nexus_logger():
     """configure_logger() sets propagate=False on the package logger; restore

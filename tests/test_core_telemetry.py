@@ -6,6 +6,8 @@ import logging
 import socket
 import threading
 
+import pytest
+
 from tpu_nexus.core.signals import setup_signal_context
 from tpu_nexus.core.telemetry import StatsdClient, Timer, RecordingMetrics, configure_logger
 
@@ -55,6 +57,72 @@ def test_statsd_histogram_datagram_format():
     data, _ = sock.recvfrom(4096)
     assert data.decode() == "tpu_nexus.serving.ttft_seconds:0.125|h|#mode:engine"
     sock.close()
+
+
+def test_statsd_oversized_datagram_truncates_tags_with_counter():
+    # the DogStatsD-over-UDP convention: a datagram past the ceiling is
+    # sent WITHOUT its tag section (still a valid metric line — a byte
+    # cut mid-payload would be garbage the agent rejects) and counted
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2)
+    port = sock.getsockname()[1]
+    client = StatsdClient(
+        "tpu_nexus", address=f"udp://127.0.0.1:{port}", max_datagram_bytes=64
+    )
+    client.count("events", 1, tags={"blob": "x" * 200})
+    data, _ = sock.recvfrom(4096)
+    assert data.decode() == "tpu_nexus.events:1|c"  # tags dropped, line valid
+    assert client.truncated == 1
+    # within the ceiling: tags ride untouched, counter unchanged
+    client.count("events", 2, tags={"kind": "Job"})
+    data, _ = sock.recvfrom(4096)
+    assert data.decode() == "tpu_nexus.events:2|c|#kind:Job"
+    assert client.truncated == 1
+    sock.close()
+
+
+def test_statsd_oversized_base_line_dropped_with_counter():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(0.2)
+    port = sock.getsockname()[1]
+    client = StatsdClient(
+        "tpu_nexus", address=f"udp://127.0.0.1:{port}", max_datagram_bytes=64
+    )
+    client.count("a" * 200, 1)  # even tagless the line exceeds the ceiling
+    assert client.truncated == 1
+    with pytest.raises(socket.timeout):
+        sock.recvfrom(4096)  # nothing was sent — a byte-cut would be garbage
+    sock.close()
+
+
+def test_statsd_send_failure_never_raises_and_is_counted():
+    client = StatsdClient("ns", address="udp://127.0.0.1:9")  # discard port
+
+    class ExplodingSocket:
+        def send(self, payload):
+            raise OSError("socket gone")
+
+    client._sock = ExplodingSocket()
+    client.count("x")  # must not raise into the engine loop
+    client.gauge("y", 1.0)
+    assert client.send_errors == 2
+
+
+def test_statsd_bad_tag_value_never_raises_and_is_counted():
+    class Unprintable:
+        def __str__(self):
+            raise RuntimeError("no repr for you")
+
+    client = StatsdClient("ns", address="udp://127.0.0.1:9")
+    client.count("x", tags={"bad": Unprintable()})  # formatting failure stays inside
+    assert client.send_errors == 1
+
+
+def test_statsd_rejects_unusable_ceiling():
+    with pytest.raises(ValueError, match="max_datagram_bytes"):
+        StatsdClient("ns", max_datagram_bytes=8)
 
 
 def test_recording_histogram_accumulates_samples():

@@ -1,0 +1,662 @@
+"""Observability layer tests (ISSUE 14, tpu_nexus/serving/tracing.py).
+
+Layers, cheapest first:
+
+* unit: RequestTrace bounds, FlightRecorder ring/dump budgets and
+  failure-counting, DeviceProfiler window state machine;
+* engine integration against the deterministic FakeExecutor: the span
+  schema end to end (submit → admitted → prefill pair → decode → terminal
+  with cause + TTFT/TPOT), tracer/metrics latency agreement, tracer-off
+  token identity;
+* tracer-under-overlap: dispatch vs materialization as DISTINCT events
+  with the one-step-late offset visible, event ordering across a
+  drain/swap fence, and the held-fault timeline (dispatch step N → fault
+  surfaced and retired at N+1);
+* chaos: a DeviceStateLost produces a flight-recorder dump whose
+  implicated timeline names the SAME cause the request (and the ledger
+  accounting) carries, and the dump converts to a perfetto-loadable
+  Chrome trace via tools/nxtrace;
+* the serve-loop seam: a cancelled lifecycle's PREEMPTED ledger details
+  carry the drain dump inventory.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from tpu_nexus.serving import (
+    DeviceProfiler,
+    EngineTracer,
+    FifoScheduler,
+    FlightRecorder,
+    NullTracer,
+    RequestState,
+    RequestTrace,
+    SchedulerConfig,
+    ServingEngine,
+    ServingMetrics,
+    StepFaultPolicy,
+)
+from tpu_nexus.serving.recovery import DeviceStateLost
+from tpu_nexus.serving.tracing import (
+    EV_ADMITTED,
+    EV_DECODE_DISPATCH,
+    EV_FAULT,
+    EV_MATERIALIZE,
+    EV_PREFILL_COMPLETE,
+    EV_PREFILL_DISPATCH,
+    EV_RETIRED,
+    EV_SPEC_ACCEPT,
+    EV_SPEC_PROPOSE,
+    EV_SUBMIT,
+)
+from tpu_nexus.workload.faults import FaultyExecutor
+
+from tests.test_serving_engine import FakeExecutor
+
+
+def names(req):
+    return [e[1] for e in req.trace.events]
+
+
+def attrs_of(req, name):
+    return [e[2] for e in req.trace.events if e[1] == name]
+
+
+def make_engine(executor, tmp_path, overlap=False, **kw):
+    tracer = EngineTracer(
+        recorder=FlightRecorder(capacity=32, dump_dir=str(tmp_path / "traces"))
+    )
+    return ServingEngine(
+        executor,
+        scheduler=FifoScheduler(SchedulerConfig()),
+        metrics=ServingMetrics(),
+        fault_policy=StepFaultPolicy(sleep=lambda s: None, rng=random.Random(0)),
+        tracer=tracer,
+        overlap=overlap,
+        **kw,
+    )
+
+
+# -- units ----------------------------------------------------------------------
+
+
+class TestRequestTrace:
+    def test_bounded_with_dropped_counter_and_forced_terminal(self):
+        tr = RequestTrace("r", max_events=8)
+        for i in range(20):
+            tr.add(float(i), "decode_dispatch")
+        assert len(tr.events) == 8
+        assert tr.dropped == 12
+        tr.add(99.0, EV_RETIRED, {"state": "Finished"}, force=True)
+        assert tr.events[-1][1] == EV_RETIRED  # terminal always lands
+        d = tr.to_dict()
+        assert d["dropped_events"] == 12
+        assert d["events"][-1]["name"] == EV_RETIRED
+
+    def test_rejects_unusable_bound(self):
+        with pytest.raises(ValueError, match="max_events"):
+            RequestTrace("r", max_events=2)
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded(self, tmp_path):
+        rec = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+        for i in range(10):
+            rec.record(step=i)
+        assert [r["step"] for r in rec.records] == [6, 7, 8, 9]
+
+    def test_dump_writes_artifact_with_implicated_timelines(self, tmp_path):
+        rec = FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+        rec.record(step=1, queue_depth=3)
+
+        class Req:
+            request_id = "req-x"
+            state = RequestState.FAILED
+            cause = "hbm-oom"
+            output_tokens = [1, 2]
+            trace = RequestTrace("req-x")
+
+        Req.trace.add(0.0, EV_SUBMIT)
+        path = rec.dump("step-fault:hbm-oom", [Req])
+        payload = json.loads(open(path).read())
+        assert payload["schema"].startswith("tpu-nexus-flight-recorder")
+        assert payload["records"] == [{"step": 1, "queue_depth": 3}]
+        assert payload["implicated"][0]["cause"] == "hbm-oom"
+        assert payload["implicated"][0]["timeline"]["events"][0]["name"] == EV_SUBMIT
+        assert rec.dumps[0]["path"] == path
+        assert rec.dumps[0]["causes"] == {"hbm-oom": 1}
+
+    def test_dump_budget_and_write_failures_counted_never_raised(self, tmp_path):
+        rec = FlightRecorder(capacity=2, dump_dir=str(tmp_path), max_dumps=1)
+        assert rec.dump("a") is not None
+        assert rec.dump("b") is None  # budget spent
+        assert rec.dump_failures == 1
+        # unwritable dump dir: a FILE where the directory should be
+        blocked = tmp_path / "blocked"
+        blocked.write_text("not a directory")
+        rec2 = FlightRecorder(capacity=2, dump_dir=str(blocked))
+        assert rec2.dump("c") is None  # swallowed, counted
+        assert rec2.dump_failures == 1
+        assert rec2.summary()["dump_failures"] == 1
+
+    def test_implicated_cap_is_honest(self, tmp_path):
+        rec = FlightRecorder(capacity=2, dump_dir=str(tmp_path), max_implicated=2)
+
+        class Req:
+            state = RequestState.EVICTED
+            cause = "drain: shed before admission"
+            output_tokens = ()
+            trace = None
+
+            def __init__(self, i):
+                self.request_id = f"r{i}"
+
+        path = rec.dump("drain", [Req(i) for i in range(5)])
+        payload = json.loads(open(path).read())
+        assert len(payload["implicated"]) == 2
+        assert payload["implicated_total"] == 5
+        assert payload["implicated_elided"] == 3
+
+
+class TestDeviceProfiler:
+    class FakeJaxProfiler:
+        def __init__(self):
+            self.calls = []
+
+        def start_trace(self, d):
+            self.calls.append(("start", d))
+
+        def stop_trace(self):
+            self.calls.append(("stop",))
+
+    def _patched(self, monkeypatch, prof, fake):
+        monkeypatch.setattr(DeviceProfiler, "_profiler", lambda self: fake)
+        return prof
+
+    def test_window_state_machine(self, monkeypatch, tmp_path):
+        fake = self.FakeJaxProfiler()
+        prof = self._patched(
+            monkeypatch,
+            DeviceProfiler(str(tmp_path / "p"), start_step=2, num_steps=3),
+            fake,
+        )
+        for step in range(10):
+            prof.tick(step)
+        assert fake.calls == [("start", str(tmp_path / "p")), ("stop",)]
+        assert prof.state == DeviceProfiler.DONE
+        prof.tick(11)  # one-shot: never re-arms
+        assert len(fake.calls) == 2
+
+    def test_stop_closes_inflight_capture(self, monkeypatch, tmp_path):
+        fake = self.FakeJaxProfiler()
+        prof = self._patched(
+            monkeypatch,
+            DeviceProfiler(str(tmp_path / "p"), start_step=0, num_steps=100),
+            fake,
+        )
+        prof.tick(0)
+        prof.stop()  # run ended inside the window
+        assert fake.calls[-1] == ("stop",)
+        prof.stop()  # idempotent
+        assert len(fake.calls) == 2
+
+    def test_start_failure_counted_and_disables(self, monkeypatch, tmp_path):
+        class Broken:
+            def start_trace(self, d):
+                raise RuntimeError("no profiler in this build")
+
+        prof = self._patched(
+            monkeypatch, DeviceProfiler(str(tmp_path / "p"), num_steps=2), Broken()
+        )
+        prof.tick(0)  # must not raise into the loop
+        assert prof.failures == 1
+        assert prof.state == DeviceProfiler.DONE
+
+    def test_from_env(self):
+        assert DeviceProfiler.from_env({}) is None
+        prof = DeviceProfiler.from_env(
+            {"NEXUS_PROFILE_DIR": "/tmp/p", "NEXUS_PROFILE_START": "5",
+             "NEXUS_PROFILE_STEPS": "7"}
+        )
+        assert (prof.profile_dir, prof.start_step, prof.num_steps) == ("/tmp/p", 5, 7)
+
+    def test_from_env_bad_values_disarm_instead_of_raising(self):
+        # the best-effort contract starts at parse: a malformed profiling
+        # knob must never take down the serving/training run it rides in
+        for bad in (
+            {"NEXUS_PROFILE_DIR": "/tmp/p", "NEXUS_PROFILE_STEPS": "0"},
+            {"NEXUS_PROFILE_DIR": "/tmp/p", "NEXUS_PROFILE_START": "abc"},
+            {"NEXUS_PROFILE_DIR": "/tmp/p", "NEXUS_PROFILE_START": "-3"},
+        ):
+            assert DeviceProfiler.from_env(bad) is None
+
+    def test_rejects_bad_window(self, tmp_path):
+        with pytest.raises(ValueError):
+            DeviceProfiler("")
+        with pytest.raises(ValueError):
+            DeviceProfiler(str(tmp_path), num_steps=0)
+
+
+# -- engine integration (sync mode) ---------------------------------------------
+
+
+class TestEngineSpans:
+    def test_full_lifecycle_span_schema(self, tmp_path):
+        eng = make_engine(FakeExecutor(2, 32), tmp_path)
+        req = eng.submit(np.arange(1, 5, dtype=np.int32), 3)
+        eng.run_until_drained()
+        assert names(req) == [
+            EV_SUBMIT, EV_ADMITTED, EV_PREFILL_DISPATCH, EV_PREFILL_COMPLETE,
+            EV_DECODE_DISPATCH, EV_DECODE_DISPATCH, EV_RETIRED,
+        ]
+        # monotonic-clock timeline
+        times = [e[0] for e in req.trace.events]
+        assert times == sorted(times)
+        sub = attrs_of(req, EV_SUBMIT)[0]
+        assert sub == {"prompt_len": 4, "max_new_tokens": 3}
+        adm = attrs_of(req, EV_ADMITTED)[0]
+        assert adm["slot"] in (0, 1) and adm["queue_wait_s"] >= 0
+        term = attrs_of(req, EV_RETIRED)[0]
+        assert term["state"] == RequestState.FINISHED
+        assert term["action"] == "completed"
+        assert term["tokens_out"] == 3
+
+    def test_terminal_summary_agrees_with_metrics(self, tmp_path):
+        eng = make_engine(FakeExecutor(1, 64), tmp_path)
+        req = eng.submit(np.arange(1, 9, dtype=np.int32), 5)
+        eng.run_until_drained()
+        term = attrs_of(req, EV_RETIRED)[0]
+        # SAME Request timestamps feed both pipelines — exact equality,
+        # not approx: the "can never disagree" contract
+        assert term["ttft_s"] == eng.metrics.ttft_s[0]
+        expected_tpot = (req.last_token_at - req.first_token_at) / (
+            len(req.output_tokens) - 1
+        )
+        assert term["tpot_mean_s"] == expected_tpot
+
+    def test_flight_recorder_rings_every_step(self, tmp_path):
+        eng = make_engine(FakeExecutor(2, 32), tmp_path)
+        eng.submit(np.arange(1, 4, dtype=np.int32), 4)
+        eng.run_until_drained()
+        recs = list(eng.tracer.recorder.records)
+        assert [r["step"] for r in recs] == list(range(1, eng.steps + 1))
+        assert all("dispatch_s" in r and "queue_depth" in r for r in recs)
+        # batch composition names the slot's tenant while it decodes
+        assert any(r["batch"] for r in recs)
+
+    def test_null_tracer_token_identity_and_no_traces(self, tmp_path):
+        prompts = [np.arange(1, 6, dtype=np.int32), np.arange(3, 7, dtype=np.int32)]
+        outs = {}
+        for label, tracer in (("on", None), ("off", NullTracer())):
+            eng = ServingEngine(
+                FakeExecutor(2, 32),
+                scheduler=FifoScheduler(SchedulerConfig()),
+                metrics=ServingMetrics(),
+                tracer=tracer,
+            )
+            reqs = [eng.submit(p, 6, request_id=f"r{i}") for i, p in enumerate(prompts)]
+            eng.run_until_drained()
+            outs[label] = [r.output_tokens for r in reqs]
+            if label == "off":
+                assert all(r.trace is None for r in reqs)
+                assert len(eng.tracer.recorder.records) == 0
+        # tracing must not change token streams (the fake-engine pin; the
+        # real-model identity matrices run tracer-on by default)
+        assert outs["on"] == outs["off"]
+
+    def test_per_request_bound_counts_into_tracer_total(self, tmp_path):
+        tracer = EngineTracer(
+            max_events_per_request=8,
+            recorder=FlightRecorder(capacity=8, dump_dir=str(tmp_path)),
+        )
+        eng = ServingEngine(
+            FakeExecutor(1, 128),
+            scheduler=FifoScheduler(SchedulerConfig()),
+            metrics=ServingMetrics(),
+            tracer=tracer,
+        )
+        req = eng.submit(np.arange(1, 3, dtype=np.int32), 60)
+        eng.run_until_drained()
+        assert req.state == RequestState.FINISHED
+        assert len(req.output_tokens) == 60  # the bound never touches tokens
+        assert len(req.trace.events) == 9  # 8 capped + forced terminal
+        assert req.trace.dropped > 0
+        assert tracer.events_dropped == req.trace.dropped
+        assert req.trace.events[-1][1] == EV_RETIRED  # cause still recorded
+
+
+class TestSpecSpans:
+    class FakeVerifyExecutor(FakeExecutor):
+        """FakeExecutor + the speculative verify contract: the 'target'
+        continues last_token+1, +2, ... so an ngram draft over repetitive
+        context gets a real (partial) acceptance pattern."""
+
+        def verify(self, tokens, cursors, drafts):
+            k = np.asarray(drafts).shape[1]
+            base = np.asarray(tokens, np.int64)[:, None]
+            return base + np.arange(1, k + 2, dtype=np.int64)[None, :]
+
+    def test_propose_and_accept_events_carry_counts(self, tmp_path):
+        from tpu_nexus.serving.speculative import NGramDrafter
+
+        eng = make_engine(
+            self.FakeVerifyExecutor(1, 64), tmp_path,
+            spec_k=2, drafter=NGramDrafter(1),
+        )
+        req = eng.submit(np.arange(1, 7, dtype=np.int32), 9)
+        eng.run_until_drained()
+        assert req.state == RequestState.FINISHED
+        proposes = attrs_of(req, EV_SPEC_PROPOSE)
+        accepts = attrs_of(req, EV_SPEC_ACCEPT)
+        assert proposes and accepts
+        assert all(p["k"] == 2 and p["drafter"] == "ngram" for p in proposes)
+        for a in accepts:
+            assert 0 <= a["accepted"] <= a["proposed"] == 2
+            assert 1 <= a["emitted"] <= 3
+        # the tracer's per-verify counts sum to the metrics' totals —
+        # same numbers, two views
+        assert sum(a["accepted"] for a in accepts) == eng.metrics.spec_accepted
+        assert sum(a["proposed"] for a in accepts) == eng.metrics.spec_proposed
+
+
+# -- tracer under overlap --------------------------------------------------------
+
+
+class TestOverlapSpans:
+    def test_dispatch_and_materialize_are_distinct_one_step_late(self, tmp_path):
+        eng = make_engine(FakeExecutor(1, 64, decode_steps=2), tmp_path, overlap=True)
+        req = eng.submit(np.arange(1, 4, dtype=np.int32), 8)
+        eng.run_until_drained()
+        dispatches = attrs_of(req, EV_DECODE_DISPATCH)
+        mats = attrs_of(req, EV_MATERIALIZE)
+        assert dispatches and mats
+        assert all(d["deferred"] for d in dispatches)
+        # THE deferral, visible: every materialization names a dispatch
+        # from an EARLIER engine step
+        for m in mats:
+            assert m["dispatch_step"] < m["step"]
+        # steady-state is exactly one step late
+        assert any(m["step"] - m["dispatch_step"] == 1 for m in mats)
+        # and every dispatched step materialized (fence at drain end)
+        assert {m["dispatch_step"] for m in mats} == {d["step"] for d in dispatches}
+
+    def test_fence_orders_materialization_before_terminal(self, tmp_path):
+        """drain() fences the pipeline: the deferred final tokens
+        materialize BEFORE any retirement decision, and the timeline
+        shows it — materialize events precede the terminal event and the
+        request keeps every token."""
+        eng = make_engine(FakeExecutor(1, 64, decode_steps=2), tmp_path, overlap=True)
+        req = eng.submit(np.arange(1, 4, dtype=np.int32), 6)
+        eng.step()  # prefill + dispatch #1
+        eng.step()  # dispatch #2, materialize #1
+        assert len(req.output_tokens) < 6  # tokens still riding the device
+        eng.drain(grace_s=10.0)
+        assert req.state == RequestState.FINISHED
+        assert len(req.output_tokens) == 6
+        evs = names(req)
+        assert evs[-1] == EV_RETIRED
+        last_mat = max(i for i, n in enumerate(evs) if n == EV_MATERIALIZE)
+        assert last_mat < evs.index(EV_RETIRED)
+        times = [e[0] for e in req.trace.events]
+        assert times == sorted(times)
+        # the drain seam dumped, implicating the drained request
+        dumps = eng.tracer.recorder.dumps
+        assert [d["reason"] for d in dumps] == ["drain"]
+        payload = json.loads(open(dumps[0]["path"]).read())
+        assert payload["implicated"][0]["request_id"] == req.request_id
+
+    def test_swap_fence_keeps_timeline_ordered(self, tmp_path):
+        class SwappableFake(FakeExecutor):
+            def swap_params(self, params):
+                pass  # the fence + in-flight guard are what this test pins
+
+        eng = make_engine(SwappableFake(1, 64, decode_steps=2), tmp_path, overlap=True)
+        req = eng.submit(np.arange(1, 4, dtype=np.int32), 4)
+        eng.step()
+        eng.quiesce(grace_s=10.0)  # fences + finishes in-flight on old weights
+        eng.swap_params(object())  # FakeExecutor has no swap_params guard
+        eng.resume_admission()
+        assert req.state == RequestState.FINISHED
+        evs = names(req)
+        assert evs[-1] == EV_RETIRED
+        assert {m["dispatch_step"] for m in attrs_of(req, EV_MATERIALIZE)} == {
+            d["step"] for d in attrs_of(req, EV_DECODE_DISPATCH)
+        }
+
+    def test_held_fault_timeline_shows_one_step_late_retirement(self, tmp_path):
+        """The chaos contract made visible: a fault captured at dispatch
+        step N is HELD and surfaces at step N+1 — the victim's timeline
+        carries the dispatch event at N, the fault event flagged held
+        with dispatch_step == N, and the terminal cause; a dump lands."""
+        fake = FakeExecutor(2, 64)
+        faulty = FaultyExecutor(fake, "step-hbm-oom", at_step=1)
+        eng = make_engine(faulty, tmp_path, overlap=True)
+        a = eng.submit(np.array([10]), 8)
+        b = eng.submit(np.array([20]), 8)
+        eng.step()  # dispatch #0
+        eng.step()  # dispatch #1 faults at the call — held
+        fault_dispatch_step = eng.steps
+        assert b.state == RequestState.DECODING  # not surfaced yet
+        eng.step()  # materialization surfaces it: one step late
+        assert b.state == RequestState.FAILED
+        assert b.cause == "hbm-oom"
+        fault = attrs_of(b, EV_FAULT)[0]
+        assert fault["held"] is True
+        assert fault["cause"] == "hbm-oom"
+        assert fault["dispatch_step"] == fault_dispatch_step
+        term = attrs_of(b, EV_RETIRED)[0]
+        assert term["cause"] == "hbm-oom"
+        # retirement happened AT the step after the faulted dispatch
+        assert eng.steps == fault_dispatch_step + 1
+        # the step-fault seam dumped with the victim's full timeline
+        dump = eng.tracer.recorder.dumps[0]
+        assert dump["reason"] == "step-fault:hbm-oom"
+        payload = json.loads(open(dump["path"]).read())
+        victim = payload["implicated"][0]
+        assert victim["request_id"] == b.request_id
+        assert victim["cause"] == "hbm-oom"
+        ev_names = [e["name"] for e in victim["timeline"]["events"]]
+        assert ev_names[0] == EV_SUBMIT and ev_names[-1] == EV_RETIRED
+        # survivor unharmed, fault markers rang in the step records
+        eng_records = list(eng.tracer.recorder.records)
+        assert any(r.get("faults") == ["hbm-oom"] for r in eng_records)
+        while eng.has_work:
+            eng.step()
+        assert a.state == RequestState.FINISHED
+
+
+# -- chaos: DeviceStateLost dump seam -------------------------------------------
+
+
+class TestDeviceStateLostDump:
+    def test_dump_lands_with_implicated_timeline_naming_the_cause(self, tmp_path):
+        class StateLosingExecutor(FakeExecutor):
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self.armed = False
+
+            def step(self, tokens, cursors):
+                if self.armed:
+                    self.armed = False
+                    raise DeviceStateLost(
+                        RuntimeError("RESOURCE_EXHAUSTED: HBM OOM while allocating")
+                    )
+                return super().step(tokens, cursors)
+
+        fake = StateLosingExecutor(2, 64)
+        eng = make_engine(fake, tmp_path)
+        a = eng.submit(np.array([10]), 6)
+        b = eng.submit(np.array([20]), 6)
+        eng.step()
+        fake.armed = True
+        eng.step()  # the whole batch fails; engine keeps serving
+        assert a.state == RequestState.FAILED and b.state == RequestState.FAILED
+        assert a.cause == "hbm-oom"  # classified from the original
+        dumps = eng.tracer.recorder.dumps
+        assert len(dumps) == 1
+        assert dumps[0]["reason"] == "device-state-lost:hbm-oom"
+        assert dumps[0]["causes"] == {"hbm-oom": 2}
+        payload = json.loads(open(dumps[0]["path"]).read())
+        # the implicated timelines name the SAME cause the requests (and
+        # the ledger accounting built from them) carry
+        for impl in payload["implicated"]:
+            assert impl["cause"] == "hbm-oom"
+            terminal = impl["timeline"]["events"][-1]
+            assert terminal["name"] == EV_RETIRED
+            assert terminal["attrs"]["cause"] == "hbm-oom"
+        assert payload["records"], "flight-recorder ring must ride the dump"
+        assert eng.metrics.trace_dumps_total == 1
+        assert eng.metrics.summary()["trace_dumps"] == 1
+
+    def test_dump_converts_to_perfetto_loadable_chrome_trace(self, tmp_path):
+        from tools import nxtrace
+
+        fake = FakeExecutor(1, 64)
+        eng = make_engine(fake, tmp_path)
+        req = eng.submit(np.array([5]), 4)
+        eng.run_until_drained()
+        path = eng.tracer.dump("manual", [req])
+        out = str(tmp_path / "out.trace.json")
+        assert nxtrace.main([path, "-o", out]) == 0
+        trace = json.loads(open(out).read())
+        events = trace["traceEvents"]
+        assert isinstance(events, list) and events
+        # chrome trace-event contract: every event has a phase, slices
+        # have non-negative durations, instants carry ts
+        for ev in events:
+            assert "ph" in ev
+            if ev["ph"] == "X":
+                assert ev["dur"] >= 0 and "ts" in ev
+            if ev["ph"] in ("i", "C"):
+                assert "ts" in ev
+        # the request's thread is named and its lifetime slice exists
+        thread_names = [
+            ev["args"]["name"] for ev in events if ev.get("name") == "thread_name"
+        ]
+        assert req.request_id in thread_names
+        assert any(
+            ev["ph"] == "X" and req.request_id in str(ev.get("name", ""))
+            for ev in events
+        )
+        # engine counters made it across
+        assert any(ev["ph"] == "C" and ev["name"] == "queue_depth" for ev in events)
+
+    def test_nxtrace_rejects_non_dump_json(self, tmp_path):
+        from tools import nxtrace
+
+        bogus = tmp_path / "x.json"
+        bogus.write_text('{"something": "else"}')
+        assert nxtrace.main([str(bogus)]) == 2
+
+
+class TestFleetDumpPointer:
+    def test_kill_replica_refuses_stale_dump_as_incident_pointer(self, tmp_path):
+        """When the replica-lost dump itself is refused (budget spent /
+        unwritable dir), the fleet must NOT pass an earlier unrelated
+        artifact off as this incident's drill-down."""
+        from tpu_nexus.serving import ServingFleet
+
+        # budget of exactly 1, pre-spent on an unrelated dump
+        tracer = EngineTracer(
+            recorder=FlightRecorder(capacity=4, dump_dir=str(tmp_path), max_dumps=1)
+        )
+        eng = ServingEngine(FakeExecutor(1, 32), tracer=tracer)
+        req = eng.submit(np.arange(1, 4, dtype=np.int32), 2)
+        eng.run_until_drained()
+        stale = tracer.dump("earlier-unrelated", [req])
+        assert stale is not None
+        fleet = ServingFleet()
+        rep = fleet.add_replica("pod-0", eng)
+        eng2_req = eng.submit(np.arange(1, 4, dtype=np.int32), 8)
+        eng.step()
+        fleet.kill_replica("pod-0", "replica-lost:pod_deleted")
+        # the replica-lost dump was refused (budget spent) -> no pointer,
+        # not the stale one
+        assert rep.last_incident_dump is None
+        assert eng2_req.state == RequestState.FAILED
+
+    def test_kill_replica_attaches_the_landed_dump(self, tmp_path):
+        from tpu_nexus.serving import ServingFleet
+
+        eng = ServingEngine(
+            FakeExecutor(1, 32),
+            tracer=EngineTracer(
+                recorder=FlightRecorder(capacity=4, dump_dir=str(tmp_path))
+            ),
+        )
+        fleet = ServingFleet()
+        rep = fleet.add_replica("pod-0", eng)
+        eng.submit(np.arange(1, 4, dtype=np.int32), 8)
+        eng.step()
+        fleet.kill_replica("pod-0", "replica-lost:pod_deleted")
+        assert rep.last_incident_dump is not None
+        assert rep.last_incident_dump["reason"] == "replica-lost:pod_deleted"
+
+
+# -- serve-loop ledger seam ------------------------------------------------------
+
+class TestServeLoopSeam:
+    def test_preempted_details_carry_flight_recorder_inventory(self, tmp_path, monkeypatch):
+        from tpu_nexus.checkpoint.models import CheckpointedRequest, LifecycleStage
+        from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+        from tpu_nexus.core.signals import LifecycleContext
+        from tpu_nexus.models import LlamaConfig
+        from tpu_nexus.parallel.distributed import ProcessContext
+        from tpu_nexus.workload.serve import ServeConfig, run_serve_engine
+
+        monkeypatch.setenv("NEXUS_TRACE_DIR", str(tmp_path / "traces"))
+        ctx = ProcessContext(
+            process_id=0, num_processes=1, algorithm="trace-drill",
+            run_id="run-t1", coordinator=None,
+        )
+        store = InMemoryCheckpointStore()
+        store.upsert_checkpoint(
+            CheckpointedRequest(
+                algorithm=ctx.algorithm, id=ctx.run_id,
+                lifecycle_stage=LifecycleStage.RUNNING,
+            )
+        )
+        lifecycle = LifecycleContext()
+        cfg = ServeConfig(
+            model=LlamaConfig.tiny(), batch_size=2, prompt_len=8,
+            gen_tokens=12, rounds=2, heartbeat_every=2, drain_grace_s=0.0,
+        )
+
+        def prompts():
+            rng = np.random.default_rng(7)
+            n = 0
+            while True:
+                if n == 2:
+                    lifecycle.cancel(reason="SIGTERM")
+                yield rng.integers(1, 64, size=(cfg.batch_size, cfg.prompt_len))
+                n += 1
+
+        summary = run_serve_engine(
+            cfg, store=store, ctx=ctx, prompts=prompts(), lifecycle=lifecycle
+        )
+        assert summary["drained"] is True
+        inventory = summary["flight_recorder"]
+        assert inventory["dumps"], "drain seam must dump"
+        assert inventory["dumps"][-1]["reason"] == "drain"
+        row = store.read_checkpoint(ctx.algorithm, ctx.run_id)
+        details = json.loads(row.algorithm_failure_details)
+        # the ledger row names its drill-down: same inventory, and the
+        # dump's per-cause counts match the row's retirement causes
+        assert details["flight_recorder"]["dumps"] == inventory["dumps"]
+        dump_causes = details["flight_recorder"]["dumps"][-1]["causes"]
+        for cause in dump_causes:
+            assert cause in details["retired_causes"] or cause == ""
+        payload = json.loads(open(inventory["dumps"][-1]["path"]).read())
+        assert payload["seam"] == "drain"
+
+    def test_trace_env_opt_out_and_dir_parse(self):
+        from tpu_nexus.workload.serve import ServeConfig
+
+        cfg = ServeConfig.from_env(
+            {"NEXUS_TRACE": "0", "NEXUS_TRACE_DIR": "/tmp/x"}
+        )
+        assert cfg.trace_enabled is False and cfg.trace_dir == "/tmp/x"
+        assert ServeConfig.from_env({}).trace_enabled is True

@@ -1638,3 +1638,131 @@ def test_nx014_repo_engine_is_clean():
         rules=[r for r in all_rules() if r.rule_id == "NX014"],
     )
     assert findings == []
+
+
+# -- NX015 metric-name parity ---------------------------------------------------
+
+REGISTRY_OK = """
+METRIC_NAMES = {
+    "serving.ttft_seconds": ("histogram", "submit -> first token"),
+    "serving.shed": ("count", "admission sheds"),
+}
+"""
+
+EMITTER_OK = """
+class ServingMetrics:
+    def first_token(self, ttft):
+        self._m.histogram("serving.ttft_seconds", ttft)
+
+    def shed(self):
+        self._m.count("serving.shed")
+"""
+
+
+def _lint_nx015(emitter_src, registry_src=REGISTRY_OK,
+                emitter_path="tpu_nexus/serving/metrics.py"):
+    return lint_source(
+        registry_src, "NX015", rel_path="tpu_nexus/core/telemetry.py",
+        extra=[(emitter_path, emitter_src)],
+    )
+
+
+def test_nx015_clean_when_registry_and_emissions_agree():
+    assert _lint_nx015(EMITTER_OK) == []
+
+
+def test_nx015_flags_emitted_but_unregistered_metric():
+    src = EMITTER_OK + """
+    def extra(self):
+        self._m.gauge("serving.mystery_gauge", 1.0)
+"""
+    findings = _lint_nx015(src)
+    assert len(findings) == 1
+    assert "serving.mystery_gauge" in findings[0].message
+    assert "METRIC_NAMES" in findings[0].message
+
+
+def test_nx015_flags_registered_but_never_emitted_metric():
+    registry = REGISTRY_OK.replace(
+        '"serving.shed": ("count", "admission sheds"),',
+        '"serving.shed": ("count", "admission sheds"),\n'
+        '    "serving.ghost": ("count", "an alert built on air"),',
+    )
+    findings = _lint_nx015(EMITTER_OK, registry_src=registry)
+    assert len(findings) == 1
+    assert "serving.ghost" in findings[0].message
+    # the stale row is flagged AT the registry, where the fix lives
+    assert findings[0].file.endswith("core/telemetry.py")
+
+
+def test_nx015_flags_non_literal_metric_name():
+    src = """
+    class M:
+        def emit(self, name):
+            self._m.count(name)
+    """
+    findings = _lint_nx015(src, registry_src="METRIC_NAMES = {}\n")
+    assert len(findings) == 1
+    assert "non-literal" in findings[0].message
+
+
+def test_nx015_fails_closed_when_registry_missing():
+    findings = _lint_nx015(EMITTER_OK, registry_src="OTHER = 1\n")
+    assert len(findings) == 1
+    assert "fails closed" in findings[0].message
+
+
+def test_nx015_ignores_out_of_scope_modules_and_non_metrics_receivers():
+    # out-of-scope module: emissions there are not the serving/workload
+    # contract (core/telemetry's own docstrings, tests, supervisor)
+    src = 'class M:\n    def f(self):\n        self._m.count("not.registered")\n'
+    assert _lint_nx015(src, emitter_path="tpu_nexus/supervisor/service.py") == [] or [
+        f for f in _lint_nx015(src, emitter_path="tpu_nexus/supervisor/service.py")
+        if "not.registered" in f.message
+    ] == []
+    # non-Metrics receivers: itertools.count(1) and list.count(x) must
+    # not be mistaken for metric emissions
+    src2 = """
+    import itertools
+
+    class Engine:
+        def __init__(self):
+            self._counter = itertools.count(1)
+            self.n = [1, 2].count(1)
+    """
+    findings = _lint_nx015(src2)
+    # only the registry's now-unemitted rows fire — no emission findings
+    assert all("METRIC_NAMES documents" in f.message for f in findings)
+
+
+def test_nx015_repo_registry_matches_emissions():
+    """The shipped registry is in exact two-way parity with the serving/
+    workload emission sites (repo gate covers it; pinned so a drift
+    failure names the rule)."""
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus")],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX015"],
+    )
+    assert findings == []
+
+
+def test_metrics_table_docs_in_sync():
+    """docs/SERVING.md's generated metrics table matches METRIC_NAMES —
+    the docs half of the NX015 story (regenerate with
+    `python -m tools.metrics_table --write docs/SERVING.md`)."""
+    from tools.metrics_table import main as metrics_table_main
+
+    assert metrics_table_main(["--check", os.path.join(REPO_ROOT, "docs", "SERVING.md")]) == 0
+
+
+def test_metrics_table_check_detects_drift(tmp_path):
+    from tools.metrics_table import END_MARK, START_MARK
+    from tools.metrics_table import main as metrics_table_main
+
+    doc = tmp_path / "doc.md"
+    doc.write_text(f"# x\n\n{START_MARK}\n| stale |\n{END_MARK}\n")
+    assert metrics_table_main(["--check", str(doc)]) == 1
+    assert metrics_table_main(["--write", str(doc)]) == 0
+    assert metrics_table_main(["--check", str(doc)]) == 0
+    assert metrics_table_main(["--check", str(tmp_path / "missing.md")]) == 2

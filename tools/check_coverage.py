@@ -42,6 +42,7 @@ REQUIRED = [
     "tpu_nexus/serving/recovery.py",
     "tpu_nexus/serving/sharded.py",             # tensor-parallel executors + shard-aware swaps
     "tpu_nexus/serving/speculative.py",         # drafting + verify-k acceptance
+    "tpu_nexus/serving/tracing.py",             # span timelines + flight recorder + profiler
 
     "tpu_nexus/supervisor/taxonomy.py",
 ]

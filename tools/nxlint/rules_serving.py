@@ -449,6 +449,12 @@ OVERLAP_PATH = "serving/overlap.py"
 #: swap_params seam) — one stray readback there is a fleet-wide host
 #: GATHER of a sharded param tree during a rolling update
 SHARDED_PATH = "serving/sharded.py"
+#: the observability layer (ISSUE 14) is whole-module in scope too: the
+#: tracer/recorder hooks run INSIDE the dispatch loop on every step, so a
+#: readback there would re-serialize the overlapped engine exactly like
+#: one in the engine itself — the layer's contract is that it records
+#: host ints the engine already owned, never device values
+TRACING_PATH = "serving/tracing.py"
 ENGINE_CLASS = "ServingEngine"
 
 #: the sanctioned deferred-materialize seam: functions whose name carries
@@ -511,8 +517,10 @@ class DispatchLoopReadbackRule(Rule):
     def check_module(self, module: Module) -> Iterator[Finding]:
         if module.tree is None:
             return
-        if module.rel_path.endswith(OVERLAP_PATH) or module.rel_path.endswith(
-            SHARDED_PATH
+        if (
+            module.rel_path.endswith(OVERLAP_PATH)
+            or module.rel_path.endswith(SHARDED_PATH)
+            or module.rel_path.endswith(TRACING_PATH)
         ):
             yield from self._scan(module, module.tree.body)
             return

@@ -1,0 +1,168 @@
+"""Telemetry rules: the metric-name registry the docs table is built from.
+
+NX015  metric-name parity: every literal metric name emitted through a
+       ``Metrics``-shaped receiver in ``tpu_nexus/serving/`` and
+       ``tpu_nexus/workload/`` must have a row in
+       ``core/telemetry.METRIC_NAMES`` — and every registry row must
+       still be emitted somewhere in scope.  The docs table is GENERATED
+       from the registry (``python -m tools.metrics_table``), so both
+       directions together mean the table can never drift from the code:
+       an undocumented metric fails the gate, and so does a documented
+       ghost nothing emits any more.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+
+TELEMETRY_PATH = "core/telemetry.py"
+REGISTRY_NAME = "METRIC_NAMES"
+
+#: module path fragments in scope: the serving data plane and the workload
+#: loops — exactly where the dashboards' metric contract is produced
+_NX015_SCOPES = ("tpu_nexus/serving/", "tpu_nexus/workload/")
+
+#: the Metrics interface verbs (core/telemetry.Metrics)
+_VERBS = frozenset({"count", "gauge", "histogram", "timing"})
+
+#: receiver terminal names that carry a ``Metrics``-shaped object in the
+#: scoped modules (``self._m`` in ServingMetrics, ``self._metrics`` in the
+#: fleet controller and HealthMonitor, the harness's ``telemetry``, a bare
+#: ``metrics``/``statsd`` local).  A new receiver spelling outside this
+#: set silently escapes the rule — keep it in sync when adding one (the
+#: repo-clean gate's review is the backstop), but DON'T widen it to "any
+#: attribute": ``itertools.count(1)`` and ``list.count(x)`` are the false
+#: positives this set exists to exclude.
+_RECEIVERS = frozenset({"_m", "_metrics", "metrics", "telemetry", "statsd"})
+
+
+def _terminal(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def registered_metrics(tree: ast.Module) -> Optional[Dict[str, ast.AST]]:
+    """Metric name -> declaring key node: the literal string keys of the
+    module-level ``METRIC_NAMES`` dict (possibly annotated).  None when
+    the registry assignment is missing or not a dict literal (the rule
+    fails closed on that)."""
+    for stmt in tree.body:
+        value = None
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == REGISTRY_NAME for t in stmt.targets
+        ):
+            value = stmt.value
+        elif (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and stmt.target.id == REGISTRY_NAME
+        ):
+            value = stmt.value
+        if isinstance(value, ast.Dict):
+            names: Dict[str, ast.AST] = {}
+            for key in value.keys:
+                if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                    names.setdefault(key.value, key)
+            return names
+    return None
+
+
+def _emission_sites(tree: ast.Module) -> List[Tuple[ast.Call, Optional[str]]]:
+    """Every ``<receiver>.<verb>(first_arg, ...)`` call on a Metrics-shaped
+    receiver: ``(call node, literal name or None)`` — None flags a
+    non-literal first argument (unverifiable against the registry)."""
+    sites: List[Tuple[ast.Call, Optional[str]]] = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _VERBS
+            and _terminal(node.func.value) in _RECEIVERS
+        ):
+            continue
+        if node.args and isinstance(node.args[0], ast.Constant) and isinstance(
+            node.args[0].value, str
+        ):
+            sites.append((node, node.args[0].value))
+        else:
+            sites.append((node, None))
+    return sites
+
+
+@register
+class MetricNameParityRule(Rule):
+    """NX015: a metric a dashboard cannot find is a metric that does not
+    exist, and a documented metric nothing emits is worse — an on-call
+    building an alert on air.  Every literal metric name emitted via the
+    ``Metrics`` verbs in ``tpu_nexus/serving/`` and ``tpu_nexus/workload/``
+    must appear in ``core/telemetry.METRIC_NAMES`` (the single registry
+    the docs table is generated from), every registry row must still be
+    emitted, and a NON-literal metric name in scope is itself a finding
+    (the registry cannot vouch for a name computed at runtime).  Fails
+    closed when the registry is missing or unparseable — the same
+    contract as NX005/NX009/NX013."""
+
+    rule_id = "NX015"
+    description = (
+        "every emitted metric name must appear in core/telemetry.METRIC_NAMES "
+        "(and vice versa)"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        registry_module = project.find_module(TELEMETRY_PATH)
+        if registry_module is None or registry_module.tree is None:
+            return  # project doesn't contain the core tree (tools subtree)
+        registry = registered_metrics(registry_module.tree)
+        if registry is None:
+            yield self.finding(
+                registry_module,
+                registry_module.tree,
+                f"no {REGISTRY_NAME} dict literal found in "
+                f"{registry_module.rel_path} — metric-name parity "
+                "unverifiable (rule fails closed; fix registered_metrics "
+                "or restore the registry)",
+            )
+            return
+        emitted: Dict[str, List[Tuple[Module, ast.Call]]] = {}
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            if not any(scope in module.rel_path for scope in _NX015_SCOPES):
+                continue
+            for call, name in _emission_sites(module.tree):
+                if name is None:
+                    yield self.finding(
+                        module,
+                        call,
+                        "metric emitted with a non-literal name — the "
+                        f"{REGISTRY_NAME} registry (and the generated docs "
+                        "table) cannot vouch for a name computed at "
+                        "runtime; use a literal, or split per-variant "
+                        "literals",
+                    )
+                    continue
+                emitted.setdefault(name, []).append((module, call))
+                if name not in registry:
+                    yield self.finding(
+                        module,
+                        call,
+                        f"metric '{name}' is emitted but has no "
+                        f"{REGISTRY_NAME} row in {TELEMETRY_PATH} — add it "
+                        "(and regenerate the docs table: python -m "
+                        "tools.metrics_table --write docs/SERVING.md)",
+                    )
+        for name in sorted(set(registry) - set(emitted)):
+            yield self.finding(
+                registry_module,
+                registry[name],
+                f"{REGISTRY_NAME} documents '{name}' but nothing in "
+                f"{' / '.join(_NX015_SCOPES)} emits it any more — remove "
+                "the row (and regenerate the docs table) or restore the "
+                "emission",
+            )

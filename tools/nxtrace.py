@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""nxtrace — convert flight-recorder dumps to Chrome trace-event format.
+
+The serving engine's flight recorder (``tpu_nexus/serving/tracing.py``)
+serializes its per-step ring + the implicated requests' span timelines to
+JSON at the incident seams (step-fault escalation, DeviceStateLost,
+drain/SIGTERM, fleet replica-lost).  This tool turns one of those dumps
+into the Chrome trace-event format that ``chrome://tracing`` and
+https://ui.perfetto.dev load directly:
+
+    python -m tools.nxtrace /tmp/tpu-nexus-traces/nxtrace-123-001-drain.json
+    # -> nxtrace-123-001-drain.trace.json (open it in perfetto)
+
+Rendering (docs/OBSERVABILITY.md has the schemas):
+
+* each implicated request is a named thread under the "requests" process:
+  derived **slices** for its queued (submit→admitted) and prefill
+  (prefill_dispatch→prefill_complete) phases plus a whole-life slice, and
+  an **instant** per raw span event with its attrs as args — in overlap
+  mode the distinct decode_dispatch/materialize instants make the
+  one-step-late deferral visible on the timeline;
+* the flight-recorder ring renders under the "engine" process: **counter**
+  tracks for queue depth / slots / block pool / deferred lanes, and a
+  per-step **slice** on the dispatch track sized to that step's host
+  dispatch seconds.
+
+Dependency-free stdlib, same exit contract as the other tools: 0 ok,
+2 usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+#: trace-event process ids (arbitrary but stable — perfetto groups by pid)
+PID_REQUESTS = 1
+PID_ENGINE = 2
+
+#: span-phase pairs rendered as duration slices on a request's track
+_PHASE_SLICES = (
+    ("queued", "submit", "admitted"),
+    ("prefill", "prefill_dispatch", "prefill_complete"),
+)
+
+#: flight-recorder fields rendered as engine counter tracks
+_COUNTERS = (
+    "queue_depth",
+    "slots_used",
+    "deferred_slots",
+    "blocks_free",
+    "blocks_used",
+    "blocks_reclaimable",
+)
+
+
+def _us(t: float) -> float:
+    """Monotonic seconds -> trace-event microseconds."""
+    return t * 1e6
+
+
+def _thread_meta(pid: int, tid: int, name: str) -> Dict[str, Any]:
+    return {
+        "ph": "M", "pid": pid, "tid": tid, "name": "thread_name",
+        "args": {"name": name},
+    }
+
+
+def _request_events(timeline: Dict[str, Any], tid: int) -> List[Dict[str, Any]]:
+    rid = timeline.get("request_id", "?")
+    events = timeline.get("events", [])
+    out: List[Dict[str, Any]] = [_thread_meta(PID_REQUESTS, tid, rid)]
+    by_name: Dict[str, Dict[str, Any]] = {}
+    for ev in events:
+        by_name.setdefault(ev["name"], ev)  # first occurrence wins
+        out.append(
+            {
+                "ph": "i",  # instant, thread-scoped
+                "pid": PID_REQUESTS,
+                "tid": tid,
+                "name": ev["name"],
+                "ts": _us(ev["t"]),
+                "s": "t",
+                "args": ev.get("attrs") or {},
+            }
+        )
+    for slice_name, start_ev, end_ev in _PHASE_SLICES:
+        a, b = by_name.get(start_ev), by_name.get(end_ev)
+        if a is not None and b is not None and b["t"] >= a["t"]:
+            out.append(
+                {
+                    "ph": "X",
+                    "pid": PID_REQUESTS,
+                    "tid": tid,
+                    "name": slice_name,
+                    "ts": _us(a["t"]),
+                    "dur": max(1.0, _us(b["t"] - a["t"])),
+                    "args": {},
+                }
+            )
+    if events:
+        terminal = events[-1]
+        args = dict(terminal.get("attrs") or {})
+        out.append(
+            {
+                "ph": "X",
+                "pid": PID_REQUESTS,
+                "tid": tid,
+                "name": f"request {rid} [{args.get('state', '?')}]",
+                "ts": _us(events[0]["t"]),
+                "dur": max(1.0, _us(terminal["t"] - events[0]["t"])),
+                "args": args,
+            }
+        )
+    return out
+
+
+def _engine_events(records: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = [
+        _thread_meta(PID_ENGINE, 0, "dispatch"),
+    ]
+    for rec in records:
+        t = rec.get("t")
+        if t is None:
+            continue
+        for field in _COUNTERS:
+            if field in rec:
+                out.append(
+                    {
+                        "ph": "C",
+                        "pid": PID_ENGINE,
+                        "tid": 0,
+                        "name": field,
+                        "ts": _us(t),
+                        "args": {field: rec[field]},
+                    }
+                )
+        dispatch_s = float(rec.get("dispatch_s", 0.0))
+        # the step record's timestamp is taken AFTER its dispatches, so
+        # the slice ends at t and extends dispatch_s back — approximate,
+        # but the relative widths (the host tax per step) are exact
+        out.append(
+            {
+                "ph": "X",
+                "pid": PID_ENGINE,
+                "tid": 0,
+                "name": f"step {rec.get('step', '?')}",
+                "ts": _us(t - dispatch_s),
+                "dur": max(1.0, _us(dispatch_s)),
+                "args": {
+                    k: v
+                    for k, v in rec.items()
+                    if k not in ("t", "batch") and not isinstance(v, dict)
+                },
+            }
+        )
+        if rec.get("faults"):
+            out.append(
+                {
+                    "ph": "i",
+                    "pid": PID_ENGINE,
+                    "tid": 0,
+                    "name": f"fault: {','.join(rec['faults'])}",
+                    "ts": _us(t),
+                    "s": "p",  # process-scoped: draws across the track
+                    "args": {"faults": rec["faults"]},
+                }
+            )
+    return out
+
+
+def convert(dump: Dict[str, Any]) -> Dict[str, Any]:
+    """One flight-recorder dump dict -> a Chrome trace-event dict
+    (``{"traceEvents": [...], ...}``).  Raises ValueError on a payload
+    that is not a flight-recorder dump."""
+    schema = dump.get("schema", "")
+    if not str(schema).startswith("tpu-nexus-flight-recorder"):
+        raise ValueError(
+            f"not a flight-recorder dump (schema={schema!r}); expected "
+            "an artifact written by serving/tracing.FlightRecorder.dump"
+        )
+    events: List[Dict[str, Any]] = []
+    for tid, timeline in enumerate(dump.get("implicated", []), start=1):
+        tl = timeline.get("timeline")
+        if tl:
+            events.extend(_request_events(tl, tid))
+        else:
+            events.append(
+                _thread_meta(
+                    PID_REQUESTS, tid,
+                    f"{timeline.get('request_id', '?')} (no timeline)",
+                )
+            )
+    events.extend(_engine_events(dump.get("records", [])))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "reason": dump.get("reason", ""),
+            "wall_time": dump.get("wall_time"),
+            "implicated_total": dump.get("implicated_total"),
+            "source": "tpu-nexus nxtrace",
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.nxtrace",
+        description="convert flight-recorder dumps to Chrome trace-event JSON",
+    )
+    parser.add_argument("dump", help="flight-recorder JSON artifact")
+    parser.add_argument(
+        "-o", "--out",
+        help="output path (default: <dump>.trace.json alongside the input)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        with open(args.dump, "r", encoding="utf-8") as fh:
+            payload = json.load(fh)
+        trace = convert(payload)
+    except (OSError, ValueError, json.JSONDecodeError) as exc:
+        print(f"nxtrace: {exc}", file=sys.stderr)
+        return 2
+    out = args.out or (
+        args.dump[: -len(".json")] + ".trace.json"
+        if args.dump.endswith(".json")
+        else args.dump + ".trace.json"
+    )
+    with open(out, "w", encoding="utf-8") as fh:
+        json.dump(trace, fh)
+    print(
+        f"nxtrace: {len(trace['traceEvents'])} trace events -> {out} "
+        "(load in chrome://tracing or ui.perfetto.dev)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

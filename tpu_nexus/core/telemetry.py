@@ -279,6 +279,54 @@ def get_logger(name: str = "tpu_nexus", verbosity: int = 1) -> VLogger:
     return VLogger(logging.getLogger(name), verbosity=verbosity)
 
 
+#: THE metric-name registry (nxlint NX015): every literal metric name
+#: emitted through a ``Metrics``-shaped receiver in ``tpu_nexus/serving/``
+#: and ``tpu_nexus/workload/`` must have a row here, and every row here
+#: must still be emitted somewhere — both directions enforced statically,
+#: so the docs table (generated from this dict by ``python -m
+#: tools.metrics_table``) can never drift from what the code ships.
+#: Rows are ``name: (verb, description)`` with LITERAL string keys (the
+#: NX001/NX005/NX013 table convention — nxlint reads this as plain AST).
+METRIC_NAMES: Dict[str, tuple] = {
+    # -- serving engine (tpu_nexus/serving/metrics.py) -------------------------
+    "serving.ttft_seconds": ("histogram", "submit -> first token (queue wait + prefill)"),
+    "serving.tpot_seconds": ("histogram", "interval between consecutive tokens of one request (mean-preserving dt/n samples for multi-token materializations)"),
+    "serving.queue_wait_seconds": ("histogram", "submit -> slot granted (the scheduler-owned slice of TTFT)"),
+    "serving.dispatch_seconds": ("histogram", "host seconds one engine step spent inside jitted dispatches (the host tax; also rung per-step by the flight recorder)"),
+    "serving.queue_depth": ("gauge", "requests waiting for a slot, sampled per step"),
+    "serving.slot_occupancy": ("gauge", "busy slots / total slots, sampled per step"),
+    "serving.token_occupancy": ("gauge", "live cache tokens / token capacity (paged: blocks in use; contiguous: cursor rows)"),
+    "serving.deferred_slots": ("gauge", "slots with tokens dispatched but not yet materialized (overlapped dispatch)"),
+    "serving.requests_retired": ("count", "terminal retirements, tagged state: (+ cause: for non-finished outcomes)"),
+    "serving.shed": ("count", "submits rejected at admission (bounded queue / draining / reloading), tagged reason:"),
+    "serving.step_faults": ("count", "classified device faults that went unrecoverable, tagged cause:"),
+    "serving.step_retries": ("count", "transient-fault retry attempts spent (recovered and exhausted)"),
+    "serving.prefix_hit": ("count", "admissions that reused a cached prompt prefix"),
+    "serving.prefix_shared_tokens": ("count", "prompt tokens served by block reference instead of prefill"),
+    "serving.blocks_cow": ("count", "copy-on-write block copies at admission"),
+    "serving.spec_proposed": ("count", "draft tokens proposed to speculative verify"),
+    "serving.spec_accepted": ("count", "draft tokens accepted AND emitted"),
+    "serving.spec_rollback_blocks": ("count", "paged KV blocks released by verify rollback"),
+    "serving.draft_faults": ("count", "drafter failures degraded to no-draft steps"),
+    "serving.weight_swaps": ("count", "completed hot weight swaps (rolling updates)"),
+    "serving.trace_dumps": ("count", "flight-recorder incident artifacts written, tagged reason: (seam)"),
+    # -- fleet controller (tpu_nexus/serving/fleet.py) -------------------------
+    "fleet_decisions": ("count", "taxonomy-classified fleet events, tagged action:"),
+    "fleet_escalations": ("count", "incidents escalated to an operator (recreate refused), tagged action:"),
+    "fleet_recreates": ("count", "serving pods recreated by the controller, tagged action:"),
+    "fleet_watchdog_recreates": ("count", "pods recreated by the missing-pod absence sweep"),
+    # -- training (tpu_nexus/workload/harness.py, health.py) -------------------
+    "train.loss": ("gauge", "heartbeat-step training loss"),
+    "train.grad_norm": ("gauge", "heartbeat-step gradient norm"),
+    "train.anomaly": ("count", "numerical-health anomalies detected, tagged cause:"),
+    "train.skip": ("count", "in-jit sentinel-gated (skipped) optimizer updates"),
+    "train.rollback": ("count", "health-triggered rollback-and-skip recoveries, tagged cause:"),
+    "train.ckpt_rollback": ("count", "restore-time rollbacks past unverifiable checkpoints, tagged cause:"),
+    "train.emergency_save": ("count", "preemption emergency saves attempted, tagged skipped:"),
+    "train.emergency_save_failed": ("count", "emergency saves that failed inside the grace budget"),
+}
+
+
 class Metrics:
     """Minimal metrics interface: counters, gauges, timings (DogStatsD verbs)."""
 
@@ -352,13 +400,34 @@ class StatsdClient(Metrics):
     best-effort UDP).
     """
 
+    #: default datagram ceiling: the DogStatsD-over-UDP convention (1432 =
+    #: ethernet MTU minus headers — datagrams above it risk IP
+    #: fragmentation, and a fragmented-and-dropped datagram is a silently
+    #: lost metric).  Oversized payloads are truncated tags-first (a
+    #: tagless metric is still a VALID metric; a byte-truncated one is
+    #: garbage the agent rejects) and counted on ``truncated``.
+    DEFAULT_MAX_DATAGRAM = 1432
+
     def __init__(
         self,
         namespace: str,
         address: Optional[str] = None,
         static_tags: Optional[Mapping[str, str]] = None,
+        max_datagram_bytes: int = DEFAULT_MAX_DATAGRAM,
     ) -> None:
+        if max_datagram_bytes < 64:
+            raise ValueError(
+                f"max_datagram_bytes must be >= 64, got {max_datagram_bytes}"
+            )
         self.namespace = namespace.rstrip(".")
+        self.max_datagram_bytes = max_datagram_bytes
+        #: oversized datagrams sent without tags, or dropped entirely when
+        #: even the bare metric line exceeded the ceiling
+        self.truncated = 0
+        #: datagrams lost to socket/encoding failures (the fire-and-forget
+        #: contract made auditable: the engine loop never sees a raise,
+        #: but a drill can assert the failure was COUNTED, not vanished)
+        self.send_errors = 0
         self._tags = [f"{k}:{v}" for k, v in (static_tags or {}).items()]
         address = address or os.environ.get("DD_DOGSTATSD_URL") or "udp://127.0.0.1:8125"
         self._sock: Optional[socket.socket] = None
@@ -381,13 +450,25 @@ class StatsdClient(Metrics):
     def _send(self, payload: str, tags: Optional[Mapping[str, str]]) -> None:
         if self._sock is None:
             return
-        all_tags = self._tags + [f"{k}:{v}" for k, v in (tags or {}).items()]
-        if all_tags:
-            payload = f"{payload}|#{','.join(all_tags)}"
         try:
-            self._sock.send(payload.encode("utf-8"))
-        except OSError:
-            pass
+            base = payload.encode("utf-8")
+            all_tags = self._tags + [f"{k}:{v}" for k, v in (tags or {}).items()]
+            if all_tags:
+                wire = base + f"|#{','.join(all_tags)}".encode("utf-8")
+            else:
+                wire = base
+            if len(wire) > self.max_datagram_bytes:
+                # truncate-with-counter: drop the tag section first (the
+                # bare metric line is still valid DogStatsD; a mid-payload
+                # byte cut would be garbage the agent rejects) — and when
+                # even the bare line is oversized, drop the datagram
+                self.truncated += 1
+                if len(base) > self.max_datagram_bytes:
+                    return
+                wire = base
+            self._sock.send(wire)
+        except Exception:  # noqa: BLE001 - fire-and-forget contract (module doc): NO failure in the telemetry path — socket, encoding, a tag value whose __str__ raises — may propagate into the engine/supervision hot path; counted on send_errors so drills can assert the loss was recorded
+            self.send_errors += 1
 
     def count(self, name, value=1, tags=None) -> None:  # noqa: ANN001
         self._send(f"{self.namespace}.{name}:{value}|c", tags)

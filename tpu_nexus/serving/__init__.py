@@ -22,6 +22,12 @@ Layering (each module imports only downward):
                        prompt-lookup ngram + draft-model drafters, the
                        verify-k acceptance oracle (greedy token-identity)
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
+* ``tracing``        — observability layer (ISSUE 14): per-request span
+                       timelines, the engine flight recorder (ring of
+                       per-step records, serialized to JSON artifacts at
+                       the incident seams; ``python -m tools.nxtrace``
+                       converts dumps to perfetto-loadable Chrome traces)
+                       and the NEXUS_PROFILE_DIR device-profiling window
 * ``overlap``        — deferred-dispatch bookkeeping (ISSUE 12): pending
                        decode scans, override/inflight ledgers — the host
                        accounting behind ``ServingEngine(overlap=True)``
@@ -97,6 +103,13 @@ from tpu_nexus.serving.request import (
     RequestState,
 )
 from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
+from tpu_nexus.serving.tracing import (
+    DeviceProfiler,
+    EngineTracer,
+    FlightRecorder,
+    NullTracer,
+    RequestTrace,
+)
 
 __all__ = [
     "ACTIVE_STATES",
@@ -105,19 +118,23 @@ __all__ = [
     "CAUSE_REPLICA_LOST",
     "CheckpointWatcher",
     "DRAFTERS",
+    "DeviceProfiler",
     "DeviceStateLost",
     "DispatchPipeline",
     "Drafter",
     "EngineReplica",
+    "EngineTracer",
     "FifoScheduler",
     "FleetError",
     "FleetSupervisor",
+    "FlightRecorder",
     "IllegalTransition",
     "KVBlockManager",
     "KVSlotManager",
     "ModelDrafter",
     "ModelExecutor",
     "NGramDrafter",
+    "NullTracer",
     "PagedCacheManager",
     "PagedModelExecutor",
     "PendingStep",
@@ -127,6 +144,7 @@ __all__ = [
     "RETIREMENT_ACTIONS",
     "Request",
     "RequestState",
+    "RequestTrace",
     "SCRATCH_BLOCK",
     "SERVING_PARAM_RULES",
     "SchedulerConfig",

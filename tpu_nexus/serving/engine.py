@@ -35,6 +35,15 @@ over-capacity submits; and :meth:`ServingEngine.drain` implements the
 graceful-preemption protocol (stop admission, finish what fits in the
 grace budget, evict the rest with honest causes).
 
+Observability (ISSUE 14): tracing is DEFAULT-ON — every admitted request
+accumulates a bounded span timeline (``Request.trace``; dispatch and
+materialization are DISTINCT events under overlap, making the
+one-step-late deferral visible) and a flight-recorder ring of per-step
+records serializes to a JSON artifact at the incident seams (step-fault
+escalation, DeviceStateLost, drain, replica-lost), with the artifact
+inventory merged into the ledger details.  ``serving/tracing.py`` owns
+the layer; pass a ``NullTracer`` to disable.  docs/OBSERVABILITY.md.
+
 Overlapped execution (ISSUE 12): ``ServingEngine(overlap=True)`` never
 blocks between device steps — step N+1 dispatches while N's tokens are
 in flight (N's device outputs ARE N+1's operands; host overrides merge
@@ -74,6 +83,17 @@ from tpu_nexus.serving.request import (
 )
 from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
 from tpu_nexus.serving.speculative import accept_tokens
+from tpu_nexus.serving.tracing import (
+    EV_ADMITTED,
+    EV_DECODE_DISPATCH,
+    EV_FAULT,
+    EV_MATERIALIZE,
+    EV_PREFILL_COMPLETE,
+    EV_PREFILL_DISPATCH,
+    EV_SPEC_ACCEPT,
+    EV_SPEC_PROPOSE,
+    EngineTracer,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -828,8 +848,16 @@ class ServingEngine:
         spec_k: int = 0,
         drafter: Optional[Any] = None,
         overlap: bool = False,
+        tracer: Optional[Any] = None,
     ) -> None:
         self.executor = executor
+        #: request-span tracing + flight recorder (ISSUE 14,
+        #: serving/tracing.py) — DEFAULT ON: pass a
+        #: :class:`~tpu_nexus.serving.tracing.NullTracer` to disable (the
+        #: bench's tracer-off side; NEXUS_TRACE=0 in the serve loop).
+        #: Host-side only, never touches tokens — the token-identity
+        #: matrices run tracer-on, which is the proof.
+        self.tracer = EngineTracer(clock=clock) if tracer is None else tracer
         #: speculative decoding (ISSUE 11): propose spec_k draft tokens
         #: per slot each step, verify them in ONE q_len=spec_k+1 call,
         #: emit the accepted prefix + correction.  0 keeps the decode
@@ -947,10 +975,26 @@ class ServingEngine:
         #: synchronous oracle path runs.
         self._pipeline = DispatchPipeline(executor.num_slots)
         self.steps = 0
+        #: per-step observability accumulators (reset at the top of every
+        #: step, rung into the flight recorder by _finish_step): host
+        #: seconds spent inside jitted dispatches, fault-cause markers,
+        #: transient retries spent
+        self._step_dispatch_s = 0.0
+        self._step_fault_marks: List[str] = []
+        self._step_retry_marks = 0
+        #: flight-recorder sampling cadence for the paged pool's
+        #: reclaimable count — a full prefix-trie walk, priced every Nth
+        #: step instead of on the per-step hot path
+        self._reclaimable_sample_every = 16
         #: retirement log in order — what the bench and tests audit;
         #: trimmed from the FRONT past ``retired_log_limit`` so a serving
         #: process that never restarts cannot grow it without bound
         self.retired: List[Request] = []
+        #: monotonic retirement counter (never trimmed): the incident
+        #: seams mark it before retiring and slice the log tail by the
+        #: DELTA — a ``len(self.retired)`` mark would misalign the moment
+        #: the front-trim fires on a long-lived engine
+        self.retired_total = 0
 
     # -- admission interface ---------------------------------------------------
 
@@ -1007,6 +1051,7 @@ class ServingEngine:
             )
         self.requests[rid] = req
         self.scheduler.submit(req)
+        self.tracer.begin(req)
         return req
 
     def cancel(self, request_id: str) -> bool:
@@ -1030,8 +1075,11 @@ class ServingEngine:
         step over every live slot.  Returns counts for observability
         ({admitted, decoded, retired})."""
         self.steps += 1
-        retired_before = len(self.retired)
+        retired_before = self.retired_total
         deferred_tokens = 0
+        self._step_dispatch_s = 0.0
+        self._step_fault_marks = []
+        self._step_retry_marks = 0
 
         # 0. a pending dispatch that FAULTED at the call (overlap mode)
         # must resolve BEFORE any scheduling decision below: the sweeps
@@ -1117,6 +1165,13 @@ class ServingEngine:
             )
         decoded = 0
         next_tokens = None
+        if self.tracer.enabled:  # don't build attrs dicts for a NullTracer
+            for req in self._active.values():
+                # sync mode: dispatch and readback are the same point, so
+                # ONE span event covers the step (overlap mode records
+                # distinct dispatch/materialize events — the deferral
+                # made visible)
+                self.tracer.event(req, EV_DECODE_DISPATCH, {"step": self.steps})
         while self._active:
             try:
                 next_tokens = self._dispatch(self._step_thunk)
@@ -1134,7 +1189,12 @@ class ServingEngine:
                     fault.cause, victim.request_id, victim_slot,
                     len(self._active) - 1, fault.original,
                 )
+                self.tracer.event(
+                    victim, EV_FAULT,
+                    {"cause": fault.cause, "retries": fault.retries},
+                )
                 self._retire(victim, RequestState.FAILED, cause=fault.cause)
+                self._dump_incident("step-fault", fault.cause, [victim])
         if next_tokens is not None:
             now = self._clock()
             for slot, req in list(self._active.items()):
@@ -1175,11 +1235,48 @@ class ServingEngine:
             live_tokens=live_tokens, token_capacity=token_capacity,
             deferred_slots=self._pipeline.deferred_slots,
         )
-        return {
+        self.metrics.dispatch_time(self._step_dispatch_s)
+        summary = {
             "admitted": admitted,
             "decoded": decoded,
-            "retired": len(self.retired) - retired_before,
+            "retired": self.retired_total - retired_before,
         }
+        if not self.tracer.enabled:
+            return summary
+        # one flight-recorder ring entry per engine step: what the engine
+        # was doing in the steps before an incident (the dump seams
+        # serialize this ring) — plain host ints only, NX014-clean
+        record: Dict[str, Any] = {
+            "step": self.steps,
+            "t": self._clock(),
+            "queue_depth": self.scheduler.pending,
+            "batch": {
+                int(slot): req.request_id for slot, req in self._active.items()
+            },
+            "slots_used": self.slots.used_count,
+            "slots_free": self.slots.free_count,
+            "deferred_slots": self._pipeline.deferred_slots,
+            "dispatch_s": round(self._step_dispatch_s, 6),
+            **summary,
+        }
+        if self.paged is not None:
+            record["blocks_free"] = self.paged.manager.free_count
+            record["blocks_used"] = self.paged.used_blocks
+            # reclaimable is a full prefix-trie walk (O(cached blocks)) —
+            # too expensive for every step of the dispatch loop NX014
+            # keeps lean; SAMPLE it instead.  Rows without the field are
+            # between samples, not zero (nxtrace renders it as a stepped
+            # counter either way).
+            if self.steps % self._reclaimable_sample_every == 0:
+                record["blocks_reclaimable"] = self.paged.index.reclaimable(
+                    self.paged.manager
+                )
+        if self._step_fault_marks:
+            record["faults"] = list(self._step_fault_marks)
+        if self._step_retry_marks:
+            record["retries"] = self._step_retry_marks
+        self.tracer.record_step(**record)
+        return summary
 
     # -- overlapped dispatch / in-jit multi-step decode (ISSUE 12) -------------
 
@@ -1278,11 +1375,26 @@ class ServingEngine:
             # (an early-stop retires it first), so the offset is exact
             cursor_base=cursors.astype(np.int64) + self._pipeline.inflight,
             assumed=limits.copy(),
+            step_no=self.steps,
+            dispatched_at=self._clock(),
         )
+        if self.tracer.enabled:  # don't build attrs dicts for a NullTracer
+            for slot in pending.order:
+                # deferred mode: dispatch and materialization are DISTINCT
+                # span events — this one marks when the request's tokens
+                # left the host; EV_MATERIALIZE (one step later) marks
+                # when they came back, carrying dispatch_step so the
+                # deferral is visible
+                self.tracer.event(
+                    pending.snapshot[slot], EV_DECODE_DISPATCH,
+                    {"step": self.steps, "deferred": True},
+                )
+        t0 = time.perf_counter()
         try:
             pending.result = pending.thunk()
         except (RuntimeError, DeviceStateLost) as exc:  # noqa: BLE001 - deferred seam: the fault is HELD on the pending record and re-raised at materialization through the SAME recovery policy, one step late by design (the chaos contract)
             pending.error = exc
+        self._step_dispatch_s += time.perf_counter() - t0
         self._pipeline.push(pending)
 
     def _materialize_one(self) -> int:
@@ -1339,7 +1451,20 @@ class ServingEngine:
                     fault.cause, victim.request_id, victim.slot,
                     survivors, fault.original,
                 )
+                self.tracer.event(
+                    victim, EV_FAULT,
+                    {
+                        "cause": fault.cause,
+                        "retries": fault.retries,
+                        # surfaced at materialization, one step after the
+                        # dispatch that captured it — the held-fault
+                        # timeline the chaos tests pin
+                        "held": True,
+                        "dispatch_step": pending.step_no,
+                    },
+                )
                 self._retire(victim, RequestState.FAILED, cause=fault.cause)
+                self._dump_incident("step-fault", fault.cause, [victim])
         decoded = 0
         now = self._clock()
         for slot in pending.order:
@@ -1350,6 +1475,12 @@ class ServingEngine:
             n = int(counts[slot])
             if n <= 0:
                 continue
+            if self.tracer.enabled:
+                self.tracer.event(
+                    req, EV_MATERIALIZE,
+                    {"step": self.steps, "dispatch_step": pending.step_no,
+                     "n": n},
+                )
             dt = None if req.last_token_at is None else now - req.last_token_at
             emitted = [int(t) for t in toks[slot, :n]]
             for tok in emitted:
@@ -1430,6 +1561,16 @@ class ServingEngine:
             return 0
         k = self.spec_k
         drafts = self._propose_safe(k)
+        if self.tracer.enabled:  # don't build attrs dicts for a NullTracer
+            drafter_name = getattr(self.drafter, "name", "?")
+            for req in self._active.values():
+                # propose + the verify dispatch it feeds, one span event
+                # (the acceptance outcome lands as EV_SPEC_ACCEPT after
+                # readback)
+                self.tracer.event(
+                    req, EV_SPEC_PROPOSE,
+                    {"step": self.steps, "k": k, "drafter": drafter_name},
+                )
         if self.paged is not None:
             # the verify window writes positions [cursor, cursor + k]; a
             # prior rollback may have released the request's tail blocks,
@@ -1459,7 +1600,12 @@ class ServingEngine:
                     fault.cause, victim.request_id, victim_slot,
                     len(self._active) - 1, fault.original,
                 )
+                self.tracer.event(
+                    victim, EV_FAULT,
+                    {"cause": fault.cause, "retries": fault.retries},
+                )
                 self._retire(victim, RequestState.FAILED, cause=fault.cause)
+                self._dump_incident("step-fault", fault.cause, [victim])
         decoded = 0
         if greedy is None:
             return 0
@@ -1476,6 +1622,12 @@ class ServingEngine:
             self._tokens[slot] = emitted[-1]
             self.metrics.spec_tokens(dt, e)
             self.metrics.spec_verify(proposed=k, accepted=n_draft)
+            if self.tracer.enabled:
+                self.tracer.event(
+                    req, EV_SPEC_ACCEPT,
+                    {"step": self.steps, "proposed": k, "accepted": n_draft,
+                     "emitted": e},
+                )
             self.drafter.observe(slot, emitted)
             decoded += e
             # rollback audit: the verify wrote KV through position c + k
@@ -1532,6 +1684,7 @@ class ServingEngine:
         ledger report; per-cause counts live in
         ``metrics.retired_causes``."""
         self.draining = True
+        drain_mark = self.retired_total
         # fence BEFORE any shedding decision: in-flight dispatches carry
         # real tokens (possibly a request's final one) — materialize them
         # so the drain never evicts a request that had already finished
@@ -1559,6 +1712,10 @@ class ServingEngine:
             self.metrics.retired.get(RequestState.FINISHED, 0) - finished_before,
             evicted, shed_queue,
         )
+        # drain/SIGTERM incident seam: one artifact carrying the final
+        # flight-recorder window + every timeline the drain retired, so
+        # the PREEMPTED ledger row's per-cause counts have a drill-down
+        self._dump_incident("drain", "drain", self._retired_since(drain_mark))
         return {
             "drain_steps": steps,
             "drain_finished": self.metrics.retired.get(RequestState.FINISHED, 0)
@@ -1611,6 +1768,7 @@ class ServingEngine:
         # the process is going away — account whatever already made it
         # back from the device before writing the requests off
         self._fence()
+        mark = self.retired_total
         n = 0
         for req in self.scheduler.drain_queue():
             self._retire(req, RequestState.EVICTED, cause=cause)
@@ -1618,6 +1776,9 @@ class ServingEngine:
         for req in list(self._active.values()):
             self._retire(req, RequestState.FAILED, cause=cause)
             n += 1
+        # fleet replica-lost incident seam: the controller merges this
+        # artifact's path into the ledger incident record it writes
+        self._dump_incident("replica-lost", cause, self._retired_since(mark))
         return n
 
     def quiesce(self, grace_s: float, max_steps: int = 1_000_000) -> Dict[str, int]:
@@ -1678,20 +1839,73 @@ class ServingEngine:
 
     # -- internals -------------------------------------------------------------
 
+    @property
+    def last_incident_dump(self) -> Optional[Dict[str, Any]]:
+        """Path/reason/causes of the most recent flight-recorder artifact
+        (None when tracing is off or nothing dumped) — what the serve loop
+        and the fleet controller merge into ledger details."""
+        return self.tracer.last_dump
+
+    def _retired_since(self, mark: int) -> List[Request]:
+        """Requests retired since ``mark`` (a ``retired_total`` snapshot),
+        read off the log's TAIL — correct across the front-trim that a
+        plain ``len(self.retired)`` slice index is not (the trim shifts
+        every index; the tail delta is invariant).  Retirements beyond
+        ``retired_log_limit`` since the mark are gone from the log and
+        honestly absent here."""
+        since = self.retired_total - mark
+        keep = min(since, len(self.retired))
+        return self.retired[len(self.retired) - keep:]
+
+    def _dump_incident(self, seam: str, reason: str, reqs: Sequence[Request]) -> None:
+        """Serialize the flight-recorder ring + the implicated requests'
+        timelines at one of the incident seams (step-fault escalation,
+        device-state-lost, drain/SIGTERM, replica-lost).  ``seam`` is the
+        bounded metrics tag; ``reason`` the specific cause baked into the
+        artifact name.  Best-effort by the recorder's contract — a failed
+        write is counted, never raised."""
+        full = (
+            reason
+            if reason == seam or reason.startswith(f"{seam}:")
+            else f"{seam}:{reason}"
+        )
+        path = self.tracer.dump(
+            full,
+            reqs,
+            extra={"engine_steps": self.steps, "seam": seam},
+        )
+        if path is not None:
+            self.metrics.trace_dump(seam)
+            logger.warning(
+                "flight recorder dumped %d step record(s) to %s (%s)",
+                len(self.tracer.recorder.records), path, reason,
+            )
+
     def _dispatch(self, fn: Callable[[], Any]) -> Any:
         """Run one jitted dispatch through the fault policy; feed the
         policy's audit counters into metrics.  Raises :class:`StepFault`
         for unrecoverable classified faults (caller retires the implicated
         request), re-raises unclassified errors."""
         retries_before = self.fault_policy.retries_used
+        t0 = time.perf_counter()
         try:
             result = self.fault_policy.run(fn)
         except StepFault as fault:
             self.metrics.step_fault(fault.cause, fault.retries)
+            self._step_fault_marks.append(fault.cause)
             raise
+        except DeviceStateLost:
+            self._step_fault_marks.append("device-state-lost")
+            raise
+        finally:
+            # host dispatch latency, accumulated per step for the flight
+            # recorder + serving.dispatch_seconds (faulted attempts count:
+            # a step that burned its budget in retries IS slow)
+            self._step_dispatch_s += time.perf_counter() - t0
         recovered = self.fault_policy.retries_used - retries_before
         if recovered:
             self.metrics.step_recovered(recovered)
+            self._step_retry_marks += recovered
         return result
 
     def _step_thunk(self):
@@ -1819,13 +2033,20 @@ class ServingEngine:
             assert slot is not None, "scheduler admitted beyond free slots"
             req.slot = slot
             req.transition(RequestState.PREFILLING)
-            self.metrics.queue_wait(self._clock() - req.submitted_at)
+            wait_s = self._clock() - req.submitted_at
+            self.metrics.queue_wait(wait_s)
+            self.tracer.event(
+                req, EV_ADMITTED,
+                {"step": self.steps, "slot": slot,
+                 "queue_wait_s": round(wait_s, 6)},
+            )
             begin = self._prepare_begin(slot, req)
             if begin is None:
                 # the admission plan straddled a device reset and the
                 # shareless re-plan no longer fits the pool
                 self._retire(req, RequestState.FAILED, cause="device-state-lost")
                 continue
+            self.tracer.event(req, EV_PREFILL_DISPATCH, {"step": self.steps})
             try:
                 # same recovery policy as the decode step; a prefill fault
                 # implicates exactly ONE request — this one.  Transient
@@ -1840,8 +2061,15 @@ class ServingEngine:
                     "engine keeps serving: %s",
                     fault.cause, req.request_id, slot, fault.original,
                 )
+                self.tracer.event(
+                    req, EV_FAULT,
+                    {"cause": fault.cause, "retries": fault.retries,
+                     "phase": "prefill"},
+                )
                 self._retire(req, RequestState.FAILED, cause=fault.cause)
+                self._dump_incident("step-fault", fault.cause, [req])
                 continue
+            shared = n_cow = 0
             if self.paged is not None:
                 # cache the prompt's full blocks for future admissions —
                 # only now, after the prefill that filled them succeeded —
@@ -1854,6 +2082,11 @@ class ServingEngine:
                     self.metrics.blocks_cow(n_cow)
                 if shared:
                     self.metrics.prefix_hit(shared)
+            self.tracer.event(
+                req, EV_PREFILL_COMPLETE,
+                {"step": self.steps, "prefilled": req.prompt_len - shared,
+                 "shared_tokens": shared, "cow_blocks": n_cow},
+            )
             if self.drafter is not None:
                 # the drafter's slot state mirrors the request's tenancy:
                 # begin BEFORE any retire path can run, observe the
@@ -1909,8 +2142,11 @@ class ServingEngine:
             cause, len(victims), lost.original,
         )
         self.metrics.step_fault(cause, 0)
+        self._step_fault_marks.append(cause)
         for req in victims:
+            self.tracer.event(req, EV_FAULT, {"cause": cause, "batch_wide": True})
             self._retire(req, RequestState.FAILED, cause=cause)
+        self._dump_incident("device-state-lost", cause, victims)
         # every pending result references the CONSUMED device state — drop
         # them all; the next dispatch starts from host state wholesale
         self._pipeline.clear()
@@ -1953,9 +2189,14 @@ class ServingEngine:
                 # drop every block reference: exclusive blocks free now,
                 # index-cached prefix blocks stay for future admissions
                 self.paged.release(req.request_id)
+        # terminal span event: state/action/cause + the TTFT/TPOT summary,
+        # computed from the SAME Request timestamps the metrics histograms
+        # read — tracing and metrics cannot disagree
+        self.tracer.terminal(req, action)
         self.metrics.retired_request(req, action)
         self.requests.pop(req.request_id, None)  # bound live-request memory
         self.retired.append(req)
+        self.retired_total += 1
         if len(self.retired) > self._retired_log_limit:
             del self.retired[: len(self.retired) - self._retired_log_limit]
         logger.info(

@@ -105,6 +105,11 @@ class EngineReplica:
     down_cause: str = ""
     history: List[Request] = field(default_factory=list)
     history_limit: int = 10_000
+    #: the flight-recorder artifact the dying engine dumped at its
+    #: replica-lost seam (path/reason/causes; None when tracing is off) —
+    #: survives the engine swap so the recreate incident record can point
+    #: the ledger at the drill-down
+    last_incident_dump: Optional[Dict[str, Any]] = None
 
     def fold_history(self) -> None:
         """Fold the current engine's retirement log into ``history`` (the
@@ -219,7 +224,16 @@ class ServingFleet:
             raise FleetError(f"unknown replica {name!r}")
         if rep.state == REPLICA_DOWN:
             return 0
+        # abandon() dumps the flight recorder at the replica-lost seam;
+        # keep the artifact pointer past the engine swap for the incident
+        # record the controller writes into the ledger — but ONLY if the
+        # dump actually landed (same dict identity = no new artifact:
+        # budget spent or unwritable dir).  A stale earlier step-fault
+        # artifact must not be passed off as THIS incident's drill-down.
+        before = getattr(rep.engine, "last_incident_dump", None)
         n = rep.engine.abandon(cause)
+        after = getattr(rep.engine, "last_incident_dump", None)
+        rep.last_incident_dump = after if after is not before else None
         rep.state = REPLICA_DOWN
         rep.down_cause = cause
         logger.warning(
@@ -771,6 +785,7 @@ class FleetSupervisor:
                 self.fleet.kill_replica(
                     incident.pod, f"{CAUSE_REPLICA_LOST}:{incident.action}"
                 )
+                self._attach_dump(record, incident.pod)
             self.incidents.append(record)
             self._metrics.count("fleet_escalations", tags={"action": incident.action})
             self._log.warning(
@@ -817,6 +832,7 @@ class FleetSupervisor:
             self.fleet.kill_replica(
                 incident.pod, f"{CAUSE_REPLICA_LOST}:{incident.action}"
             )
+            self._attach_dump(record, incident.pod)
         step = self._target_step()
         await self._recreate_pod(incident.pod, kv)
         engine = self.replica_factory(incident.pod, step, kv)
@@ -884,6 +900,14 @@ class FleetSupervisor:
                     env.append({"name": "NEXUS_KV_BLOCKS", "value": str(kv_blocks)})
         await self._client.create_object("Pod", self.namespace, manifest)
         self._pod_templates[name] = copy.deepcopy(manifest)
+
+    def _attach_dump(self, record: Dict[str, Any], pod: str) -> None:
+        """Merge the dead replica's flight-recorder artifact pointer into
+        the incident record (``_record_cause`` serializes the record into
+        the ledger details wholesale, so the row names its drill-down)."""
+        rep = self.fleet.replicas.get(pod)
+        if rep is not None and rep.last_incident_dump is not None:
+            record["flight_recorder"] = rep.last_incident_dump
 
     # -- ledger ----------------------------------------------------------------
 

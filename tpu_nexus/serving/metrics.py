@@ -18,7 +18,8 @@ Definitions (the usual LLM-serving SLOs):
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence
 
 from tpu_nexus.core.telemetry import Metrics, NullMetrics
 from tpu_nexus.serving.request import Request
@@ -81,6 +82,17 @@ class ServingMetrics:
         self.deferred_slots = 0
         #: completed hot weight swaps (rolling updates, ISSUE 9)
         self.weight_swaps_total = 0
+        #: flight-recorder incident artifacts written (ISSUE 14): one per
+        #: dump at the StepFault/DeviceStateLost/drain/replica-lost seams
+        self.trace_dumps_total = 0
+        #: host dispatch seconds per engine step (the flight recorder's
+        #: per-step sample, histogrammed so a dashboard sees the host tax
+        #: the overlap refactor exists to hide).  BOUNDED (recent window):
+        #: this is sampled once per STEP forever, not once per request —
+        #: an unbounded list would grow for the life of a serving process
+        #: (the statsd histogram stream is the unbounded production view;
+        #: summary() percentiles read the recent window)
+        self.dispatch_s: Deque[float] = deque(maxlen=4096)
 
     def queue_wait(self, seconds: float) -> None:
         """Submit → admission (slot granted), the scheduler-owned slice of
@@ -195,6 +207,20 @@ class ServingMetrics:
         self.weight_swaps_total += 1
         self._m.count("serving.weight_swaps")
 
+    def trace_dump(self, reason: str) -> None:
+        """One flight-recorder incident artifact landed on disk (the
+        ``reason`` tag names the seam: step-fault cause, device-state-lost,
+        drain, replica-lost)."""
+        self.trace_dumps_total += 1
+        self._m.count("serving.trace_dumps", tags={"reason": reason})
+
+    def dispatch_time(self, seconds: float) -> None:
+        """Host seconds one engine step spent inside jitted dispatches
+        (fault-policy attempts included) — the per-step host-tax sample
+        the flight recorder also rings."""
+        self.dispatch_s.append(seconds)
+        self._m.histogram("serving.dispatch_seconds", seconds)
+
     def blocks_cow(self, n: int = 1) -> None:
         """``n`` copy-on-write block copies at admission (a shared partial
         block diverged)."""
@@ -245,6 +271,9 @@ class ServingMetrics:
             "spec_rollback_blocks": self.spec_rollback_blocks_total,
             "draft_faults": self.draft_faults,
             "weight_swaps": self.weight_swaps_total,
+            "trace_dumps": self.trace_dumps_total,
+            "dispatch_p50_s": percentile(self.dispatch_s, 50),
+            "dispatch_p99_s": percentile(self.dispatch_s, 99),
             "token_occupancy": self.token_occupancy,
             "deferred_slots": self.deferred_slots,
             "ttft_p50_s": percentile(self.ttft_s, 50),

@@ -77,6 +77,13 @@ class PendingStep:
     #: arrays — materialization's np.asarray readback is where a deferred
     #: device fault surfaces on async backends
     result: Optional[Tuple[Any, Any, Any, Any]] = None
+    #: observability anchors (serving/tracing.py): which engine step
+    #: dispatched this scan and when (monotonic clock) — the materialize
+    #: span events carry both, which is what makes the one-step-late
+    #: deferral VISIBLE on a request timeline instead of inferred from
+    #: bench ratios
+    step_no: int = 0
+    dispatched_at: float = 0.0
     #: dispatch-time fault (sync backends / the chaos wrapper raise at the
     #: call): held here and re-raised through the SAME recovery policy at
     #: materialization — one step late by design, same one-fault-one-

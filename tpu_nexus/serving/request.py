@@ -140,6 +140,14 @@ class Request:
     #: the scheduler's starvation-guard counter
     queued_steps: int = 0
     cancel_requested: bool = False
+    #: span timeline (serving/tracing.RequestTrace), installed by the
+    #: engine's tracer at submit: bounded monotonic-clock events from
+    #: submit through the terminal retirement (with cause), riding the
+    #: request through the retirement log and the fleet history so an
+    #: incident dump can always include the implicated timeline.  None
+    #: when tracing is disabled (NullTracer) or the request never went
+    #: through ServingEngine.submit.
+    trace: Optional[object] = None
 
     def __post_init__(self) -> None:
         self.prompt = np.asarray(self.prompt, dtype=np.int32).reshape(-1)

@@ -842,6 +842,15 @@ def _workload_loop(
         )
         watchdog.start()
 
+    # on-demand device profiling (ISSUE 14, serving/tracing.DeviceProfiler
+    # — host-side and jax-lazy, so the training harness shares the serving
+    # stack's hook): NEXUS_PROFILE_DIR arms a jax.profiler capture around
+    # train steps [NEXUS_PROFILE_START, NEXUS_PROFILE_START +
+    # NEXUS_PROFILE_STEPS); strictly best-effort, failures counted
+    from tpu_nexus.serving.tracing import DeviceProfiler
+
+    profiler = DeviceProfiler.from_env()
+
     reporter.running()
     metrics: Dict[str, Any] = {}
     m: Dict[str, Any] = {}
@@ -885,6 +894,10 @@ def _workload_loop(
                 armed = watchdog is not None and not compile_pending
                 if armed:
                     watchdog.arm(step)
+                if profiler is not None and not compile_pending:
+                    # profile steady-state steps: the first iteration's
+                    # synchronous jit compile would drown the window
+                    profiler.tick(step)
                 maybe_inject(
                     plan,
                     step,
@@ -955,6 +968,8 @@ def _workload_loop(
     finally:
         if watchdog is not None:
             watchdog.stop()
+        if profiler is not None:
+            profiler.stop()  # close a capture the loop exited inside of
     jax.block_until_ready(state["step"])
     elapsed = time.perf_counter() - t0
     # same uniformity rule as the loop break: every host reaches this point

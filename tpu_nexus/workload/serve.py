@@ -160,6 +160,18 @@ class ServeConfig:
     #: (current behavior: weights are fixed at startup).
     #: (NEXUS_RELOAD_CHECK_S)
     reload_check_interval_s: float = 0.0
+    #: engine mode only — request-span tracing + flight recorder (ISSUE
+    #: 14, serving/tracing.py).  DEFAULT ON: every request accumulates a
+    #: bounded span timeline and the engine rings per-step records,
+    #: dumping a JSON artifact at the incident seams (step-fault
+    #: escalation, device-state-lost, drain/SIGTERM).  Host-side only and
+    #: token-stream-neutral (the identity matrices run tracer-on);
+    #: measured overhead <= 2% tokens/s (BENCH_SERVING_TRACE_r11.json).
+    #: NEXUS_TRACE=0 opts out (the bench's tracer-off side).
+    trace_enabled: bool = True
+    #: where flight-recorder artifacts land; "" = NEXUS_TRACE_DIR else
+    #: <tmpdir>/tpu-nexus-traces (serving/tracing.default_trace_dir)
+    trace_dir: str = ""
 
     def __post_init__(self) -> None:
         # value validation lives HERE, not in the run loops: a bad env
@@ -324,6 +336,8 @@ class ServeConfig:
             spec_draft_preset=e.get("NEXUS_SPEC_DRAFT_PRESET", ""),
             serve_mesh=e.get("NEXUS_SERVE_MESH", ""),
             reload_check_interval_s=float(e.get("NEXUS_RELOAD_CHECK_S", "0")),
+            trace_enabled=e.get("NEXUS_TRACE", "1") != "0",
+            trace_dir=e.get("NEXUS_TRACE_DIR", ""),
             overlap_dispatch=e.get("NEXUS_OVERLAP", "") not in ("", "0"),
             decode_steps=int(e.get("NEXUS_DECODE_STEPS", "1")),
             stop_token=int(e.get("NEXUS_STOP_TOKEN", "-1")),
@@ -691,6 +705,23 @@ def _serve_engine_loop(
                 **dict(executor_kwargs, kv_quant=""),
             )
             drafter = ModelDrafter(draft_executor)
+    # observability layer (ISSUE 14, serving/tracing.py): span timelines +
+    # flight recorder, DEFAULT ON — NEXUS_TRACE=0 swaps in the NullTracer
+    # (the bench's tracer-off side); NEXUS_TRACE_DIR moves the artifacts
+    from tpu_nexus.serving.tracing import (
+        DeviceProfiler,
+        EngineTracer,
+        FlightRecorder,
+        NullTracer,
+    )
+
+    tracer = (
+        EngineTracer(
+            recorder=FlightRecorder(dump_dir=cfg.trace_dir or None)
+        )
+        if cfg.trace_enabled
+        else NullTracer()
+    )
     engine = ServingEngine(
         executor,
         scheduler=FifoScheduler(SchedulerConfig(max_queue=cfg.queue_limit)),
@@ -699,6 +730,7 @@ def _serve_engine_loop(
         # overlapped dispatch (NEXUS_OVERLAP): the host never sits between
         # device steps — step N+1 dispatches while N's tokens are in flight
         overlap=cfg.overlap_dispatch,
+        tracer=tracer,
     )
 
     reporter.running()
@@ -712,6 +744,12 @@ def _serve_engine_loop(
     # chaos seam AFTER warmup, so NEXUS_FAULT_STEP counts served decode
     # steps on the same zero base as the iteration counter below
     engine.executor = wrap_executor(plan, executor)
+
+    # on-demand device profiling (ISSUE 14): NEXUS_PROFILE_DIR arms a
+    # jax.profiler capture around engine steps [NEXUS_PROFILE_START,
+    # NEXUS_PROFILE_START + NEXUS_PROFILE_STEPS) — the host-tax numbers
+    # in PERF.md become measurements instead of inferences
+    profiler = DeviceProfiler.from_env()
 
     t0 = time.perf_counter()
     deadline_s = cfg.deadline_s or None
@@ -761,6 +799,8 @@ def _serve_engine_loop(
                 # verification or did not fit — remember it so the reload
                 # check does not pay a failed load (or a quiesce) per poll
                 bad_reload = (latest, scans)
+        if profiler is not None:
+            profiler.tick(it)
         engine.step()
         it += 1
         if cfg.heartbeat_every and it % cfg.heartbeat_every == 0:
@@ -780,6 +820,8 @@ def _serve_engine_loop(
                     pump()
     while engine.has_work and not lifecycle.cancelled:
         pump()
+    if profiler is not None:
+        profiler.stop()  # close a capture the run finished inside of
     elapsed = time.perf_counter() - t0
 
     drain_summary: Dict[str, Any] = {}
@@ -803,17 +845,18 @@ def _serve_engine_loop(
         if ctx.is_coordinator:
             import json
 
-            reporter.preempted(
-                cause=cause,
-                details=json.dumps(
-                    {
-                        "retired_states": metrics.retired,
-                        "retired_causes": metrics.retired_causes,
-                        **drain_summary,
-                    },
-                    sort_keys=True,
-                ),
-            )
+            # the flight recorder dumped at the drain seam — merge the
+            # artifact inventory (paths + per-cause counts) into the same
+            # details column the supervisor reads, so the PREEMPTED row
+            # names where its drill-down lives
+            details = {
+                "retired_states": metrics.retired,
+                "retired_causes": metrics.retired_causes,
+                **drain_summary,
+            }
+            if tracer.enabled:
+                details["flight_recorder"] = tracer.recorder.summary()
+            reporter.preempted(cause=cause, details=json.dumps(details, sort_keys=True))
     else:
         reporter.heartbeat(it)
         if ctx.is_coordinator:
@@ -835,6 +878,16 @@ def _serve_engine_loop(
         "elapsed_s": elapsed,
         "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
         "drained": lifecycle.cancelled,
+        # observability: the dump inventory (incident artifacts on disk)
+        # and the profiler window outcome, so a drill can assert both from
+        # the summary without groveling the trace dir
+        "flight_recorder": tracer.recorder.summary() if tracer.enabled else None,
+        "profiler": (
+            {"dir": profiler.profile_dir, "state": profiler.state,
+             "failures": profiler.failures}
+            if profiler is not None
+            else None
+        ),
         **drain_summary,
         **metrics.summary(),
     }
