@@ -44,52 +44,11 @@ import json
 import os
 import time
 
-# Chip-kind substring -> peak bf16 TFLOP/s (dense).  Public numbers:
-# v5e 197, v5p 459, v4 275, v6e (Trillium) 918.
-_PEAK_BF16_TFLOPS = (
-    ("v5 lite", 197.0),
-    ("v5e", 197.0),
-    ("v5p", 459.0),
-    ("v6", 918.0),
-    ("v4", 275.0),
-)
-
-
-def _chip_peak_tflops(device) -> float:
-    env = os.environ.get("NEXUS_BENCH_PEAK_TFLOPS")
-    if env:
-        return float(env)
-    kind = getattr(device, "device_kind", "").lower()
-    for sub, peak in _PEAK_BF16_TFLOPS:
-        if sub in kind:
-            return peak
-    return 0.0  # unknown chip: MFU reported as 0 rather than a wrong number
-
-
-def model_flops_per_token(cfg, seq: int) -> float:
-    """Training FLOPs per token: 6 x matmul params + causal attention.
-
-    Per layer/token forward: 2x(wq + wk + wv + wo + ffn) matmul FLOPs;
-    attention scores QK^T + PV add 4*s*hq*d, halved by causality.  Training
-    = 3x forward (fwd + 2x backward).  Embedding lookup is a gather (no
-    FLOPs); the (tied or untied) head projection is a real matmul.
-
-    MoE configs (detected by ``n_experts``) count ACTIVE parameters — the
-    router projection plus top-k experts' SwiGLU per token, the standard
-    MoE MFU convention — so dispatch scatter/gather bookkeeping counts as
-    overhead, not useful work.
-    """
-    e, f, hq, hkv, d, l, v = (
-        cfg.hidden, cfg.intermediate, cfg.n_heads, cfg.n_kv_heads,
-        cfg.head_dim, cfg.n_layers, cfg.vocab_size,
-    )
-    if getattr(cfg, "n_experts", 0):
-        ffn = cfg.experts_per_token * 3 * e * f + e * cfg.n_experts
-    else:
-        ffn = 3 * e * f
-    matmul_params = l * (e * hq * d + 2 * e * hkv * d + hq * d * e + ffn) + e * v
-    attn = 2 * seq * hq * d * l  # causal: 4*s*hq*d / 2, per layer
-    return 3.0 * (2.0 * matmul_params + attn)
+# The FLOP model + peak table live in tpu_nexus.workload.goodput (ISSUE
+# 15 made them a library concern — the training harness computes live MFU
+# from the same estimator this bench reports, so the two can never use
+# different conventions).  Re-exported here for the historical import path.
+from tpu_nexus.workload.goodput import chip_peak_flops, model_flops_per_token  # noqa: E402
 
 
 def main() -> None:
@@ -176,7 +135,7 @@ def main() -> None:
     tokens_per_sec = batch * seq * steps / elapsed
     per_chip = tokens_per_sec / n_chips
 
-    peak = _chip_peak_tflops(jax.devices()[0]) * 1e12
+    peak = chip_peak_flops(jax.devices()[0])
     mfu = per_chip * model_flops_per_token(cfg, seq) / peak if peak else 0.0
 
     baseline = 0.0

@@ -974,6 +974,197 @@ def main_trace():
     print(json.dumps(result))
 
 
+def main_slo():
+    """``--slo``: the pressure plane's observation tax, measured (ISSUE
+    15; the --trace bench's r11 methodology).  The SAME mixed-length
+    request set through the engine with a per-step
+    ``load_snapshot()`` + ``SloMonitor.observe()`` and without, outputs
+    asserted token-identical (observation reads materialized host state
+    only — the structural half; the real-model identity matrices in
+    tests/test_loadstats.py are the behavioral half).
+
+    Per the PERF.md r11 lesson: the headline is the DETERMINISTIC
+    host-only snapshot+observe cost (numpy fake engine, measured FIRST in
+    the process before the jax heap exists) priced against each regime's
+    measured per-step duration; the interleaved model-engine pair ratios
+    ride as noise-bounded corroboration only.  Bar: ≤ 2% of tokens/s in
+    the worst regime.  Note the production cadence is one observation per
+    supervisor RECONCILE (~1/s), not per engine step — per-step here is
+    the conservative ceiling."""
+    from tpu_nexus.serving import FleetSnapshot, SloMonitor, SloTargets
+
+    rng = np.random.default_rng(SEED)
+    requests = make_requests(rng)
+    repeats = int(os.environ.get("NEXUS_SLO_BENCH_REPEATS", "5"))
+
+    def make_monitor():
+        # tight targets so the monitor actually grades (transitions fire)
+        # rather than idling down a never-violated fast path
+        return SloMonitor(
+            SloTargets(ttft_p99_s=1e-9, tpot_p99_s=1e-9,
+                       short_window=2, long_window=8)
+        )
+
+    def drain_observed(engine, monitor):
+        while engine.has_work:
+            engine.step()
+            if monitor is not None:
+                snap = engine.load_snapshot(replica="e")
+                monitor.observe(FleetSnapshot.aggregate({"e": snap}))
+
+    # host-only microbench FIRST (small heap — the r11 GC lesson): a
+    # deterministic numpy fake isolates the per-step snapshot+observe cost
+    class _HostFake:
+        def __init__(self, num_slots, max_len):
+            self.num_slots, self.max_len = num_slots, max_len
+
+        def begin(self, slot, prompt):
+            return int(prompt[-1]) + 1
+
+        def step(self, tokens, cursors):
+            return np.asarray(tokens) + 1
+
+    host = {}
+    host_requests = make_requests(np.random.default_rng(SEED))
+    for side in ("monitor_on", "monitor_off"):
+        engine = ServingEngine(_HostFake(NUM_SLOTS, MAX_LEN))
+        monitor = make_monitor() if side == "monitor_on" else None
+        for r in host_requests:  # warm the allocator paths
+            engine.submit(r["prompt"], min(r["gen"], 2))
+        drain_observed(engine, monitor)
+        t0 = time.perf_counter()
+        steps_before = engine.steps
+        for rep in range(3):
+            for i, r in enumerate(host_requests):
+                engine.submit(r["prompt"], r["gen"], request_id=f"h{rep}-{i}")
+            drain_observed(engine, monitor)
+        host[side] = {
+            "elapsed_s": round(time.perf_counter() - t0, 4),
+            "engine_steps": engine.steps - steps_before,
+        }
+    host_us_per_step = {
+        side: round(1e6 * v["elapsed_s"] / v["engine_steps"], 2)
+        for side, v in host.items()
+    }
+    monitor_cost_us = round(
+        host_us_per_step["monitor_on"] - host_us_per_step["monitor_off"], 2
+    )
+
+    regimes = {
+        "compute_bound": (bench_model(), "llama-bench-4L-h256"),
+        "dispatch_bound": (overlap_bench_model(), "llama-overlap-2L-h64"),
+    }
+    rows = {}
+    for regime, (cfg, model_name) in regimes.items():
+        params = llama_init(jax.random.PRNGKey(SEED), cfg)
+        engines = {
+            "monitor_on": _mode_engine(params, cfg, False, 1),
+            "monitor_off": _mode_engine(params, cfg, False, 1),
+        }
+        best = {}
+        outputs = {"monitor_on": {}, "monitor_off": {}}
+        pair_tps = {"monitor_on": [], "monitor_off": []}
+        monitors = {"monitor_on": make_monitor(), "monitor_off": None}
+        for rep in range(repeats):
+            # interleaved pass pairs (r11 methodology): back-to-back runs
+            # see the same box state, so per-pair ratios cancel the ±8%
+            # XLA-CPU drift a sequential A-then-B comparison bakes in
+            for side, engine in engines.items():
+                engine.metrics = ServingMetrics()
+                n_warm = len(engine.retired)
+                steps_before = engine.steps
+                t0 = time.perf_counter()
+                for i, r in enumerate(requests):
+                    engine.submit(r["prompt"], r["gen"], request_id=f"sl{rep}-{i}")
+                drain_observed(engine, monitors[side])
+                elapsed = time.perf_counter() - t0
+                done = engine.retired[n_warm:]
+                tokens = sum(
+                    len(r.output_tokens)
+                    for r in done
+                    if r.state == RequestState.FINISHED
+                )
+                outputs[side].update(
+                    (f"{rep}-{r.request_id}", list(r.output_tokens)) for r in done
+                )
+                pair_tps[side].append(tokens / elapsed if elapsed else 0.0)
+                run = (tokens, elapsed, engine.steps - steps_before)
+                if side not in best or tokens / elapsed > best[side][0] / best[side][1]:
+                    best[side] = run
+        assert outputs["monitor_on"] == outputs["monitor_off"], (
+            f"{regime}: the SLO monitor changed token streams"
+        )
+        pair_ratios = sorted(
+            on_tps / off_tps
+            for on_tps, off_tps in zip(pair_tps["monitor_on"], pair_tps["monitor_off"])
+            if off_tps
+        )
+        ratio = pair_ratios[len(pair_ratios) // 2] if pair_ratios else 0.0
+        off_best = best["monitor_off"]
+        step_us = 1e6 * off_best[1] / off_best[2] if off_best[2] else 0.0
+        rows[regime] = {
+            "model": model_name,
+            **{
+                side: {
+                    "tokens": tokens,
+                    "elapsed_s": round(elapsed, 4),
+                    "engine_steps": steps,
+                    "tokens_per_second": round(tokens / elapsed, 2) if elapsed else 0.0,
+                }
+                for side, (tokens, elapsed, steps) in best.items()
+            },
+            "step_us_monitor_off": round(step_us, 1),
+            "pair_ratios_on_vs_off": [round(r, 4) for r in pair_ratios],
+            "tokens_per_second_ratio_on_vs_off": round(ratio, 4),
+            "ratio_overhead_pct": round((1.0 - ratio) * 100.0, 2),
+            "bound_overhead_pct": (
+                round(100.0 * monitor_cost_us / step_us, 2) if step_us else 0.0
+            ),
+        }
+    worst = max(rows.values(), key=lambda r: r["bound_overhead_pct"])
+    result = {
+        "metric": "slo_monitor_overhead_tokens_per_second_pct",
+        "value": worst["bound_overhead_pct"],
+        "value_basis": (
+            "deterministic host-only snapshot+observe cost / measured "
+            "per-step duration, worst regime"
+        ),
+        "host_only_us_per_engine_step": {
+            **host_us_per_step,
+            "monitor_cost_us_per_step": monitor_cost_us,
+        },
+        "unit": "pct_tokens_per_second_lost_monitor_on_vs_off",
+        "target_pct": 2.0,
+        "regimes": rows,
+        "token_identical": True,  # asserted above, both regimes
+        "observation_cadence": "per engine step (conservative ceiling; production cadence is per supervisor reconcile)",
+        "workload": {
+            "requests": N_REQUESTS,
+            "slots": NUM_SLOTS,
+            "prompt_len_range": list(PROMPT_RANGE),
+            "gen_tokens_choices": list(GEN_CHOICES),
+            "best_of": repeats,
+            "interleaved": True,
+        },
+        "note": (
+            "monitor-on = ServingEngine.load_snapshot() + "
+            "SloMonitor.observe() after EVERY engine step with targets "
+            "tight enough that every observation violates (the grading "
+            "path, not the idle path); monitor-off = the plain loop.  The "
+            "claim rests on the deterministic host-only measurement per "
+            "the r11 tracer methodology; interleaved pair ratios are "
+            "noise-bounded corroboration only (±8%/pass XLA-CPU variance "
+            "on this box class)."
+        ),
+        "seed": SEED,
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_SERVING_SLO_OUT", "BENCH_SERVING_SLO_r12.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     rng = np.random.default_rng(SEED)
     cfg = bench_model()
@@ -1029,5 +1220,7 @@ if __name__ == "__main__":
         main_overlap()
     elif "--trace" in sys.argv[1:]:
         main_trace()
+    elif "--slo" in sys.argv[1:]:
+        main_slo()
     else:
         main()

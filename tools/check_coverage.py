@@ -33,11 +33,13 @@ EXCLUDE = [
 # critical modules are pinned here; absence fails the gate.
 REQUIRED = [
     "tpu_nexus/workload/durability.py",         # checkpoint commit/verify layer
+    "tpu_nexus/workload/goodput.py",            # wall-time buckets + MFU accounting
     "tpu_nexus/workload/health.py",             # sentinel + rollback-and-skip + watchdog
     "tpu_nexus/workload/tensor_checkpoint.py",
     "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates
+    "tpu_nexus/serving/loadstats.py",           # pressure plane: snapshots + SLO monitor
     "tpu_nexus/serving/overlap.py",             # deferred-dispatch ledgers
     "tpu_nexus/serving/recovery.py",
     "tpu_nexus/serving/sharded.py",             # tensor-parallel executors + shard-aware swaps

@@ -26,6 +26,7 @@ from tools.nxlint.engine import (
 from tools.nxlint import rules_control  # noqa: F401
 from tools.nxlint import rules_durability  # noqa: F401
 from tools.nxlint import rules_faults  # noqa: F401
+from tools.nxlint import rules_pressure  # noqa: F401
 from tools.nxlint import rules_serving  # noqa: F401
 from tools.nxlint import rules_telemetry  # noqa: F401
 from tools.nxlint import rules_tracing  # noqa: F401

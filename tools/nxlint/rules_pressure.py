@@ -1,0 +1,277 @@
+"""Pressure-plane rules (ISSUE 15): the SLO/load-snapshot contracts.
+
+NX016  pressure-taxonomy totality + snapshot/metric parity:
+
+       (a) every grading table in ``tpu_nexus/serving/loadstats.py``
+       (:data:`PRESSURE_TABLES`) must be TOTAL over ``PRESSURE_STATES`` —
+       the NX001 decision-taxonomy pattern: adding a pressure state
+       without declaring its severity rank and supervisor consequence is
+       a static-analysis error, not a midnight KeyError in the fleet
+       controller's reconcile;
+
+       (b) every NUMERIC field of ``LoadSnapshot`` / ``FleetSnapshot``
+       must have a matching ``core/telemetry.METRIC_NAMES`` row under the
+       ``load.`` / ``fleet.load.`` prefix — and every registry row under
+       those prefixes must still be a snapshot field (two-way, the NX015
+       shape).  Together with NX015 (registry row ⇔ literal emission)
+       this makes the three surfaces — dataclass, registry, gauges —
+       mutually un-driftable.
+
+       Fails closed when the module, the states tuple, a table, a
+       snapshot class, or the registry is missing/unparseable.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.rules_telemetry import (
+    REGISTRY_NAME,
+    TELEMETRY_PATH,
+    registered_metrics,
+)
+
+LOADSTATS_PATH = "tpu_nexus/serving/loadstats.py"
+STATES_NAME = "PRESSURE_STATES"
+
+#: the tables that must be total over PRESSURE_STATES.  A new table keyed
+#: by pressure grades should be added here (the repo-clean gate's review
+#: is the backstop, as with NX015's receiver set).
+PRESSURE_TABLES = ("PRESSURE_SEVERITY", "PRESSURE_ACTIONS")
+
+#: snapshot class -> metric-name prefix its numeric fields mirror into
+SNAPSHOT_PREFIXES = (
+    ("LoadSnapshot", "load."),
+    ("FleetSnapshot", "fleet.load."),
+)
+
+_NUMERIC_ANNOTATIONS = frozenset({"int", "float"})
+
+
+def _module_string_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments — how the pressure
+    states are spelled (the NX001 constant-class convention, flattened)."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _module_assignment(tree: ast.Module, name: str) -> Optional[ast.expr]:
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and any(
+            isinstance(t, ast.Name) and t.id == name for t in stmt.targets
+        ):
+            return stmt.value
+        if (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and stmt.target.id == name
+        ):
+            return stmt.value
+    return None
+
+
+def _resolve_key(node: ast.expr, constants: Dict[str, str]) -> Optional[str]:
+    """A states-tuple element or table key -> the state string it names:
+    a literal string, or a Name referring to a module string constant."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return constants.get(node.id)
+    return None
+
+
+def pressure_states(tree: ast.Module) -> Optional[Set[str]]:
+    """The declared pressure state space, or None when the tuple is
+    missing or any element fails to resolve (the rule fails closed)."""
+    constants = _module_string_constants(tree)
+    value = _module_assignment(tree, STATES_NAME)
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return None
+    states: Set[str] = set()
+    for element in value.elts:
+        resolved = _resolve_key(element, constants)
+        if resolved is None:
+            return None
+        states.add(resolved)
+    return states or None
+
+
+def table_keys(
+    tree: ast.Module, name: str
+) -> Optional[Tuple[Set[str], ast.expr]]:
+    """The resolved key set of one grading table (and its node for
+    findings); None when missing, not a dict literal, or a key fails to
+    resolve."""
+    constants = _module_string_constants(tree)
+    value = _module_assignment(tree, name)
+    if not isinstance(value, ast.Dict):
+        return None
+    keys: Set[str] = set()
+    for key in value.keys:
+        resolved = _resolve_key(key, constants) if key is not None else None
+        if resolved is None:
+            return None
+        keys.add(resolved)
+    return keys, value
+
+
+def numeric_snapshot_fields(
+    tree: ast.Module, class_name: str
+) -> Optional[Dict[str, ast.AST]]:
+    """field name -> declaring node for every ``int``/``float``-annotated
+    field of one snapshot dataclass; None when the class is missing."""
+    cls = next(
+        (
+            n
+            for n in tree.body
+            if isinstance(n, ast.ClassDef) and n.name == class_name
+        ),
+        None,
+    )
+    if cls is None:
+        return None
+    fields: Dict[str, ast.AST] = {}
+    for stmt in cls.body:
+        if (
+            isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+            and isinstance(stmt.annotation, ast.Name)
+            and stmt.annotation.id in _NUMERIC_ANNOTATIONS
+        ):
+            fields[stmt.target.id] = stmt
+    return fields
+
+
+@register
+class PressureContractRule(Rule):
+    """NX016 (module doc): taxonomy totality over PRESSURE_STATES plus
+    two-way snapshot-field / metric-registry parity."""
+
+    rule_id = "NX016"
+    description = (
+        "pressure tables total over PRESSURE_STATES; LoadSnapshot/"
+        "FleetSnapshot numeric fields <-> METRIC_NAMES load./fleet.load. "
+        "rows (two-way)"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        module = project.find_module(LOADSTATS_PATH)
+        if module is None:
+            return  # project doesn't contain the serving tree (tools subtree)
+        if module.tree is None:
+            yield self.finding(
+                module,
+                ast.Module(body=[], type_ignores=[]),
+                f"{LOADSTATS_PATH} unparseable — pressure contracts "
+                "unverifiable (rule fails closed)",
+            )
+            return
+        yield from self._check_totality(module)
+        yield from self._check_parity(project, module)
+
+    # -- (a) taxonomy totality -------------------------------------------------
+
+    def _check_totality(self, module: Module) -> Iterator[Finding]:
+        states = pressure_states(module.tree)
+        if states is None:
+            yield self.finding(
+                module,
+                module.tree,
+                f"{STATES_NAME} tuple of resolvable state constants not "
+                f"found in {module.rel_path} — pressure totality "
+                "unverifiable (rule fails closed; fix pressure_states or "
+                "restore the tuple)",
+            )
+            return
+        for table_name in PRESSURE_TABLES:
+            resolved = table_keys(module.tree, table_name)
+            if resolved is None:
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"grading table {table_name} missing from "
+                    f"{module.rel_path} (or not a dict literal with "
+                    "resolvable keys) — totality unverifiable (rule fails "
+                    "closed)",
+                )
+                continue
+            keys, node = resolved
+            for missing in sorted(states - keys):
+                yield self.finding(
+                    module,
+                    node,
+                    f"{table_name} missing pressure state '{missing}' — "
+                    "every state must declare its "
+                    f"{'severity rank' if table_name == 'PRESSURE_SEVERITY' else 'supervisor consequence'}",
+                )
+            for extra in sorted(keys - states):
+                yield self.finding(
+                    module,
+                    node,
+                    f"{table_name} declares unknown pressure state "
+                    f"'{extra}' — not a member of {STATES_NAME}",
+                )
+
+    # -- (b) snapshot/metric parity --------------------------------------------
+
+    def _check_parity(
+        self, project: Project, module: Module
+    ) -> Iterator[Finding]:
+        registry_module = project.find_module(TELEMETRY_PATH)
+        if registry_module is None or registry_module.tree is None:
+            return  # NX015 already owns the missing-registry finding
+        registry = registered_metrics(registry_module.tree)
+        if registry is None:
+            return  # ditto — one finding per broken registry is enough
+        # longest prefix first, so a fleet.load.* row never misclassifies
+        # under a shorter overlapping prefix
+        prefixes: List[Tuple[str, str]] = sorted(
+            SNAPSHOT_PREFIXES, key=lambda pair: -len(pair[1])
+        )
+        claimed: Set[str] = set()
+        for class_name, prefix in prefixes:
+            fields = numeric_snapshot_fields(module.tree, class_name)
+            if fields is None:
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"snapshot class {class_name} not found in "
+                    f"{module.rel_path} — snapshot/metric parity "
+                    "unverifiable (rule fails closed)",
+                )
+                continue
+            for name, node in sorted(fields.items()):
+                row = prefix + name
+                claimed.add(row)
+                if row not in registry:
+                    yield self.finding(
+                        module,
+                        node,
+                        f"{class_name}.{name} has no '{row}' row in "
+                        f"{REGISTRY_NAME} ({TELEMETRY_PATH}) — every "
+                        "numeric snapshot field must be chartable (add "
+                        "the row + its literal gauge, and regenerate the "
+                        "docs table)",
+                    )
+            for row in sorted(registry):
+                if not row.startswith(prefix) or row in claimed:
+                    continue
+                claimed.add(row)
+                yield self.finding(
+                    registry_module,
+                    registry[row],
+                    f"{REGISTRY_NAME} documents '{row}' but {class_name} "
+                    f"has no numeric field '{row[len(prefix):]}' — remove "
+                    "the row or restore the field",
+                )

@@ -455,6 +455,12 @@ SHARDED_PATH = "serving/sharded.py"
 #: one in the engine itself — the layer's contract is that it records
 #: host ints the engine already owned, never device values
 TRACING_PATH = "serving/tracing.py"
+#: the pressure plane (ISSUE 15) shares the tracing layer's contract:
+#: LoadSnapshot/SloMonitor consume materialized host state only — a
+#: readback there would serialize every snapshot/observe call against the
+#: device, exactly the perturbation the monitor-on/off identity tests
+#: exist to rule out
+LOADSTATS_PATH = "serving/loadstats.py"
 ENGINE_CLASS = "ServingEngine"
 
 #: the sanctioned deferred-materialize seam: functions whose name carries
@@ -502,7 +508,10 @@ class DispatchLoopReadbackRule(Rule):
     device handles and must treat them as opaque) plus all of
     serving/sharded.py (ISSUE 13: the shard-aware swap path must land
     weights per-shard — a readback there is a host GATHER of sharded
-    params mid-rollout); the seam is any function named
+    params mid-rollout) plus all of serving/tracing.py and
+    serving/loadstats.py (ISSUES 14/15: the observability and pressure
+    layers record host state the engine already owned, never device
+    values); the seam is any function named
     ``_materialize*``.  The executors' synchronous entry points
     (``step``/``begin``/``verify``) are deliberately OUT of scope: they
     ARE the blocking oracle path the parity tests pin everything
@@ -521,6 +530,7 @@ class DispatchLoopReadbackRule(Rule):
             module.rel_path.endswith(OVERLAP_PATH)
             or module.rel_path.endswith(SHARDED_PATH)
             or module.rel_path.endswith(TRACING_PATH)
+            or module.rel_path.endswith(LOADSTATS_PATH)
         ):
             yield from self._scan(module, module.tree.body)
             return

@@ -315,6 +315,40 @@ METRIC_NAMES: Dict[str, tuple] = {
     "fleet_escalations": ("count", "incidents escalated to an operator (recreate refused), tagged action:"),
     "fleet_recreates": ("count", "serving pods recreated by the controller, tagged action:"),
     "fleet_watchdog_recreates": ("count", "pods recreated by the missing-pod absence sweep"),
+    # -- pressure plane (tpu_nexus/serving/loadstats.py, ISSUE 15) -------------
+    # load.<field> rows mirror LoadSnapshot's numeric fields 1:1 and
+    # fleet.load.<field> rows FleetSnapshot's — nxlint NX016 enforces the
+    # two-way parity, so neither side can drift from the other
+    "load.queue_depth": ("gauge", "per-replica queued (not yet slotted) requests, tagged replica:"),
+    "load.live_requests": ("gauge", "per-replica in-flight (slot-holding) requests, tagged replica:"),
+    "load.slots_used": ("gauge", "per-replica busy KV slots, tagged replica:"),
+    "load.slots_free": ("gauge", "per-replica free KV slots, tagged replica:"),
+    "load.deferred_slots": ("gauge", "per-replica lanes with unmaterialized dispatches, tagged replica:"),
+    "load.token_occupancy": ("gauge", "per-replica live cache tokens / capacity, tagged replica:"),
+    "load.blocks_used": ("gauge", "per-replica paged KV blocks in use (0 = contiguous), tagged replica:"),
+    "load.blocks_free": ("gauge", "per-replica paged KV blocks free (0 = contiguous), tagged replica:"),
+    "load.blocks_reclaimable": ("gauge", "per-replica evictable cached-prefix blocks (sampled trie walk), tagged replica:"),
+    "load.weight_swaps": ("gauge", "per-replica completed hot weight swaps, tagged replica:"),
+    "load.shed_total": ("gauge", "per-replica admission sheds since boot, tagged replica:"),
+    "load.requests_retired": ("gauge", "per-replica total retirements since boot, tagged replica:"),
+    "load.tokens_out": ("gauge", "per-replica tokens emitted since boot, tagged replica:"),
+    "load.engine_steps": ("gauge", "per-replica engine iterations since boot, tagged replica:"),
+    "load.ttft_p50_s": ("gauge", "per-replica recent-window TTFT p50, tagged replica:"),
+    "load.ttft_p99_s": ("gauge", "per-replica recent-window TTFT p99 (SLO-graded), tagged replica:"),
+    "load.tpot_p50_s": ("gauge", "per-replica recent-window TPOT p50, tagged replica:"),
+    "load.tpot_p99_s": ("gauge", "per-replica recent-window TPOT p99 (SLO-graded), tagged replica:"),
+    "load.queue_wait_p50_s": ("gauge", "per-replica recent-window queue-wait p50, tagged replica:"),
+    "load.queue_wait_p99_s": ("gauge", "per-replica recent-window queue-wait p99, tagged replica:"),
+    "fleet.load.replicas_total": ("gauge", "replicas the fleet knows (live + down)"),
+    "fleet.load.replicas_serving": ("gauge", "replicas accepting traffic"),
+    "fleet.load.replicas_reloading": ("gauge", "replicas paused for a weight swap"),
+    "fleet.load.replicas_down": ("gauge", "replicas down (reported, never dropped)"),
+    "fleet.load.queue_depth": ("gauge", "queued requests summed over live replicas"),
+    "fleet.load.live_requests": ("gauge", "in-flight requests summed over live replicas"),
+    "fleet.load.shed_total": ("gauge", "admission sheds summed over live replicas"),
+    "fleet.load.tokens_out": ("gauge", "tokens emitted summed over live replicas"),
+    "fleet.pressure_level": ("gauge", "pressure severity (0 healthy .. 3 down), tagged scope: (replica name or 'fleet')"),
+    "fleet.pressure_transitions": ("count", "pressure-grade transitions, tagged scope:/from:/to:"),
     # -- training (tpu_nexus/workload/harness.py, health.py) -------------------
     "train.loss": ("gauge", "heartbeat-step training loss"),
     "train.grad_norm": ("gauge", "heartbeat-step gradient norm"),
@@ -324,6 +358,10 @@ METRIC_NAMES: Dict[str, tuple] = {
     "train.ckpt_rollback": ("count", "restore-time rollbacks past unverifiable checkpoints, tagged cause:"),
     "train.emergency_save": ("count", "preemption emergency saves attempted, tagged skipped:"),
     "train.emergency_save_failed": ("count", "emergency saves that failed inside the grace budget"),
+    # -- training goodput (tpu_nexus/workload/goodput.py, ISSUE 15) ------------
+    "train.goodput": ("gauge", "productive-step fraction of wall time (step dispatch / elapsed)"),
+    "train.tokens_per_second": ("gauge", "training tokens consumed per wall-clock second"),
+    "train.mfu": ("gauge", "model-FLOPs utilization (0..1; 0 when the device peak is unknown)"),
 }
 
 

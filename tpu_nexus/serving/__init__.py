@@ -17,7 +17,13 @@ Layering (each module imports only downward):
 * ``scheduler``      — FIFO admission, prefill-token budget, starvation
                        guard, bounded queue, deadline sweep, block gate
 * ``metrics``        — TTFT/TPOT/queue-depth/occupancy/shed/fault counters
-                       + token-occupancy / prefix-hit / COW telemetry
+                       + token-occupancy / prefix-hit / COW telemetry,
+                       bounded rolling-quantile windows
+* ``loadstats``      — the pressure plane (ISSUE 15): LoadSnapshot /
+                       FleetSnapshot plain-host-state dataclasses, the
+                       total HEALTHY/PRESSURED/SATURATED/DOWN pressure
+                       taxonomy, and the windowed burn-rate SloMonitor
+                       the fleet controller consumes per reconcile
 * ``speculative``    — drafting subsystem (ISSUE 11): Drafter interface,
                        prompt-lookup ngram + draft-model drafters, the
                        verify-k acceptance oracle (greedy token-identity)
@@ -73,7 +79,23 @@ from tpu_nexus.serving.fleet import (
     FleetSupervisor,
     ServingFleet,
 )
-from tpu_nexus.serving.metrics import ServingMetrics, percentile
+from tpu_nexus.serving.loadstats import (
+    PRESSURE_ACTIONS,
+    PRESSURE_DOWN,
+    PRESSURE_HEALTHY,
+    PRESSURE_PRESSURED,
+    PRESSURE_SATURATED,
+    PRESSURE_SEVERITY,
+    PRESSURE_STATES,
+    FleetSnapshot,
+    LoadSnapshot,
+    SloMonitor,
+    SloTargets,
+    emit_fleet_snapshot,
+    emit_load_snapshot,
+    worst_pressure,
+)
+from tpu_nexus.serving.metrics import RollingQuantile, ServingMetrics, percentile
 from tpu_nexus.serving.sharded import (
     SERVING_PARAM_RULES,
     ShardedModelExecutor,
@@ -126,8 +148,10 @@ __all__ = [
     "EngineTracer",
     "FifoScheduler",
     "FleetError",
+    "FleetSnapshot",
     "FleetSupervisor",
     "FlightRecorder",
+    "LoadSnapshot",
     "IllegalTransition",
     "KVBlockManager",
     "KVSlotManager",
@@ -135,6 +159,13 @@ __all__ = [
     "ModelExecutor",
     "NGramDrafter",
     "NullTracer",
+    "PRESSURE_ACTIONS",
+    "PRESSURE_DOWN",
+    "PRESSURE_HEALTHY",
+    "PRESSURE_PRESSURED",
+    "PRESSURE_SATURATED",
+    "PRESSURE_SEVERITY",
+    "PRESSURE_STATES",
     "PagedCacheManager",
     "PagedModelExecutor",
     "PendingStep",
@@ -143,6 +174,7 @@ __all__ = [
     "QueueFull",
     "RETIREMENT_ACTIONS",
     "Request",
+    "RollingQuantile",
     "RequestState",
     "RequestTrace",
     "SCRATCH_BLOCK",
@@ -154,6 +186,8 @@ __all__ = [
     "ShardedModelExecutor",
     "ShardedPagedModelExecutor",
     "ShardingError",
+    "SloMonitor",
+    "SloTargets",
     "SlotError",
     "StepFault",
     "StepFaultPolicy",
@@ -161,10 +195,13 @@ __all__ = [
     "TRANSITIONS",
     "accept_tokens",
     "build_serve_mesh",
+    "emit_fleet_snapshot",
+    "emit_load_snapshot",
     "init_cache",
     "init_paged_cache",
     "parse_serve_mesh",
     "percentile",
+    "worst_pressure",
     "serving_param_shardings",
     "shard_serving_params",
     "validate_serve_mesh",

@@ -74,6 +74,7 @@ from tpu_nexus.serving.cache_manager import (
     init_cache,
     init_paged_cache,
 )
+from tpu_nexus.serving.loadstats import LoadSnapshot
 from tpu_nexus.serving.metrics import ServingMetrics
 from tpu_nexus.serving.overlap import DispatchPipeline, PendingStep
 from tpu_nexus.serving.recovery import DeviceStateLost, StepFault, StepFaultPolicy
@@ -984,8 +985,13 @@ class ServingEngine:
         self._step_retry_marks = 0
         #: flight-recorder sampling cadence for the paged pool's
         #: reclaimable count — a full prefix-trie walk, priced every Nth
-        #: step instead of on the per-step hot path
+        #: step instead of on the per-step hot path.  load_snapshot()
+        #: reads the SAMPLED value through the same cadence (never a
+        #: per-snapshot walk): self._blocks_reclaimable holds the latest
+        #: sample, _reclaimable_sampled_at the step it was taken
         self._reclaimable_sample_every = 16
+        self._blocks_reclaimable = 0
+        self._reclaimable_sampled_at = -1
         #: retirement log in order — what the bench and tests audit;
         #: trimmed from the FRONT past ``retired_log_limit`` so a serving
         #: process that never restarts cannot grow it without bound
@@ -1268,8 +1274,8 @@ class ServingEngine:
             # between samples, not zero (nxtrace renders it as a stepped
             # counter either way).
             if self.steps % self._reclaimable_sample_every == 0:
-                record["blocks_reclaimable"] = self.paged.index.reclaimable(
-                    self.paged.manager
+                record["blocks_reclaimable"] = self._sample_reclaimable(
+                    force=True
                 )
         if self._step_fault_marks:
             record["faults"] = list(self._step_fault_marks)
@@ -1723,6 +1729,79 @@ class ServingEngine:
             "drain_evicted": evicted,
             "drain_shed_queue": shed_queue,
         }
+
+    # -- load snapshot: the pressure plane's input (ISSUE 15) ------------------
+
+    def _sample_reclaimable(self, force: bool = False) -> int:
+        """The paged pool's reclaimable-block count, SAMPLED: the full
+        prefix-trie walk runs at most once per ``_reclaimable_sample_every``
+        engine steps (``force`` re-walks now — the flight recorder's
+        cadence slot), and both the recorder and :meth:`load_snapshot`
+        read the cached sample in between.  0 on a non-paged engine."""
+        if self.paged is None:
+            return 0
+        if force or (
+            self.steps - self._reclaimable_sampled_at
+            >= self._reclaimable_sample_every
+        ):
+            self._blocks_reclaimable = self.paged.index.reclaimable(
+                self.paged.manager
+            )
+            self._reclaimable_sampled_at = self.steps
+        return self._blocks_reclaimable
+
+    def load_snapshot(self, replica: str = "") -> LoadSnapshot:
+        """This engine's load state as plain host ints/floats — the
+        pressure plane's per-replica signal (serving/loadstats.py,
+        docs/OBSERVABILITY.md).  NX014-clean by the flight recorder's
+        materialized-state discipline: every field is host state the
+        engine already owned (scheduler counts, slot/block books, metric
+        counters, windowed percentiles) — taking a snapshot performs no
+        device readback and cannot perturb the token stream.  Percentiles
+        are the RECENT window (``ServingMetrics.slo_window``), the
+        reclaimable-block count the sampled one (never a fresh full-trie
+        walk per snapshot).  ``replica`` names the snapshot at
+        construction — the per-step observation path would otherwise pay
+        a full frozen-dataclass rebuild (``dataclasses.replace``) just to
+        stamp the name."""
+        if self.paged is not None:
+            blocks_used = self.paged.used_blocks
+            blocks_free = self.paged.manager.free_count
+            reclaimable = self._sample_reclaimable()
+        else:
+            blocks_used = blocks_free = reclaimable = 0
+        return LoadSnapshot(
+            replica=replica,
+            queue_depth=self.scheduler.pending,
+            live_requests=len(self._active),
+            slots_used=self.slots.used_count,
+            slots_free=self.slots.free_count,
+            deferred_slots=self._pipeline.deferred_slots,
+            token_occupancy=self.metrics.token_occupancy,
+            blocks_used=blocks_used,
+            blocks_free=blocks_free,
+            blocks_reclaimable=reclaimable,
+            weight_swaps=self.weight_swaps,
+            shed_total=self.metrics.shed_total,
+            requests_retired=self.retired_total,
+            tokens_out=self.metrics.tokens_out,
+            engine_steps=self.steps,
+            **self.metrics.slo_window(),
+        )
+
+    def dump_pressure(self, reason: str) -> Optional[Dict[str, Any]]:
+        """SLO-saturation incident seam (ISSUE 15): serialize the flight
+        recorder + every LIVE request's timeline when the pressure monitor
+        grades this replica SATURATED — a saturation incident gets the
+        same drill-down a fault does (what was queued, how long requests
+        waited, where the dispatch time went).  Returns the new artifact's
+        inventory entry, or None when tracing is off / the dump budget is
+        spent / an earlier artifact would be passed off as this incident's
+        (the fleet's kill_replica identity rule)."""
+        before = self.last_incident_dump
+        self._dump_incident("saturation", reason, list(self.requests.values()))
+        after = self.last_incident_dump
+        return after if after is not before else None
 
     # -- rolling weight updates (ISSUE 9) --------------------------------------
 

@@ -62,6 +62,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_nexus.serving.engine import CAUSE_RELOAD_GRACE, ServingEngine
+from tpu_nexus.serving.loadstats import (
+    FleetSnapshot,
+    LoadSnapshot,
+    SloMonitor,
+    emit_fleet_snapshot,
+)
 from tpu_nexus.serving.request import Request
 from tpu_nexus.serving.scheduler import QueueFull
 from tpu_nexus.workload.durability import CheckpointError, VerifiedStepPoller
@@ -467,6 +473,27 @@ class ServingFleet:
             out.extend(rep.all_retired())
         return out
 
+    def snapshot(self) -> FleetSnapshot:
+        """The fleet's machine-readable load state (ISSUE 15,
+        serving/loadstats.py): one :class:`LoadSnapshot` per replica —
+        live replicas report their engine's materialized host state
+        (``ServingEngine.load_snapshot``, NX014-clean), RELOADING ones
+        included with their true lifecycle state, and DOWN replicas
+        REPORTED as down with their cause, never silently dropped — plus
+        the fleet aggregates.  This is what the SLO monitor grades and
+        what ``summary()``/the controller's ledger details embed."""
+        import dataclasses as _dc
+
+        replicas: Dict[str, LoadSnapshot] = {}
+        for name, rep in self.replicas.items():
+            if rep.state == REPLICA_DOWN:
+                replicas[name] = LoadSnapshot.down(name, cause=rep.down_cause)
+            else:
+                replicas[name] = _dc.replace(
+                    rep.engine.load_snapshot(replica=name), state=rep.state
+                )
+        return FleetSnapshot.aggregate(replicas)
+
     def summary(self) -> Dict[str, Any]:
         states: Dict[str, int] = {}
         causes: Dict[str, int] = {}
@@ -484,6 +511,11 @@ class ServingFleet:
             "retired_causes": causes,
             "rollouts_completed": self.rollouts_completed,
             "rollout_error": self.rollout_error,
+            # per-replica liveness + load folded in (ISSUE 15 satellite):
+            # the summary used to expose incident history with no view of
+            # what the fleet is DOING — the snapshot is that view, and the
+            # serve/controller ledger details inherit it wholesale
+            "load": self.snapshot().to_dict(),
         }
 
 
@@ -528,6 +560,7 @@ class FleetSupervisor:
         resync_period: Optional[timedelta] = None,
         logger_: Optional[Any] = None,
         metrics: Optional[Any] = None,
+        slo: Optional[SloMonitor] = None,
     ) -> None:
         from tpu_nexus.core.telemetry import NullMetrics, get_logger
         from tpu_nexus.k8s.informer import SharedInformerFactory
@@ -571,10 +604,20 @@ class FleetSupervisor:
         #: (step, poller scan count) of a shunned rollout candidate — see
         #: :meth:`_check_rollout`
         self._shunned: Optional[Tuple[int, int]] = None
+        #: the pressure plane (ISSUE 15): graded per reconcile when a
+        #: monitor is configured; transitions land on the ledger row +
+        #: tagged metrics, SATURATED dumps the replica's flight recorder
+        self.slo = slo
         # observability (tests + dashboards)
         self.recreated = 0
         self.escalated = 0
         self.incidents: List[Dict[str, Any]] = []
+        #: bounded transition log (front-trimmed past
+        #: _pressure_events_limit, the SloMonitor.transitions discipline):
+        #: a replica flapping around its SLO target transitions for the
+        #: supervisor's whole lifetime and must not grow this unboundedly
+        self.pressure_events: List[Dict[str, Any]] = []
+        self._pressure_events_limit = 1024
 
     # -- k8s handlers (sync, informer-dispatched) ------------------------------
 
@@ -694,6 +737,7 @@ class FleetSupervisor:
         await self._sweep_missing_pods(now)
         self._check_rollout(now)
         self.fleet.tick()
+        await self._observe_pressure()
 
     async def _sweep_missing_pods(self, now: float) -> None:
         """Absence-driven backstop (the ledger watchdog's discipline): a
@@ -766,6 +810,104 @@ class FleetSupervisor:
         self.fleet.start_rollout(
             self.source, step, self.grace_s, transform=self.transform
         )
+
+    # -- the pressure plane (ISSUE 15) -----------------------------------------
+
+    async def _observe_pressure(self) -> None:
+        """One pressure observation per reconcile (module doc): snapshot
+        the fleet, emit the tagged load gauges, grade through the SLO
+        monitor, and dispatch each transition through the TOTAL
+        ``PRESSURE_ACTIONS`` table — every transition is recorded
+        (cause+details JSON on the fleet's RUNNING ledger row, the
+        ``fleet.pressure_transitions`` metric, ``pressure_events``), and
+        a replica entering SATURATED additionally dumps its flight
+        recorder at the saturation incident seam so the episode gets the
+        same drill-down a fault does."""
+        if self.slo is None:
+            return
+        snapshot = self.fleet.snapshot()
+        emit_fleet_snapshot(self._metrics, snapshot)
+        for transition in self.slo.observe(snapshot):
+            # the monitor already stamped PRESSURE_ACTIONS[to] on the
+            # record — one place the consequence semantics live
+            record = dict(transition)
+            if (
+                "dump" in record["action"]
+                and transition["scope"] in self.fleet.replicas
+            ):
+                rep = self.fleet.replicas[transition["scope"]]
+                if rep.state != REPLICA_DOWN:
+                    dump = rep.engine.dump_pressure(
+                        f"slo-{transition['to']}:{transition['scope']}"
+                    )
+                    if dump is not None:
+                        record["flight_recorder"] = dump
+            self.pressure_events.append(record)
+            if len(self.pressure_events) > self._pressure_events_limit:
+                del self.pressure_events[
+                    : len(self.pressure_events) - self._pressure_events_limit
+                ]
+            self._log.warning(
+                "fleet pressure transition",
+                scope=transition["scope"],
+                from_=transition["from"],
+                to=transition["to"],
+            )
+            await self._record_pressure(record, snapshot)
+
+    async def _record_pressure(
+        self, record: Dict[str, Any], snapshot: FleetSnapshot
+    ) -> None:
+        """Pressure transitions on the ledger (the _record_cause
+        discipline): the fleet row stays RUNNING — pressure is a
+        condition, not a death — but cause/details name the transition
+        and embed the graded snapshot, so an operator reading the row
+        sees WHAT the fleet looked like when it crossed the line.
+
+        Pressure shares the cause/details columns with fault incidents
+        (``_record_cause``) and each write replaces the last, so the
+        details carry the RECENT INCIDENTS alongside the transition —
+        a pod-loss record overwritten one reconcile later by the
+        resulting HEALTHY -> PRESSURED note must not vanish from the
+        row (the PR 12 inventory-merge discipline)."""
+        if self._store is None:
+            return
+        import asyncio
+
+        cause = (
+            f"fleet pressure: {record['scope']} "
+            f"{record['from']} -> {record['to']}"
+        )
+        details = json.dumps(
+            {
+                "pressure": record,
+                "grades": dict(self.slo.grades) if self.slo is not None else {},
+                "fleet": snapshot.to_dict(),
+                **(
+                    {"incidents": self.incidents[-3:]}
+                    if self.incidents
+                    else {}
+                ),
+            },
+            sort_keys=True,
+            default=str,
+        )
+
+        def _write():
+            cp = self._store.read_checkpoint(self.algorithm, self.jobset_name)
+            if cp is None or cp.is_finished():
+                return
+            self._store.update_fields(
+                self.algorithm,
+                self.jobset_name,
+                {
+                    "algorithm_failure_cause": cause,
+                    "algorithm_failure_details": details,
+                    "last_modified": datetime.now(timezone.utc),
+                },
+            )
+
+        await asyncio.to_thread(_write)
 
     # -- recovery execution ----------------------------------------------------
 

@@ -14,12 +14,27 @@ Definitions (the usual LLM-serving SLOs):
   first (decode cadence; what a streaming reader perceives);
 * **queue depth** — requests waiting for a slot, sampled per step;
 * **slot occupancy** — busy slots / total slots, sampled per step.
+
+Window semantics (ISSUE 15): every latency sample series is a BOUNDED
+:class:`RollingQuantile` — a serving process that never restarts must not
+grow per-request lists for its lifetime (``dispatch_s`` got this in PR 12;
+TTFT/TPOT/queue-wait get it here).  ``summary()`` percentiles are computed
+over the retained window: EXACT whole-run percentiles for any run shorter
+than :data:`ServingMetrics.WINDOW` samples (every bench and test in this
+repo), trailing-window percentiles beyond it — the honest semantics for a
+long-lived server, where "p99 of everything since boot" is a statistic
+nobody wants anyway (the statsd histogram stream remains the unbounded
+production view).  ``load_snapshot()``-facing percentiles
+(:meth:`ServingMetrics.slo_window`) read a SHORTER recent window
+(:data:`ServingMetrics.SNAPSHOT_WINDOW` samples) so the pressure monitor
+sees current behavior, not the boot-time tail.
 """
 
 from __future__ import annotations
 
 from collections import deque
-from typing import Deque, Dict, List, Optional, Sequence
+from itertools import islice
+from typing import Deque, Dict, Iterator, Optional, Sequence
 
 from tpu_nexus.core.telemetry import Metrics, NullMetrics
 from tpu_nexus.serving.request import Request
@@ -35,14 +50,101 @@ def percentile(values: Sequence[float], q: float) -> float:
     return ordered[rank]
 
 
+class RollingQuantile:
+    """Bounded rolling sample window with nearest-rank quantiles.
+
+    The primitive behind the windowed SLO views (ISSUE 15): appends are
+    O(1) into a ``deque(maxlen=window)`` (the hot-path cost — quantiles
+    sort lazily, only when somebody asks), ``total`` counts every sample
+    ever recorded (including ones the window has since dropped), and
+    :meth:`quantile` reads either the whole retained window or just the
+    most recent ``recent`` samples (the load-snapshot view).
+
+    List-compatible on the surfaces the existing callers touch —
+    ``append`` / ``len`` / iteration / indexing / ``== list`` — so the
+    ServingMetrics fields could switch from unbounded lists without
+    rewriting every test that inspects them."""
+
+    __slots__ = ("window", "total", "_samples")
+
+    def __init__(self, window: int = 8192) -> None:
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = window
+        #: samples ever recorded (survives the window trim — the honest
+        #: denominator for rates)
+        self.total = 0
+        self._samples: Deque[float] = deque(maxlen=window)
+
+    def append(self, value: float) -> None:
+        self._samples.append(float(value))
+        self.total += 1
+
+    def quantile(self, q: float, recent: Optional[int] = None) -> float:
+        """Nearest-rank percentile (q in [0, 100]) over the retained
+        window, or over the most recent ``recent`` samples of it."""
+        return self.quantiles((q,), recent=recent)[0]
+
+    def quantiles(
+        self, qs: Sequence[float], recent: Optional[int] = None
+    ) -> "list[float]":
+        """Several nearest-rank percentiles off ONE sort of the window —
+        the snapshot path asks for p50+p99 of each series per observation,
+        and sorting twice for two ranks of the same sample would double
+        the pressure plane's hot-path cost for nothing."""
+        if recent is None or recent >= len(self._samples):
+            tail = sorted(self._samples)
+        elif recent < 1:
+            tail = []
+        else:
+            tail = sorted(
+                islice(self._samples, len(self._samples) - recent, None)
+            )
+        if not tail:
+            return [0.0 for _ in qs]
+        top = len(tail) - 1
+        return [
+            tail[min(top, max(0, int(round(q / 100.0 * top))))] for q in qs
+        ]
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def __bool__(self) -> bool:
+        return bool(self._samples)
+
+    def __iter__(self) -> Iterator[float]:
+        return iter(self._samples)
+
+    def __getitem__(self, idx: int) -> float:
+        return self._samples[idx]
+
+    def __eq__(self, other: object) -> bool:
+        # list(self) delegates element comparison to the other side —
+        # pytest.approx(list) keeps working against a rolling window
+        return list(self._samples) == other
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"RollingQuantile({list(self._samples)!r}, window={self.window})"
+
+
 class ServingMetrics:
     """Per-engine metrics recorder + telemetry emitter (see module doc)."""
 
+    #: retained samples per latency series (module-doc window semantics):
+    #: summary() percentiles are exact up to this many samples, trailing-
+    #: window beyond it
+    WINDOW = 8192
+    #: the load-snapshot view (ServingEngine.load_snapshot / slo_window):
+    #: percentiles over only this many most-recent samples, so the
+    #: pressure monitor grades CURRENT behavior, not the since-boot tail
+    SNAPSHOT_WINDOW = 512
+
     def __init__(self, metrics: Optional[Metrics] = None) -> None:
         self._m = metrics or NullMetrics()
-        self.ttft_s: List[float] = []
-        self.tpot_s: List[float] = []
-        self.queue_wait_s: List[float] = []
+        self.ttft_s = RollingQuantile(self.WINDOW)
+        self.tpot_s = RollingQuantile(self.WINDOW)
+        self.queue_wait_s = RollingQuantile(self.WINDOW)
         self.retired: Dict[str, int] = {}
         #: per-CAUSE retirement counts for non-FINISHED outcomes (keys are
         #: the recorded ``Request.cause`` strings: "hbm-oom", "deadline
@@ -92,7 +194,9 @@ class ServingMetrics:
         #: an unbounded list would grow for the life of a serving process
         #: (the statsd histogram stream is the unbounded production view;
         #: summary() percentiles read the recent window)
-        self.dispatch_s: Deque[float] = deque(maxlen=4096)
+        self.dispatch_s = RollingQuantile(4096)
+        #: (series totals, window dict) — slo_window()'s memo; see its doc
+        self._slo_window_cache: Optional[tuple] = None
 
     def queue_wait(self, seconds: float) -> None:
         """Submit → admission (slot granted), the scheduler-owned slice of
@@ -251,6 +355,38 @@ class ServingMetrics:
             # block-granular cache gives back
             self.token_occupancy = live_tokens / token_capacity
             self._m.gauge("serving.token_occupancy", self.token_occupancy)
+
+    def slo_window(self) -> Dict[str, float]:
+        """The load-snapshot latency view (ISSUE 15): TTFT / TPOT /
+        queue-wait p50/p99 over the most recent :data:`SNAPSHOT_WINDOW`
+        samples of each series — what :meth:`ServingEngine.load_snapshot`
+        embeds and the SLO monitor grades.  Distinct from ``summary()``'s
+        whole-window percentiles by design: pressure is a statement about
+        NOW, and a since-boot p99 buries a regression under history.
+
+        Memoized on the series sample counts: an engine step that retired
+        nothing recorded no new latency samples, so the previous window is
+        still THE window — decode steady state pays a tuple compare here,
+        not three sorts (the bench prices the worst case, a fresh sample
+        before every observation)."""
+        key = (self.ttft_s.total, self.tpot_s.total, self.queue_wait_s.total)
+        cached = self._slo_window_cache
+        if cached is not None and cached[0] == key:
+            return dict(cached[1])
+        w = self.SNAPSHOT_WINDOW
+        ttft_p50, ttft_p99 = self.ttft_s.quantiles((50, 99), recent=w)
+        tpot_p50, tpot_p99 = self.tpot_s.quantiles((50, 99), recent=w)
+        qw_p50, qw_p99 = self.queue_wait_s.quantiles((50, 99), recent=w)
+        out = {
+            "ttft_p50_s": ttft_p50,
+            "ttft_p99_s": ttft_p99,
+            "tpot_p50_s": tpot_p50,
+            "tpot_p99_s": tpot_p99,
+            "queue_wait_p50_s": qw_p50,
+            "queue_wait_p99_s": qw_p99,
+        }
+        self._slo_window_cache = (key, out)
+        return dict(out)
 
     def summary(self) -> Dict[str, float]:
         return {

@@ -58,6 +58,17 @@ from tpu_nexus.workload.faults import (
     maybe_inject,
     wrap_data_stream,
 )
+from tpu_nexus.workload.goodput import (
+    BUCKET_CKPT,
+    BUCKET_DATA,
+    BUCKET_EMERGENCY,
+    BUCKET_EVAL,
+    BUCKET_INIT,
+    BUCKET_OTHER,
+    BUCKET_RECOVERY,
+    BUCKET_STEP,
+    build_meter,
+)
 from tpu_nexus.workload.health import (
     CAUSE_NUMERIC_NAN,
     CAUSE_STEP_HANG,
@@ -132,6 +143,13 @@ class WorkloadConfig:
     #: numerical-health sentinel + step-hang watchdog knobs
     #: (workload/health.py; NEXUS_HEALTH*/NEXUS_STEP_TIMEOUT_S env contract)
     health: HealthConfig = field(default_factory=HealthConfig)
+    #: training goodput/MFU accounting (ISSUE 15, workload/goodput.py):
+    #: wall-time buckets + productive-step fraction + tokens/s + MFU,
+    #: emitted as heartbeat gauges, folded into the terminal ledger
+    #: details (COMPLETED/PREEMPTED), and printed as a table in the run
+    #: summary.  Host-side clocks only — loss is bit-identical on vs off
+    #: (gated by tests).  NEXUS_GOODPUT=0 opts out.
+    goodput: bool = True
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "WorkloadConfig":
@@ -177,6 +195,7 @@ class WorkloadConfig:
             eval_steps=int(e.get("NEXUS_EVAL_STEPS", "4")),
             emergency_grace_s=float(e.get("NEXUS_EMERGENCY_GRACE_S", "30")),
             health=HealthConfig.from_env(e),
+            goodput=e.get("NEXUS_GOODPUT", "1") != "0",
         )
 
 
@@ -226,7 +245,11 @@ class LedgerReporter:
 
     def heartbeat(self, step: int) -> None:
         # per-key merge, NOT a row RMW: each host owns only its own chip keys
-        # and N hosts heartbeat one run concurrently (SURVEY §7.4 multi-host)
+        # and N hosts heartbeat one run concurrently (SURVEY §7.4 multi-host).
+        # ONLY chip keys ride this map — per_chip_steps means "per-chip
+        # training step counters" everywhere it is read (watchdog staleness
+        # signature, on-call queries); run-global evidence like goodput
+        # lands in the terminal details column instead.
         if self.store is None:
             return
         cp = self.store.read_checkpoint(self.ctx.algorithm, self.ctx.run_id)
@@ -280,10 +303,18 @@ class LedgerReporter:
             fields["algorithm_failure_details"] = details
         self._guarded_update(fields)
 
-    def completed(self, result_uri: str = "") -> None:
-        self._guarded_update(
-            {"lifecycle_stage": LifecycleStage.COMPLETED, "result_uri": result_uri}
-        )
+    def completed(self, result_uri: str = "", details: str = "") -> None:
+        """Terminal COMPLETED; ``details`` (JSON) lands in the details
+        column when given — the serve loop records its final load
+        snapshot there (ISSUE 15), same column the drain protocol and the
+        fleet controller use for their closing evidence."""
+        fields: Dict[str, Any] = {
+            "lifecycle_stage": LifecycleStage.COMPLETED,
+            "result_uri": result_uri,
+        }
+        if details:
+            fields["algorithm_failure_details"] = details
+        self._guarded_update(fields)
 
     def preempted(self, cause: str = "", details: str = "") -> None:
         """Workload-side preemption report: the graceful-drain protocol
@@ -530,6 +561,12 @@ def _workload_loop(
             static_tags={"algorithm": ctx.algorithm, "run_id": ctx.run_id},
         )
     adapter = adapter_for(cfg.model)
+    # goodput accounting (ISSUE 15, workload/goodput.py): one stopwatch,
+    # every wall second attributed to a named bucket at the phase
+    # boundaries below; buckets provably sum to elapsed (property test).
+    # Host clocks only — the traced program is untouched (bit-parity test).
+    meter = build_meter(cfg.goodput, adapter.config, cfg.seq_len)
+    meter.start()
     mesh = build_mesh(cfg.mesh)
     if mesh.shape.get("pp", 1) > 1 and not cfg.rules.get("layers"):
         # a pp-bearing mesh with layer stacks replicated would silently waste
@@ -855,6 +892,9 @@ def _workload_loop(
     metrics: Dict[str, Any] = {}
     m: Dict[str, Any] = {}
     t0 = time.perf_counter()
+    # everything up to here — mesh build, state init, verified restore,
+    # step_fn construction — is startup cost by definition
+    meter.lap(BUCKET_INIT)
     tokens_done = 0
     step = start_step
     pending_anomaly: Optional[Anomaly] = None
@@ -881,6 +921,7 @@ def _workload_loop(
                     pending_anomaly = None
                     state, step = _health_recover(anomaly, state)
                     latest_ref["snap"] = (state, cursor.state())
+                    meter.lap(BUCKET_RECOVERY)
                     continue
                 if step >= cfg.steps:
                     break
@@ -905,12 +946,19 @@ def _workload_loop(
                     data_faults_handled=data_faults_handled,
                     hang_watchdog_armed=armed,
                 )
+                # host bookkeeping since the last attribution point
+                # (sync_flags allgather, watchdog arming, fault hooks) is
+                # loop overhead, not training — name it honestly
+                meter.lap(BUCKET_OTHER)
                 batch = to_global(next(cursor))
+                meter.lap(BUCKET_DATA)
                 state, m = step_fn(state, batch)
                 # one assignment: the watchdog thread must never observe a
                 # state/cursor pair that disagrees about consumed draws
                 latest_ref["snap"] = (state, cursor.state())
-                tokens_done += adapter.items_in(batch)
+                items = adapter.items_in(batch)
+                tokens_done += items
+                meter.note_step(items)
                 if monitor is not None:
                     # one-step-delayed readback: materializes the PREVIOUS
                     # step's verdict (already retired on device), stores this
@@ -918,9 +966,17 @@ def _workload_loop(
                     # already gated a condemned update, so acting a step
                     # late loses nothing irreversible.
                     pending_anomaly = monitor.push(step, m)
+                # the dispatch (plus the monitor's delayed materialization,
+                # which waits on the PREVIOUS step's chain) is train time;
+                # the first iteration's call compiles synchronously and
+                # belongs to startup, not steady state
+                meter.lap(BUCKET_INIT if compile_pending else BUCKET_STEP)
                 if cfg.heartbeat_every and (step + 1) % cfg.heartbeat_every == 0:
                     # pull metrics (device sync) only on heartbeat steps
                     metrics = {k: float(v) for k, v in m.items()}
+                    # that pull blocked on the step chain — train time, on
+                    # the async backends where dispatch returned instantly
+                    meter.lap(BUCKET_STEP)
                     reporter.heartbeat(step + 1)
                     logger.info("step %d loss %.4f", step + 1, metrics.get("loss", float("nan")))
                     # anomalies must be visible in statsd BEFORE (and after)
@@ -929,6 +985,8 @@ def _workload_loop(
                         telemetry.gauge("train.loss", metrics["loss"])
                     if "grad_norm" in metrics:
                         telemetry.gauge("train.grad_norm", metrics["grad_norm"])
+                    if ctx.is_coordinator:
+                        meter.gauges(telemetry)
                 if watchdog is not None:
                     watchdog.disarm()
                 compile_pending = False
@@ -938,6 +996,7 @@ def _workload_loop(
                         for _ in range(cfg.eval_steps)
                     ]
                     eval_loss = float(sum(losses)) / max(len(losses), 1)
+                    meter.lap(BUCKET_EVAL)
                     logger.info("step %d eval_loss %.4f", step + 1, eval_loss)
                 if ckpt and (step + 1) % cfg.checkpoint_every == 0:
                     # publish-after-durability: save() starts the (possibly
@@ -955,6 +1014,7 @@ def _workload_loop(
                         reporter.tensor_checkpoint(uri, step + 1)
                     else:
                         ckpt.wait()
+                    meter.lap(BUCKET_CKPT)
                 step += 1
     except Exception as exc:  # noqa: BLE001 - annotate, record, re-raise
         # north-star contract: failure-time trace artifact, its ref in the
@@ -972,6 +1032,9 @@ def _workload_loop(
             profiler.stop()  # close a capture the loop exited inside of
     jax.block_until_ready(state["step"])
     elapsed = time.perf_counter() - t0
+    # draining the dispatched step chain is train time surfacing late
+    # (async-dispatch honesty, module doc of workload/goodput.py)
+    meter.lap(BUCKET_STEP)
     # same uniformity rule as the loop break: every host reaches this point
     # (loop exhausted or uniform break), so a signal that landed on only
     # some hosts still yields one run-wide verdict — the emergency save
@@ -982,6 +1045,7 @@ def _workload_loop(
         emergency = _emergency_save(
             cfg, ckpt, state, reporter, ctx, lifecycle, telemetry, cursor=cursor
         )
+        meter.lap(BUCKET_EMERGENCY)
     if ckpt:
         ckpt.wait()
         ckpt.close()
@@ -1024,6 +1088,11 @@ def _workload_loop(
             f"step {plan.step}, but the run completed {cfg.steps} steps "
             "without wedging"
         )
+    # close the goodput books: residual host time (ckpt close, drill
+    # guards) lands in host_other, so the buckets sum to elapsed exactly
+    meter.stop()
+    if meter.enabled and ctx.is_coordinator:
+        logger.info("%s", meter.table())
     metrics = {k: float(v) for k, v in m.items()} if m else metrics
     final_step = int(state["step"])
     # completion protocol: every host lands its final heartbeat, THEN a
@@ -1060,16 +1129,35 @@ def _workload_loop(
                             if health_events
                             else {}
                         ),
+                        # goodput evidence survives the run (ISSUE 15): the
+                        # buckets/fraction/MFU of the time it DID get —
+                        # per_chip_steps stays chip-keys-only by contract
+                        **(
+                            {"goodput": meter.summary()}
+                            if meter.enabled
+                            else {}
+                        ),
                     }
                 ),
             )
         else:
-            reporter.completed()
+            # COMPLETED details carry the goodput accounting (ISSUE 15):
+            # the details column is the machine-readable place the run's
+            # wall-time story survives the process (the serve loop's
+            # final-snapshot discipline)
+            reporter.completed(
+                details=(
+                    json.dumps({"goodput": meter.summary()}, sort_keys=True)
+                    if meter.enabled
+                    else ""
+                )
+            )
     return {
         "final_step": final_step,
         "resumed_from": resumed_from,
         "elapsed_s": elapsed,
         "tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
+        **({"goodput": meter.summary()} if meter.enabled else {}),
         **({"eval_loss": eval_loss} if eval_loss is not None else {}),
         **({"preempted": True, **emergency} if preempted else {}),
         **({"ckpt_rollbacks": rollback_events} if rollback_events else {}),

@@ -172,6 +172,24 @@ class ServeConfig:
     #: where flight-recorder artifacts land; "" = NEXUS_TRACE_DIR else
     #: <tmpdir>/tpu-nexus-traces (serving/tracing.default_trace_dir)
     trace_dir: str = ""
+    #: SLO targets for the pressure plane (ISSUE 15, serving/loadstats.py):
+    #: recent-window TTFT/TPOT p99 ceilings in seconds and a shed-rate
+    #: ceiling (fraction of outcomes that were admission sheds between
+    #: observations).  0 disables a dimension; ALL zero disables the
+    #: monitor entirely (current behavior).  With any target set, the
+    #: serve loop grades its engine every heartbeat interval through an
+    #: SloMonitor (HEALTHY/PRESSURED/SATURATED with burn-rate escalation)
+    #: and reports the grade in the summary + ledger details; the fleet
+    #: controller consumes the same targets per reconcile.
+    #: (NEXUS_SLO_TTFT_S / NEXUS_SLO_TPOT_S / NEXUS_SLO_SHED_RATE)
+    slo_ttft_s: float = 0.0
+    slo_tpot_s: float = 0.0
+    slo_shed_rate: float = 0.0
+    #: burn windows in OBSERVATIONS (serve loop: heartbeat intervals;
+    #: fleet: reconciles) — short detects, long confirms; validated
+    #: short <= long (NEXUS_SLO_SHORT_N / NEXUS_SLO_LONG_N)
+    slo_short_window: int = 4
+    slo_long_window: int = 12
 
     def __post_init__(self) -> None:
         # value validation lives HERE, not in the run loops: a bad env
@@ -305,6 +323,35 @@ class ServeConfig:
                 "kv_blocks must be 0 (full occupancy) or >= 2 "
                 "(scratch block 0 + one usable), got 1"
             )
+        # SLO targets validate through SloTargets itself (the single
+        # owner of the window/burn/target invariants) — constructing one
+        # at parse is the validation, so a bad NEXUS_SLO_* env dies here
+        # in both the serve loop and the fleet controller
+        if self.slo_targets() is not None and not self.heartbeat_every:
+            # the serve loop observes the monitor at heartbeat cadence —
+            # targets with the cadence disabled would construct a monitor
+            # that never grades, silently (an explicitly requested feature
+            # must run or refuse, never no-op)
+            raise ValueError(
+                "NEXUS_SLO_* targets require a heartbeat cadence "
+                "(NEXUS_HEARTBEAT_EVERY > 0) — the SLO monitor observes "
+                "at heartbeat intervals and would otherwise never grade"
+            )
+
+    def slo_targets(self) -> "Optional[Any]":
+        """The parsed+validated :class:`~tpu_nexus.serving.loadstats.
+        SloTargets`, or None when every target is 0 (monitor disabled)."""
+        if not (self.slo_ttft_s or self.slo_tpot_s or self.slo_shed_rate):
+            return None
+        from tpu_nexus.serving.loadstats import SloTargets
+
+        return SloTargets(
+            ttft_p99_s=self.slo_ttft_s,
+            tpot_p99_s=self.slo_tpot_s,
+            shed_rate=self.slo_shed_rate,
+            short_window=self.slo_short_window,
+            long_window=self.slo_long_window,
+        )
 
     @staticmethod
     def from_env(env: Optional[Dict[str, str]] = None) -> "ServeConfig":
@@ -341,6 +388,11 @@ class ServeConfig:
             overlap_dispatch=e.get("NEXUS_OVERLAP", "") not in ("", "0"),
             decode_steps=int(e.get("NEXUS_DECODE_STEPS", "1")),
             stop_token=int(e.get("NEXUS_STOP_TOKEN", "-1")),
+            slo_ttft_s=float(e.get("NEXUS_SLO_TTFT_S", "0")),
+            slo_tpot_s=float(e.get("NEXUS_SLO_TPOT_S", "0")),
+            slo_shed_rate=float(e.get("NEXUS_SLO_SHED_RATE", "0")),
+            slo_short_window=int(e.get("NEXUS_SLO_SHORT_N", "4")),
+            slo_long_window=int(e.get("NEXUS_SLO_LONG_N", "12")),
         )
 
 
@@ -751,6 +803,37 @@ def _serve_engine_loop(
     # in PERF.md become measurements instead of inferences
     profiler = DeviceProfiler.from_env()
 
+    # the pressure plane (ISSUE 15, NEXUS_SLO_*): grade this engine as a
+    # fleet-of-one every heartbeat interval.  Observation is passive —
+    # load_snapshot() reads materialized host state only (NX014), so the
+    # token stream is identical monitor-on vs off (tests pin it).
+    slo_monitor = None
+    slo_targets = cfg.slo_targets()
+    if slo_targets is not None:
+        from tpu_nexus.serving.loadstats import FleetSnapshot, SloMonitor
+
+        slo_monitor = SloMonitor(slo_targets, metrics=statsd)
+
+        def observe_slo() -> None:
+            snap = engine.load_snapshot(replica="engine")
+            for tr in slo_monitor.observe(
+                FleetSnapshot.aggregate({"engine": snap})
+            ):
+                logger.warning(
+                    "serving pressure transition: %s %s -> %s (%s)",
+                    tr["scope"], tr["from"], tr["to"],
+                    tr.get("violated", tr.get("cause", "")),
+                )
+                # the PRESSURE_ACTIONS table (stamped on the transition by
+                # the monitor) owns the consequence — same dispatch as the
+                # fleet controller, so the two paths cannot diverge
+                if "dump" in tr["action"] and tr["scope"] == "engine":
+                    engine.dump_pressure(f"slo-{tr['to']}:engine")
+    else:
+
+        def observe_slo() -> None:
+            return None
+
     t0 = time.perf_counter()
     deadline_s = cfg.deadline_s or None
     # iteration counter from 0, NOT engine.steps (warmup already advanced
@@ -805,6 +888,7 @@ def _serve_engine_loop(
         it += 1
         if cfg.heartbeat_every and it % cfg.heartbeat_every == 0:
             reporter.heartbeat(it)
+            observe_slo()
 
     for _ in range(cfg.rounds):
         if lifecycle.cancelled:
@@ -848,19 +932,33 @@ def _serve_engine_loop(
             # the flight recorder dumped at the drain seam — merge the
             # artifact inventory (paths + per-cause counts) into the same
             # details column the supervisor reads, so the PREEMPTED row
-            # names where its drill-down lives
+            # names where its drill-down lives.  The final load snapshot
+            # rides along (same inventory-merge discipline): the terminal
+            # row records what the engine LOOKED like when it died, not
+            # just how its requests ended.
             details = {
                 "retired_states": metrics.retired,
                 "retired_causes": metrics.retired_causes,
+                "load_snapshot": engine.load_snapshot().to_dict(),
                 **drain_summary,
             }
             if tracer.enabled:
                 details["flight_recorder"] = tracer.recorder.summary()
+            if slo_monitor is not None:
+                details["pressure"] = slo_monitor.summary()
             reporter.preempted(cause=cause, details=json.dumps(details, sort_keys=True))
     else:
         reporter.heartbeat(it)
         if ctx.is_coordinator:
-            reporter.completed()
+            import json
+
+            # COMPLETED rows carry the final load snapshot too (ISSUE 15
+            # satellite): the details column is the only machine-readable
+            # place the run's closing state survives the process
+            details = {"load_snapshot": engine.load_snapshot().to_dict()}
+            if slo_monitor is not None:
+                details["pressure"] = slo_monitor.summary()
+            reporter.completed(details=json.dumps(details, sort_keys=True))
 
     done = engine.retired[n_warm:]
     finished = [r for r in done if r.state == RequestState.FINISHED]
@@ -878,6 +976,10 @@ def _serve_engine_loop(
         "elapsed_s": elapsed,
         "decoded_tokens_per_second": tokens_done / elapsed if elapsed > 0 else 0.0,
         "drained": lifecycle.cancelled,
+        # the pressure plane's closing view (ISSUE 15): the final load
+        # snapshot + the monitor's grades, mirroring the ledger details
+        "load_snapshot": engine.load_snapshot().to_dict(),
+        "pressure": slo_monitor.summary() if slo_monitor is not None else None,
         # observability: the dump inventory (incident artifacts on disk)
         # and the profiler window outcome, so a drill can assert both from
         # the summary without groveling the trace dir
