@@ -1,0 +1,422 @@
+"""nxflow: the interprocedural engine (tools/nxlint/flow.py).
+
+Covers the ISSUE 16 acceptance surface: the fails-closed failure modes
+(unresolvable dispatch, star imports, graph-build crashes degrade loudly),
+cycle termination, hash-keyed summary-cache invalidation, and — for every
+rebuilt rule (NX007/NX008/NX010/NX014) — a BOTH-WAYS pair proving the
+flow-backed pass flags a seeded violation the lexical pass provably
+misses (and, where the flow pass is *more precise*, that it drops a
+lexical false positive).  The repo-wide gate plus a wall-clock bound live
+here too: interprocedural analysis only ships if the whole tree stays
+clean AND fast.
+"""
+
+import ast
+import os
+import textwrap
+import time
+
+from tools.nxlint import Module, Project, lint_paths, lint_project
+from tools.nxlint import flow as nxflow
+from tools.nxlint.flow import FlowIntegrityRule, flow_for, summary_cache_stats
+from tools.nxlint.rules_durability import (
+    CheckpointPublishBarrierRule,
+    ParamsSwapBarrierRule,
+)
+from tools.nxlint.rules_serving import DispatchLoopReadbackRule
+from tools.nxlint.rules_tracing import HostSyncInJitRule
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def make_project(*files):
+    modules = [
+        Module("/virtual/" + rel, rel, textwrap.dedent(src)) for rel, src in files
+    ]
+    return Project("/virtual", modules)
+
+
+def run_rule(rule_cls, project, flow_enabled=True):
+    """Lint with a FRESH rule instance so toggling ``flow_enabled`` never
+    leaks into the registry singletons other tests use."""
+    rule = rule_cls()
+    rule.flow_enabled = flow_enabled
+    return lint_project(project, rules=[rule])
+
+
+# -- failure modes (fails closed, degrades loudly) -----------------------------
+
+
+def test_nx020_unbound_call_target_fails_closed():
+    project = make_project(
+        (
+            "tpu_nexus/serving/helper.py",
+            """
+            def pump(batch):
+                return mystery(batch)
+            """,
+        )
+    )
+    findings = lint_project(project, rules=[FlowIntegrityRule()])
+    assert [f.rule_id for f in findings] == ["NX020"]
+    assert "mystery" in findings[0].message
+    assert "unresolvable dynamic dispatch" in findings[0].message
+
+
+def test_nx020_star_import_fails_closed():
+    project = make_project(
+        (
+            "tpu_nexus/workload/glue.py",
+            """
+            from os.path import *
+
+            def f(p):
+                return join(p, "x")
+            """,
+        )
+    )
+    findings = lint_project(project, rules=[FlowIntegrityRule()])
+    # ONE finding, for the star import — the unbound-name check is skipped
+    # (every star-provided name would be a false positive on top)
+    assert [f.rule_id for f in findings] == ["NX020"]
+    assert "star import" in findings[0].message
+
+
+def test_nx020_out_of_scope_modules_are_exempt():
+    project = make_project(
+        (
+            "pkg/helper.py",
+            """
+            from os.path import *
+
+            def pump(batch):
+                return mystery(batch)
+            """,
+        )
+    )
+    assert lint_project(project, rules=[FlowIntegrityRule()]) == []
+
+
+def test_nx020_sanctioned_seam_suppressible_per_line():
+    project = make_project(
+        (
+            "tpu_nexus/serving/helper.py",
+            """
+            def pump(batch):
+                return mystery(batch)  # nxlint: disable=NX020 injected by the test harness
+            """,
+        )
+    )
+    assert lint_project(project, rules=[FlowIntegrityRule()]) == []
+
+
+def test_graph_build_failure_reports_nx020_and_degrades_to_lexical(monkeypatch):
+    """A crash in CallGraph construction must (a) surface as a named NX020
+    finding and (b) leave the rebuilt rules running their lexical pass —
+    never silently drop coverage."""
+
+    def boom(project):
+        raise RuntimeError("synthetic graph crash")
+
+    monkeypatch.setattr(nxflow, "CallGraph", boom)
+    project = make_project(
+        (
+            "tpu_nexus/workload/model.py",
+            """
+            import jax
+
+            @jax.jit
+            def step(x):
+                return x.item()
+            """,
+        )
+    )
+    findings = lint_project(
+        project, rules=[FlowIntegrityRule(), HostSyncInJitRule()]
+    )
+    by_rule = {f.rule_id for f in findings}
+    assert by_rule == {"NX010", "NX020"}
+    nx020 = next(f for f in findings if f.rule_id == "NX020")
+    assert "call-graph construction failed" in nx020.message
+    assert "RuntimeError" in nx020.message
+    nx010 = next(f for f in findings if f.rule_id == "NX010")
+    assert ".item()" in nx010.message  # the lexical fallback still caught it
+
+
+def test_summarize_cycle_terminates_with_default():
+    project = make_project(
+        (
+            "pkg/m.py",
+            """
+            def a(x):
+                return b(x)
+
+            def b(x):
+                return a(x)
+            """,
+        )
+    )
+    graph = flow_for(project)
+    info = graph.indexes["pkg/m.py"].functions["a"]
+
+    def compute(fn, recurse):
+        hit = False
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.Call):
+                for callee, _via in graph.resolve_call(node, fn.module):
+                    hit = hit or bool(recurse(callee))
+        return hit
+
+    # a -> b -> a is cut by the cycle guard (default False), so the whole
+    # summary is False and — crucially — the call returns at all
+    assert graph.summarize(info, "test-cycle", compute, False) is False
+
+
+def test_mutually_recursive_helpers_do_not_hang_the_barrier_rules():
+    project = make_project(
+        (
+            "tpu_nexus/workload/pub.py",
+            """
+            def ping(ckpt):
+                return pong(ckpt)
+
+            def pong(ckpt):
+                return ping(ckpt)
+
+            def publish(reporter, ckpt, uri, step):
+                ping(ckpt)
+                reporter.tensor_checkpoint(uri, step)
+            """,
+        )
+    )
+    findings = run_rule(CheckpointPublishBarrierRule, project)
+    # terminates, and the cyclic helpers summarize neutral: no barrier, so
+    # the unbarriered publish is still flagged
+    assert [f.rule_id for f in findings] == ["NX007"]
+
+
+# -- hash-keyed summary cache ---------------------------------------------------
+
+_ENGINE_SRC = """
+from tpu_nexus.serving.pending import drain
+
+class ServingEngine:
+    def pump(self, pending):
+        return drain(pending)
+"""
+
+_HELPER_OK = """
+def drain(pending):
+    return pending.value
+"""
+
+_HELPER_BAD = """
+def drain(pending):
+    return pending.value.item()
+"""
+
+
+def _nx014_over(helper_src):
+    project = make_project(
+        ("tpu_nexus/serving/engine.py", _ENGINE_SRC),
+        ("tpu_nexus/serving/pending.py", helper_src),
+    )
+    return run_rule(DispatchLoopReadbackRule, project)
+
+
+def test_summary_cache_hits_on_identical_sources_and_invalidates_on_edit():
+    assert _nx014_over(_HELPER_OK) == []
+    baseline = summary_cache_stats()["computes"]
+
+    # identical project (fresh Modules, fresh CallGraph): the deep hash is
+    # unchanged, so the summary comes straight from the cache
+    assert _nx014_over(_HELPER_OK) == []
+    assert summary_cache_stats()["computes"] == baseline
+
+    # pure line motion (leading blank lines) — hashes exclude positions
+    assert _nx014_over("\n\n" + _HELPER_OK) == []
+    assert summary_cache_stats()["computes"] == baseline
+
+    # a body edit changes the deep hash: recompute, and the verdict flips
+    findings = _nx014_over(_HELPER_BAD)
+    assert [f.rule_id for f in findings] == ["NX014"]
+    assert "drain()" in findings[0].message
+    assert summary_cache_stats()["computes"] > baseline
+
+
+# -- both-ways: lexical pass misses, flow pass finds ----------------------------
+
+
+def test_nx007_flow_catches_publish_through_wrapper_lexical_misses():
+    """The sanctioned-seam refactor: the wrapper carries the per-line
+    disable, so its own finding is suppressed — lexically the caller is
+    invisible; through the graph the caller inherits the obligation."""
+    project = make_project(
+        (
+            "tpu_nexus/workload/publish.py",
+            """
+            def publish_uri(reporter, uri, step):
+                reporter.tensor_checkpoint(uri, step)  # nxlint: disable=NX007 sanctioned seam
+
+            def after_save(ckpt, reporter, uri, step):
+                ckpt.save(step)
+                publish_uri(reporter, uri, step)
+            """,
+        )
+    )
+    assert run_rule(CheckpointPublishBarrierRule, project, flow_enabled=False) == []
+    findings = run_rule(CheckpointPublishBarrierRule, project)
+    assert [f.rule_id for f in findings] == ["NX007"]
+    assert "publish_uri" in findings[0].message
+    assert findings[0].line == 7  # the CALL site, not the wrapper
+
+
+def test_nx007_flow_sees_barrier_inside_helper_lexical_false_positive():
+    project = make_project(
+        (
+            "tpu_nexus/workload/publish.py",
+            """
+            def resolve(ckpt):
+                return ckpt.latest_verified_step()
+
+            def checked_publish(ckpt, reporter, uri):
+                step = resolve(ckpt)
+                reporter.tensor_checkpoint(uri, step)
+            """,
+        )
+    )
+    lexical = run_rule(CheckpointPublishBarrierRule, project, flow_enabled=False)
+    assert [f.rule_id for f in lexical] == ["NX007"]  # blind to the helper
+    assert run_rule(CheckpointPublishBarrierRule, project) == []
+
+
+def test_nx008_flow_catches_bound_alias_swap_lexical_misses():
+    project = make_project(
+        (
+            "tpu_nexus/serving/rollout.py",
+            """
+            def roll(engine, params):
+                swap = engine.swap_params
+                swap(params)
+            """,
+        )
+    )
+    assert run_rule(ParamsSwapBarrierRule, project, flow_enabled=False) == []
+    findings = run_rule(ParamsSwapBarrierRule, project)
+    assert [f.rule_id for f in findings] == ["NX008"]
+    assert "bound alias of swap_params" in findings[0].message
+
+
+def test_nx010_flow_follows_from_imported_helper_lexical_misses():
+    project = make_project(
+        (
+            "tpu_nexus/workload/model.py",
+            """
+            import jax
+            from tpu_nexus.workload.helpers import summarize
+
+            @jax.jit
+            def step(x):
+                return summarize(x)
+            """,
+        ),
+        (
+            "tpu_nexus/workload/helpers.py",
+            """
+            def summarize(x):
+                return x.item()
+            """,
+        ),
+    )
+    assert run_rule(HostSyncInJitRule, project, flow_enabled=False) == []
+    findings = run_rule(HostSyncInJitRule, project)
+    assert [f.rule_id for f in findings] == ["NX010"]
+    assert findings[0].file == "tpu_nexus/workload/helpers.py"
+    assert ".item()" in findings[0].message
+
+
+def test_nx010_flow_follows_self_method_lexical_misses():
+    project = make_project(
+        (
+            "tpu_nexus/workload/trainer.py",
+            """
+            import jax
+
+            class Trainer:
+                def build(self):
+                    def step(x):
+                        return self._tap(x)
+                    return jax.jit(step)
+
+                def _tap(self, x):
+                    return float(x)
+            """,
+        )
+    )
+    assert run_rule(HostSyncInJitRule, project, flow_enabled=False) == []
+    findings = run_rule(HostSyncInJitRule, project)
+    assert [f.rule_id for f in findings] == ["NX010"]
+    assert "float()" in findings[0].message
+
+
+def test_nx014_flow_catches_readback_wrapped_in_sibling_module():
+    findings_lexical_project = make_project(
+        ("tpu_nexus/serving/engine.py", _ENGINE_SRC),
+        ("tpu_nexus/serving/pending.py", _HELPER_BAD),
+    )
+    assert (
+        run_rule(DispatchLoopReadbackRule, findings_lexical_project, flow_enabled=False)
+        == []
+    )
+    findings = run_rule(DispatchLoopReadbackRule, findings_lexical_project)
+    assert [f.rule_id for f in findings] == ["NX014"]
+    assert "through the call graph" in findings[0].message
+    assert findings[0].file == "tpu_nexus/serving/engine.py"
+
+
+def test_nx014_flow_does_not_follow_executor_entry_points():
+    """Method calls on non-engine objects are the blocking oracle path by
+    contract — the graph must not drag them into dispatch-loop scope."""
+    project = make_project(
+        (
+            "tpu_nexus/serving/engine.py",
+            """
+            from tpu_nexus.serving.executor import Executor
+
+            class ServingEngine:
+                def __init__(self):
+                    self.executor = Executor()
+
+                def pump(self, batch):
+                    return self.executor.step(batch)
+            """,
+        ),
+        (
+            "tpu_nexus/serving/executor.py",
+            """
+            class Executor:
+                def step(self, batch):
+                    return batch.tokens.item()
+            """,
+        ),
+    )
+    assert run_rule(DispatchLoopReadbackRule, project) == []
+
+
+# -- the repo-wide gate, timed --------------------------------------------------
+
+
+def test_repo_wide_flow_lint_is_clean_and_under_60s():
+    """The full interprocedural run over tpu_nexus/ AND tools/ must stay
+    clean and complete well inside a minute — the pre-commit budget the
+    --changed fast path assumes (the whole tree is always scanned; only
+    reporting is filtered)."""
+    start = time.monotonic()
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus"), os.path.join(REPO_ROOT, "tools")],
+        root=REPO_ROOT,
+    )
+    elapsed = time.monotonic() - start
+    rendered = "\n".join(f.render() for f in findings)
+    assert findings == [], f"repo-wide nxlint regressed:\n{rendered}"
+    assert elapsed < 60.0, f"repo-wide nxlint took {elapsed:.1f}s (budget: 60s)"

@@ -1910,3 +1910,573 @@ def test_nx016_repo_is_clean():
         rules=[r for r in all_rules() if r.rule_id == "NX016"],
     )
     assert findings == []
+
+
+# -- multi-line statement suppression (regression) ------------------------------
+
+
+def test_multiline_statement_disable_on_opening_line():
+    """A `# nxlint: disable` on the FIRST line of a formatter-wrapped
+    statement suppresses findings anchored to any continuation line —
+    the fix for the old per-anchor-line-only behavior."""
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        y = (  # nxlint: disable=NX010 materialized on purpose in this fixture
+            x.item()
+        )
+        return y
+    """
+    assert lint_source(src, "NX010") == []
+
+
+def test_multiline_statement_disable_requires_the_opening_line():
+    """Same wrapped statement WITHOUT the disable: the continuation-line
+    finding still fires (the span mapping adds suppression scope, never
+    removes findings)."""
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x):
+        y = (
+            x.item()
+        )
+        return y
+    """
+    findings = lint_source(src, "NX010")
+    assert [f.rule_id for f in findings] == ["NX010"]
+
+
+def test_def_line_disable_does_not_blanket_the_body():
+    """Compound statements map only their wrapped HEADER: a disable on a
+    `def` line must never suppress findings inside the nested body."""
+    src = """
+    import jax
+
+    @jax.jit
+    def f(  # nxlint: disable=NX010
+        x,
+    ):
+        return x.item()
+    """
+    findings = lint_source(src, "NX010")
+    assert [f.rule_id for f in findings] == ["NX010"]
+
+
+def test_wrapped_with_header_disable_covers_condition_not_body():
+    """A wrapped `with` header maps to its opening line; the body keeps
+    its own suppression scope."""
+    src = """
+    import jax
+
+    @jax.jit
+    def f(x, ctx):
+        with ctx(  # nxlint: disable=NX010 trace-time probe in this fixture
+            x.item()
+        ):
+            return x.item()
+    """
+    findings = lint_source(src, "NX010")
+    # header finding suppressed; body finding (line 9) survives
+    assert [f.line for f in findings] == [9]
+
+
+# -- --changed REF (pre-commit fast path) ---------------------------------------
+
+
+def _git(repo, *args):
+    import subprocess
+
+    subprocess.run(
+        ["git", "-c", "user.email=t@test", "-c", "user.name=t", *args],
+        cwd=repo,
+        check=True,
+        capture_output=True,
+    )
+
+
+def test_cli_changed_reports_only_touched_files(tmp_path, capsys):
+    dirty = "try:\n    pass\nexcept Exception:\n    pass\n"
+    (tmp_path / "a.py").write_text(dirty)
+    (tmp_path / "b.py").write_text(dirty)
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "a.py", "b.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    # touch b.py (still dirty) and add an untracked c.py; a.py is unchanged
+    (tmp_path / "b.py").write_text(dirty + "x = 1\n")
+    (tmp_path / "c.py").write_text(dirty)
+
+    code = nxlint_main(["--changed", "HEAD", str(tmp_path), "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert code == 1
+    assert "b.py:" in out and "c.py:" in out
+    assert "a.py:" not in out  # scanned (interprocedural soundness) but not reported
+    assert "changed vs HEAD" in out
+
+    # with only unchanged files touched, the same dirty tree exits 0
+    (tmp_path / "b.py").write_text(dirty)
+    (tmp_path / "c.py").unlink()
+    assert (
+        nxlint_main(["--changed", "HEAD", str(tmp_path), "--root", str(tmp_path)]) == 0
+    )
+    capsys.readouterr()
+
+
+def test_cli_changed_unknown_ref_is_a_usage_error(tmp_path, capsys):
+    (tmp_path / "a.py").write_text("x = 1\n")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "add", "a.py")
+    _git(tmp_path, "commit", "-qm", "seed")
+    code = nxlint_main(
+        ["--changed", "no-such-ref", str(tmp_path), "--root", str(tmp_path)]
+    )
+    assert code == 2
+    assert "git diff failed" in capsys.readouterr().err
+
+
+# -- --sarif FILE ---------------------------------------------------------------
+
+
+def test_cli_sarif_output_schema_and_exit_contract(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("try:\n    pass\nexcept Exception:\n    pass\n")
+    out = tmp_path / "out.sarif"
+    assert nxlint_main([str(dirty), "--root", str(tmp_path), "--sarif", str(out)]) == 1
+    capsys.readouterr()
+
+    payload = json.loads(out.read_text())
+    assert payload["version"] == "2.1.0"
+    assert payload["$schema"].endswith("sarif-2.1.0.json")
+    driver = payload["runs"][0]["tool"]["driver"]
+    assert driver["name"] == "nxlint"
+    assert any(rule["id"] == "NX003" for rule in driver["rules"])
+    assert all(rule["shortDescription"]["text"] for rule in driver["rules"])
+
+    result = next(r for r in payload["runs"][0]["results"] if r["ruleId"] == "NX003")
+    assert result["level"] == "error"
+    assert result["message"]["text"]
+    location = result["locations"][0]["physicalLocation"]
+    assert location["artifactLocation"]["uri"] == "dirty.py"
+    assert location["region"]["startLine"] == 3
+    assert location["region"]["startColumn"] >= 1  # SARIF columns are 1-based
+    assert result["fingerprints"]["nxlint/v1"]
+
+    # clean tree: file still written (empty results), exit stays 0
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    out2 = tmp_path / "clean.sarif"
+    assert nxlint_main([str(clean), "--root", str(tmp_path), "--sarif", str(out2)]) == 0
+    assert json.loads(out2.read_text())["runs"][0]["results"] == []
+    capsys.readouterr()
+
+
+# -- NX017 lock discipline -------------------------------------------------------
+
+WATCHDOG_OK = """
+import threading
+
+class StepWatchdog:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.fired = False
+
+    def arm(self):
+        t = threading.Thread(target=self._run)
+        t.start()
+
+    def _run(self):
+        with self._lock:
+            self.fired = True
+"""
+
+
+def _lint_nx017(src, rel_path="tpu_nexus/workload/health.py", extra=()):
+    return lint_source(src, "NX017", rel_path=rel_path, extra=extra)
+
+
+def test_nx017_locked_thread_mutation_passes():
+    assert _lint_nx017(WATCHDOG_OK) == []
+
+
+def test_nx017_unlocked_thread_mutation_flagged():
+    src = WATCHDOG_OK.replace(
+        "        with self._lock:\n            self.fired = True",
+        "        self.fired = True",
+    )
+    findings = _lint_nx017(src)
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "must hold self._lock" in findings[0].message
+    assert "StepWatchdog._run" in findings[0].message
+
+
+def test_nx017_mutation_reachable_through_helper_flagged():
+    """The closure follows call edges: the thread target delegates to a
+    second method, whose unlocked mutation is still thread-reachable."""
+    src = """
+    import threading
+
+    class StepWatchdog:
+        def __init__(self):
+            self._lock = threading.Lock()
+
+        def arm(self):
+            threading.Thread(target=self._run).start()
+
+        def _run(self):
+            self._publish()
+
+        def _publish(self):
+            self.fired = True
+    """
+    findings = _lint_nx017(src)
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "StepWatchdog._publish" in findings[0].message
+
+
+def test_nx017_single_threaded_seam_mutation_flagged():
+    src = """
+    import threading
+
+    class ServingEngine:
+        def spawn(self):
+            threading.Thread(target=self._poke).start()
+
+        def _poke(self):
+            self.queue.append(1)
+    """
+    findings = _lint_nx017(src, rel_path="tpu_nexus/serving/engine.py")
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "single-threaded seam" in findings[0].message
+
+
+def test_nx017_untouched_guarded_class_passes():
+    """No thread reaches the engine: the single-threaded contract holds."""
+    src = """
+    class ServingEngine:
+        def pump(self):
+            self.queue.append(1)
+    """
+    assert _lint_nx017(src, rel_path="tpu_nexus/serving/engine.py") == []
+
+
+def test_nx017_missing_guarded_class_fails_closed():
+    findings = _lint_nx017("def nothing():\n    pass\n")
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "guarded class StepWatchdog no longer exists" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx017_unassigned_lock_fails_closed():
+    src = """
+    class StepWatchdog:
+        def __init__(self):
+            self._lock = None
+    """
+    findings = _lint_nx017(src)
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "never assigns it a threading lock" in findings[0].message
+
+
+def test_nx017_unresolvable_thread_target_fails_closed_in_strict_modules():
+    src = """
+    import threading
+
+    def launch(worker):
+        threading.Thread(target=worker).start()
+    """
+    findings = _lint_nx017(
+        src,
+        rel_path="tpu_nexus/workload/spawn.py",
+        extra=[("tpu_nexus/workload/health.py", WATCHDOG_OK)],
+    )
+    assert [f.rule_id for f in findings] == ["NX017"]
+    assert "thread target does not resolve" in findings[0].message
+
+
+def test_nx017_repo_is_clean():
+    """The shipped tree passes its own lock-discipline rule (repo gate
+    covers it; pinned so a race regression names the rule)."""
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus")],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX017"],
+    )
+    assert findings == []
+
+
+# -- NX018 env/config/docs parity ------------------------------------------------
+
+_DOC_HEADER = "| Variable | Type | Parsed at | Description |\n|---|---|---|---|\n"
+
+
+def _env_project(tmp_path, rows, src, rel_path="tpu_nexus/workload/serve.py"):
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    (docs / "ENVIRONMENT.md").write_text(_DOC_HEADER + rows)
+    module = Module(str(tmp_path / rel_path), rel_path, textwrap.dedent(src))
+    return Project(str(tmp_path), [module])
+
+
+def _lint_nx018(project):
+    return lint_project(
+        project, rules=[r for r in all_rules() if r.rule_id == "NX018"]
+    )
+
+
+_READ_SRC = """
+import os
+
+LEVEL = os.environ.get("NEXUS_LOG_LEVEL", "info")
+"""
+
+
+def test_nx018_documented_read_passes(tmp_path):
+    row = "| `NEXUS_LOG_LEVEL` | str | `tpu_nexus/workload/serve.py` | log level |\n"
+    assert _lint_nx018(_env_project(tmp_path, row, _READ_SRC)) == []
+
+
+def test_nx018_undocumented_read_flagged(tmp_path):
+    findings = _lint_nx018(_env_project(tmp_path, "", _READ_SRC))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "NEXUS_LOG_LEVEL is read here but has no row" in findings[0].message
+    assert findings[0].file == "tpu_nexus/workload/serve.py"
+
+
+def test_nx018_stale_doc_row_flagged(tmp_path):
+    rows = (
+        "| `NEXUS_LOG_LEVEL` | str | `tpu_nexus/workload/serve.py` | log level |\n"
+        "| `NEXUS_GONE` | int | `tpu_nexus/workload/serve.py` | removed knob |\n"
+    )
+    findings = _lint_nx018(_env_project(tmp_path, rows, _READ_SRC))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "documents NEXUS_GONE but nothing in the scanned tree reads it" in (
+        findings[0].message
+    )
+
+
+def test_nx018_moved_parse_site_flagged(tmp_path):
+    row = "| `NEXUS_LOG_LEVEL` | str | `tpu_nexus/workload/other.py` | log level |\n"
+    findings = _lint_nx018(_env_project(tmp_path, row, _READ_SRC))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "parse site moved without its docs row" in findings[0].message
+
+
+def test_nx018_empty_type_column_flagged(tmp_path):
+    row = "| `NEXUS_LOG_LEVEL` |  | `tpu_nexus/workload/serve.py` | log level |\n"
+    findings = _lint_nx018(_env_project(tmp_path, row, _READ_SRC))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "empty Type column" in findings[0].message
+
+
+def test_nx018_missing_doc_file_fails_closed(tmp_path):
+    module = Module(
+        str(tmp_path / "tpu_nexus/workload/serve.py"),
+        "tpu_nexus/workload/serve.py",
+        textwrap.dedent(_READ_SRC),
+    )
+    findings = _lint_nx018(Project(str(tmp_path), [module]))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "docs/ENVIRONMENT.md is missing" in findings[0].message
+
+
+def test_nx018_unresolvable_key_fails_closed(tmp_path):
+    src = """
+    import os
+
+    def read(suffix):
+        return os.environ.get("NEXUS_" + suffix)
+    """
+    findings = _lint_nx018(_env_project(tmp_path, "", src))
+    assert [f.rule_id for f in findings] == ["NX018"]
+    assert "cannot resolve to a NEXUS_* literal" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx018_module_constant_key_resolves(tmp_path):
+    src = """
+    import os
+
+    ENV_LEVEL = "NEXUS_LOG_LEVEL"
+
+    LEVEL = os.environ[ENV_LEVEL]
+    """
+    row = "| `NEXUS_LOG_LEVEL` | str | `tpu_nexus/workload/serve.py` | log level |\n"
+    assert _lint_nx018(_env_project(tmp_path, row, src)) == []
+
+
+def test_nx018_overlay_namespace_exempt(tmp_path):
+    """NEXUS__* (double underscore) keys are the field-derived config
+    overlay — out of the fixed catalog, never a parity obligation."""
+    src = """
+    import os
+
+    RAW = os.environ.get("NEXUS__SERVING__MAX_BATCH")
+    """
+    module = Module(
+        str(tmp_path / "tpu_nexus/core/config.py"),
+        "tpu_nexus/core/config.py",
+        textwrap.dedent(src),
+    )
+    # no docs file on purpose: with no catalog reads the rule stays silent
+    assert _lint_nx018(Project(str(tmp_path), [module])) == []
+
+
+def test_nx018_repo_env_surface_matches_docs():
+    """Every NEXUS_* knob the shipped tree reads has a docs row, and every
+    row is still read — the two-way parity gate over the real tree."""
+    from tools.nxlint.engine import collect_modules
+
+    modules = collect_modules(
+        [os.path.join(REPO_ROOT, "tpu_nexus"), os.path.join(REPO_ROOT, "tools")],
+        REPO_ROOT,
+    )
+    findings = lint_project(
+        Project(REPO_ROOT, modules),
+        rules=[r for r in all_rules() if r.rule_id == "NX018"],
+    )
+    rendered = "\n".join(f.render() for f in findings)
+    assert findings == [], f"env/docs parity drift:\n{rendered}"
+
+
+# -- NX019 donation safety -------------------------------------------------------
+
+
+def _lint_nx019(src, rel_path="tpu_nexus/workload/train.py", extra=()):
+    return lint_source(src, "NX019", rel_path=rel_path, extra=extra)
+
+
+def test_nx019_use_after_donate_flagged():
+    src = """
+    import jax
+
+    def step(state, batch):
+        return state
+
+    def run(state, batch):
+        f = jax.jit(step, donate_argnums=(0,))
+        new = f(state, batch)
+        return new, state["step"]
+    """
+    findings = _lint_nx019(src)
+    assert [f.rule_id for f in findings] == ["NX019"]
+    assert "DeviceStateLost" in findings[0].message
+    assert "'state' was donated" in findings[0].message
+
+
+def test_nx019_rebound_in_donating_statement_passes():
+    src = """
+    import jax
+
+    class Engine:
+        def __init__(self, fn):
+            self._step = jax.jit(fn, donate_argnums=(1,))
+
+        def step(self, tokens):
+            out, self.cache = self._step(self.params, self.cache)
+            return out
+    """
+    assert _lint_nx019(src) == []
+
+
+def test_nx019_self_attr_use_after_donate_flagged():
+    src = """
+    import jax
+
+    class Engine:
+        def __init__(self, fn):
+            self._step = jax.jit(fn, donate_argnums=(1,))
+
+        def step(self, tokens):
+            out = self._step(self.params, self.cache)
+            return out, self.cache.shape
+    """
+    findings = _lint_nx019(src)
+    assert [f.rule_id for f in findings] == ["NX019"]
+    assert "self.cache" in findings[0].message
+
+
+def test_nx019_one_hop_forwarded_donation_flagged():
+    """A donated parameter that dies in the callee moves the obligation to
+    the CALLER, resolved through the call graph."""
+    src = """
+    import jax
+
+    def step(state, batch):
+        return state
+
+    def forward(state, batch):
+        f = jax.jit(step, donate_argnums=(0,))
+        return f(state, batch)
+
+    def caller(state, batch):
+        new = forward(state, batch)
+        return new, state
+    """
+    findings = _lint_nx019(src)
+    assert [f.rule_id for f in findings] == ["NX019"]
+    assert "forwarded it to donated jit" in findings[0].message
+
+
+def test_nx019_empty_tuple_donation_is_off():
+    src = """
+    import jax
+
+    def run(state, batch, fn):
+        f = jax.jit(fn, donate_argnums=())
+        new = f(state, batch)
+        return new, state
+    """
+    assert _lint_nx019(src) == []
+
+
+def test_nx019_unresolvable_donate_fails_closed():
+    src = """
+    import jax
+
+    DONATE = compute_policy()
+
+    def step(state):
+        return state
+
+    def build():
+        return jax.jit(step, donate_argnums=DONATE)
+    """
+    findings = _lint_nx019(src)
+    assert [f.rule_id for f in findings] == ["NX019"]
+    assert "does not resolve to literal argnum positions" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx019_factory_param_donate_is_the_callers_obligation():
+    """`donate=` forwarded from the enclosing function's own parameter is
+    the jit-factory seam (`_make_jit`): no finding at the factory body."""
+    src = """
+    import jax
+
+    class Engine:
+        def _make_jit(self, fn, donate):
+            return jax.jit(fn, donate_argnums=donate)
+    """
+    assert _lint_nx019(src) == []
+
+
+def test_nx019_repo_is_clean():
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus")],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX019"],
+    )
+    assert findings == []
+
+
+def test_nx018_out_of_scope_doc_rows_not_judged_stale(tmp_path):
+    """A partial scan (one tree, --changed) must not call rows stale when
+    their declared parse-site modules were never scanned."""
+    rows = (
+        "| `NEXUS_LOG_LEVEL` | str | `tpu_nexus/workload/serve.py` | log level |\n"
+        "| `NEXUS_GATE_MODEL` | str | `tools/int8_gate_1b.py` | gate preset |\n"
+    )
+    assert _lint_nx018(_env_project(tmp_path, rows, _READ_SRC)) == []

@@ -22,9 +22,13 @@ from tools.nxlint.engine import (
     register,
 )
 
-# importing the rule modules populates the registry
+# importing the rule modules populates the registry (flow carries NX020)
+from tools.nxlint import flow  # noqa: F401
+from tools.nxlint import rules_concurrency  # noqa: F401
 from tools.nxlint import rules_control  # noqa: F401
+from tools.nxlint import rules_donation  # noqa: F401
 from tools.nxlint import rules_durability  # noqa: F401
+from tools.nxlint import rules_envdocs  # noqa: F401
 from tools.nxlint import rules_faults  # noqa: F401
 from tools.nxlint import rules_pressure  # noqa: F401
 from tools.nxlint import rules_serving  # noqa: F401

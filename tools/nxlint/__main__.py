@@ -2,6 +2,8 @@
 
     python -m tools.nxlint tpu_nexus/            # human output, exit 0/1
     python -m tools.nxlint --json tpu_nexus/     # machine output
+    python -m tools.nxlint --sarif out.sarif tpu_nexus/   # CI annotators
+    python -m tools.nxlint --changed origin/main tpu_nexus/  # pre-commit
     python -m tools.nxlint --write-baseline nxlint-baseline.json tpu_nexus/
     python -m tools.nxlint --baseline nxlint-baseline.json tpu_nexus/
 
@@ -14,6 +16,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from tools.nxlint.engine import (
@@ -22,8 +25,84 @@ from tools.nxlint.engine import (
     lint_project,
     load_baseline,
     write_baseline,
+    Finding,
     Project,
 )
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = "https://json.schemastore.org/sarif-2.1.0.json"
+
+
+def changed_files(ref: str, root: str) -> set:
+    """Repo-relative posix paths touched vs ``ref`` (diff + untracked), for
+    ``--changed``.  Raises CalledProcessError when ``ref`` is unknown."""
+    diff = subprocess.run(
+        ["git", "diff", "--name-only", ref],
+        cwd=root,
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    untracked = subprocess.run(
+        ["git", "ls-files", "--others", "--exclude-standard"],
+        cwd=root,
+        check=True,
+        capture_output=True,
+        text=True,
+    )
+    return {
+        line.strip()
+        for out in (diff.stdout, untracked.stdout)
+        for line in out.splitlines()
+        if line.strip()
+    }
+
+
+def sarif_payload(findings, rules) -> dict:
+    """Minimal valid SARIF 2.1.0: one run, the rule catalog as
+    reportingDescriptors, one result per finding (columns are 1-based in
+    SARIF, 0-based in Finding)."""
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "nxlint",
+                        "informationUri": "docs/STATIC_ANALYSIS.md",
+                        "rules": [
+                            {
+                                "id": rule.rule_id,
+                                "shortDescription": {"text": rule.description},
+                            }
+                            for rule in rules
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": f.rule_id,
+                        "level": "error" if f.severity == "error" else "warning",
+                        "message": {"text": f.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": f.file},
+                                    "region": {
+                                        "startLine": f.line,
+                                        "startColumn": f.col + 1,
+                                    },
+                                }
+                            }
+                        ],
+                        "fingerprints": {"nxlint/v1": f.fingerprint()},
+                    }
+                    for f in findings
+                ],
+            }
+        ],
+    }
 
 
 def main(argv=None) -> int:
@@ -45,6 +124,18 @@ def main(argv=None) -> int:
         help="comma-separated rule ids to run (default: all)",
     )
     parser.add_argument("--list-rules", action="store_true", help="print the rule catalog")
+    parser.add_argument(
+        "--changed",
+        metavar="REF",
+        help="report findings only for files touched vs this git ref "
+        "(the whole tree is still scanned so interprocedural rules stay "
+        "sound; pre-commit fast path)",
+    )
+    parser.add_argument(
+        "--sarif",
+        metavar="FILE",
+        help="also write findings as SARIF 2.1.0 to FILE (exit contract unchanged)",
+    )
     try:
         args = parser.parse_args(argv)
     except SystemExit as exc:
@@ -89,6 +180,22 @@ def main(argv=None) -> int:
 
     findings = lint_project(project, rules=rules, baseline=baseline)
 
+    changed_note = ""
+    if args.changed:
+        try:
+            touched = changed_files(args.changed, args.root)
+        except (OSError, subprocess.CalledProcessError) as exc:
+            detail = getattr(exc, "stderr", "") or str(exc)
+            print(f"--changed {args.changed}: git diff failed: {detail.strip()}", file=sys.stderr)
+            return 2
+        findings = [f for f in findings if f.file in touched]
+        changed_note = f" (changed vs {args.changed}: {len(touched)} file(s))"
+
+    if args.sarif:
+        with open(args.sarif, "w", encoding="utf-8") as fh:
+            json.dump(sarif_payload(findings, rules), fh, indent=2)
+            fh.write("\n")
+
     if args.as_json:
         print(json.dumps([f.to_json() for f in findings], indent=2))
     else:
@@ -96,7 +203,8 @@ def main(argv=None) -> int:
             print(finding.render())
         suffix = " (baseline applied)" if baseline else ""
         print(
-            f"nxlint: {len(findings)} finding(s) in {len(project.modules)} file(s){suffix}"
+            f"nxlint: {len(findings)} finding(s) in {len(project.modules)} "
+            f"file(s){suffix}{changed_note}"
         )
     return 1 if findings else 0
 

@@ -70,6 +70,7 @@ class Module:
         self.lines = source.splitlines()
         self.tree: Optional[ast.Module] = None
         self.parse_error: Optional[SyntaxError] = None
+        self._stmt_openings: Optional[Dict[int, int]] = None
         try:
             self.tree = ast.parse(source, filename=rel_path)
         except SyntaxError as exc:
@@ -86,8 +87,59 @@ class Module:
             return frozenset()
         return frozenset(part.strip() for part in m.group(1).split(",") if part.strip())
 
+    def _statement_opening_lines(self) -> Dict[int, int]:
+        """Continuation line -> opening line of its statement, so a
+        ``# nxlint: disable`` on the first line of a formatter-wrapped call
+        suppresses findings anchored to ANY line of that statement.  Simple
+        statements map their whole span; compound statements map only their
+        HEADER (a wrapped ``if``/``with`` condition) — a disable on a
+        ``def``/``if`` line must never blanket the nested body."""
+        if self._stmt_openings is not None:
+            return self._stmt_openings
+        spans: Dict[int, int] = {}
+        compound = (
+            ast.FunctionDef,
+            ast.AsyncFunctionDef,
+            ast.ClassDef,
+            ast.If,
+            ast.For,
+            ast.AsyncFor,
+            ast.While,
+            ast.With,
+            ast.AsyncWith,
+            ast.Try,
+        )
+        if self.tree is not None:
+            for node in ast.walk(self.tree):
+                if not isinstance(node, ast.stmt):
+                    continue
+                end = getattr(node, "end_lineno", None)
+                if end is None or end <= node.lineno:
+                    continue
+                if isinstance(node, compound):
+                    if isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                    ):
+                        continue
+                    children = [
+                        stmt.lineno
+                        for field in ("body", "orelse", "finalbody")
+                        for stmt in getattr(node, field, []) or []
+                    ] + [h.lineno for h in getattr(node, "handlers", []) or []]
+                    if children:
+                        end = min(end, min(children) - 1)
+                    if end <= node.lineno:
+                        continue
+                for line in range(node.lineno + 1, end + 1):
+                    spans.setdefault(line, node.lineno)
+        self._stmt_openings = spans
+        return spans
+
     def is_suppressed(self, finding: Finding) -> bool:
         rules = self.suppressed_rules(finding.line)
+        opening = self._statement_opening_lines().get(finding.line)
+        if opening is not None:
+            rules = rules | self.suppressed_rules(opening)
         return finding.rule_id in rules or "all" in rules
 
 

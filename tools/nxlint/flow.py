@@ -1,0 +1,701 @@
+"""nxflow: repo-wide call-graph construction and interprocedural effect
+summaries for nxlint rules (ISSUE 16).
+
+The lexical rules (NX007/NX008/NX010/NX014) go blind the moment a helper
+function wraps their sink or barrier — exactly the refactoring pressure
+the next roadmap items apply to ``serving/`` and ``workload/``.  This
+module gives them eyes across call boundaries:
+
+``CallGraph``
+    One per lint run (memoized on the ``Project`` via :func:`flow_for`).
+    Resolves call sites to function definitions across every scanned
+    module: lexically-scoped names, ``from``-imports, module-alias
+    attribute chains (``durability.verify_step(...)``), ``self.method``
+    calls through the enclosing class and its bases, and attribute/local
+    types inferred from constructor assignments and annotations
+    (``ckpt = TensorCheckpointer(...)``; ``reporter: LedgerReporter``).
+    Resolution is deliberately conservative: anything dynamic resolves to
+    nothing, and rules treat "nothing" per their own fail-open/closed
+    contract (NX020 below is the fails-closed backstop).
+
+``CallGraph.summarize``
+    Bounded-depth (``MAX_DEPTH`` call hops), cycle-guarded, memoized
+    effect summaries.  The cache key is a *deep hash*: the function's own
+    body hash combined with its resolved callees' deep hashes — so a
+    summary is invalidated the moment the helper's body (or a helper's
+    helper's body) changes, and never invalidated by mere line motion.
+    Summaries are computed from the raw AST: a ``# nxlint: disable``
+    comment suppresses a *finding*, never an *effect* — a sanctioned
+    publish seam still summarizes as "publishes", which is what moves the
+    barrier obligation to its callers.
+
+``NX020``
+    The fails-closed contract for unresolvable dynamic dispatch: inside
+    the flow-scoped strict modules (``serving/``, ``workload/``,
+    ``checkpoint/``) a ``from x import *`` or a call to a name bound
+    nowhere in the module defeats call-graph resolution and is itself a
+    finding, so the interprocedural rules can never silently lose
+    coverage to an unresolvable edge.
+
+Rule catalog and effect-summary table: docs/STATIC_ANALYSIS.md
+("Interprocedural rules").
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins as _builtins
+import hashlib
+from dataclasses import dataclass
+from typing import Callable, Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+
+#: maximum number of call hops an effect summary propagates through.  Two
+#: hops is the contract (a helper's helper); three keeps one hop of slack
+#: for the wrapper-of-wrapper refactors without letting summaries crawl
+#: the whole graph.
+MAX_DEPTH = 3
+
+_BUILTIN_NAMES = frozenset(dir(_builtins))
+
+_FUNC_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef)
+_SCOPE_DEFS = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _dotted_module(rel_path: str) -> str:
+    """``tpu_nexus/serving/engine.py`` -> ``tpu_nexus.serving.engine``."""
+    path = rel_path[:-3] if rel_path.endswith(".py") else rel_path
+    if path.endswith("/__init__"):
+        path = path[: -len("/__init__")]
+    return path.replace("/", ".")
+
+
+def frame_nodes(scope: ast.AST) -> List[ast.AST]:
+    """All AST nodes executing in ``scope``'s own frame — nested
+    function/class/lambda bodies excluded (same semantics as the lexical
+    rules' scope walks: an effect inside a nested def that may never run
+    proves nothing about the frame)."""
+    out: List[ast.AST] = []
+    body = scope.body if hasattr(scope, "body") else []
+    if not isinstance(body, list):  # Lambda.body is a single expression
+        body = [body]
+    stack = list(body)
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, _SCOPE_DEFS):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+@dataclass
+class FunctionInfo:
+    """One function definition the graph knows about."""
+
+    module: Module
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    name: str
+    qualname: str  # "serving/engine.py::ServingEngine.step"
+    class_name: Optional[str]  # immediately-enclosing class, if a method
+
+    _body_hash: Optional[str] = None
+
+    @property
+    def body_hash(self) -> str:
+        """Content hash of this function's own AST (line numbers excluded,
+        so renumbering never invalidates a summary but any body edit
+        does)."""
+        if self._body_hash is None:
+            dump = ast.dump(self.node, include_attributes=False)
+            self._body_hash = hashlib.sha256(dump.encode("utf-8")).hexdigest()[:16]
+        return self._body_hash
+
+
+class _ModuleIndex:
+    """Per-module AST indexes the graph resolves against."""
+
+    def __init__(self, module: Module) -> None:
+        self.module = module
+        tree = module.tree
+        assert tree is not None
+        self.dotted = _dotted_module(module.rel_path)
+        is_package = module.rel_path.endswith("__init__.py")
+        self._package = self.dotted if is_package else self.dotted.rsplit(".", 1)[0] if "." in self.dotted else ""
+
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+        #: local alias -> dotted module path (``import a.b as c``; a plain
+        #: ``import a.b`` binds the head ``a`` to ``a``)
+        self.import_modules: Dict[str, str] = {}
+        #: local alias -> (dotted source module, original name)
+        self.import_names: Dict[str, Tuple[str, str]] = {}
+        self.star_imports: List[ast.ImportFrom] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.asname:
+                        self.import_modules[alias.asname] = alias.name
+                    else:
+                        head = alias.name.split(".")[0]
+                        self.import_modules.setdefault(head, head)
+            elif isinstance(node, ast.ImportFrom):
+                base = self._from_base(node)
+                for alias in node.names:
+                    if alias.name == "*":
+                        self.star_imports.append(node)
+                        continue
+                    local = alias.asname or alias.name
+                    self.import_names[local] = (base, alias.name)
+
+        #: module-level defs and classes (by name)
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.classes: Dict[str, ast.ClassDef] = {}
+        #: every def in the module, nested or not, keyed by node identity
+        self.infos: Dict[int, FunctionInfo] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, _FUNC_DEFS):
+                info = FunctionInfo(
+                    module=module,
+                    node=node,
+                    name=node.name,
+                    qualname=f"{module.rel_path}::{self._qualname(node)}",
+                    class_name=self._enclosing_class_name(node),
+                )
+                self.infos[id(node)] = info
+        for stmt in tree.body:
+            if isinstance(stmt, _FUNC_DEFS):
+                self.functions[stmt.name] = self.infos[id(stmt)]
+            elif isinstance(stmt, ast.ClassDef):
+                self.classes[stmt.name] = stmt
+
+        #: every name the module binds anywhere (assignments, params, defs,
+        #: imports, loop/with/except targets, walrus) — the NX020 oracle
+        #: for "this call target cannot be a module-local binding"
+        self.bound_names: Set[str] = set(_BUILTIN_NAMES)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+                self.bound_names.add(node.id)
+            elif isinstance(node, ast.arg):
+                self.bound_names.add(node.arg)
+            elif isinstance(node, (*_FUNC_DEFS, ast.ClassDef)):
+                self.bound_names.add(node.name)
+            elif isinstance(node, ast.alias):
+                self.bound_names.add(node.asname or node.name.split(".")[0])
+            elif isinstance(node, ast.ExceptHandler) and node.name:
+                self.bound_names.add(node.name)
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                self.bound_names.update(node.names)
+            elif isinstance(node, ast.MatchAs) and node.name:
+                self.bound_names.add(node.name)
+            elif isinstance(node, ast.MatchStar) and node.name:
+                self.bound_names.add(node.name)
+
+        self._local_defs_cache: Dict[int, Dict[str, FunctionInfo]] = {}
+
+    def _from_base(self, node: ast.ImportFrom) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = self._package.split(".") if self._package else []
+        parts = parts[: len(parts) - (node.level - 1)] if node.level > 1 else parts
+        if node.module:
+            parts = parts + node.module.split(".")
+        return ".".join(parts)
+
+    def _qualname(self, node: ast.AST) -> str:
+        names = [node.name]
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (*_FUNC_DEFS, ast.ClassDef)):
+                names.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(names))
+
+    def _enclosing_class_name(self, node: ast.AST) -> Optional[str]:
+        parent = self.parents.get(node)
+        return parent.name if isinstance(parent, ast.ClassDef) else None
+
+    def enclosing_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.ClassDef):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def enclosing_function(self, node: ast.AST) -> Optional[ast.AST]:
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, _FUNC_DEFS):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    def local_defs(self, scope: ast.AST) -> Dict[str, FunctionInfo]:
+        """Functions defined directly in ``scope``'s frame."""
+        cached = self._local_defs_cache.get(id(scope))
+        if cached is not None:
+            return cached
+        defs: Dict[str, FunctionInfo] = {}
+
+        def walk(node: ast.AST) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, _FUNC_DEFS):
+                    defs.setdefault(child.name, self.infos[id(child)])
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    continue
+                walk(child)
+
+        walk(scope)
+        self._local_defs_cache[id(scope)] = defs
+        return defs
+
+
+def _attr_chain(expr: ast.expr) -> Optional[List[str]]:
+    """``self.mgr.allocate`` -> ["self", "mgr", "allocate"]; None when the
+    base is not a plain name (a call result, subscript, ...)."""
+    parts: List[str] = []
+    node = expr
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return list(reversed(parts))
+    return None
+
+
+#: resolution provenance, so rules can filter which edges they trust:
+#: "local"  — lexically-scoped def in the same module
+#: "import" — from-imported module-level function
+#: "module" — module-alias attribute call (``durability.verify_step()``)
+#: "self"   — ``self.method()`` through the enclosing class (and bases)
+#: "attr"   — ``self.attr.method()`` via a constructor/annotation type
+#: "var"    — ``obj.method()`` via a local constructor/annotation type
+Resolution = Tuple[FunctionInfo, str]
+
+#: effect-summary cache, shared across CallGraph instances (lint runs in
+#: one process).  Keyed by (domain, deep hash, remaining depth): the deep
+#: hash folds in every resolved callee's body hash, so editing a helper —
+#: at any depth the summary saw — changes the key and forces a recompute.
+_SUMMARY_CACHE: Dict[Tuple[str, str, int], object] = {}
+
+
+def summary_cache_stats() -> Dict[str, int]:
+    """For tests: cache size plus cumulative compute count."""
+    return {"entries": len(_SUMMARY_CACHE), "computes": _SUMMARY_COMPUTES[0]}
+
+
+_SUMMARY_COMPUTES = [0]
+
+
+class CallGraph:
+    """Project-wide def/call resolution plus memoized effect summaries."""
+
+    def __init__(self, project: Project) -> None:
+        self.project = project
+        self.indexes: Dict[str, _ModuleIndex] = {}
+        self.module_by_dotted: Dict[str, _ModuleIndex] = {}
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            idx = _ModuleIndex(module)
+            self.indexes[module.rel_path] = idx
+            self.module_by_dotted[idx.dotted] = idx
+        self.class_index: Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]] = {}
+        for idx in self.indexes.values():
+            for name, cls in idx.classes.items():
+                self.class_index.setdefault(name, []).append((idx, cls))
+        self._resolve_memo: Dict[Tuple[str, int], List[Resolution]] = {}
+        self._inprogress: Dict[str, Set[int]] = {}
+        self._deephash_memo: Dict[Tuple[int, int], str] = {}
+        self._deephash_inprogress: Set[int] = set()
+        self._attr_types_memo: Dict[int, Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]]] = {}
+        self._var_types_memo: Dict[int, Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]]] = {}
+
+    # -- lookups ---------------------------------------------------------------
+
+    def index_for(self, module: Module) -> Optional[_ModuleIndex]:
+        return self.indexes.get(module.rel_path)
+
+    def info_for(self, module: Module, node: ast.AST) -> Optional[FunctionInfo]:
+        idx = self.indexes.get(module.rel_path)
+        return idx.infos.get(id(node)) if idx is not None else None
+
+    def functions(self) -> Iterator[FunctionInfo]:
+        for idx in self.indexes.values():
+            yield from idx.infos.values()
+
+    # -- call resolution -------------------------------------------------------
+
+    def resolve_call(self, call: ast.Call, module: Module) -> List[Resolution]:
+        """Definitions ``call`` can reach, with provenance.  Empty when the
+        target is external (jax/numpy/builtins) or dynamic."""
+        idx = self.indexes.get(module.rel_path)
+        if idx is None:
+            return []
+        key = (module.rel_path, id(call))
+        cached = self._resolve_memo.get(key)
+        if cached is not None:
+            return cached
+        func = call.func
+        out: List[Resolution] = []
+        if isinstance(func, ast.Name):
+            out = self._resolve_name(func.id, call, idx)
+        elif isinstance(func, ast.Attribute):
+            out = self._resolve_attribute(func, call, idx)
+        self._resolve_memo[key] = out
+        return out
+
+    def _resolve_name(self, name: str, site: ast.AST, idx: _ModuleIndex) -> List[Resolution]:
+        # lexical: enclosing function scopes outward to module level
+        node: Optional[ast.AST] = site
+        while node is not None:
+            if isinstance(node, (*_FUNC_DEFS, ast.Module)):
+                found = idx.local_defs(node).get(name)
+                if found is not None:
+                    via = "local" if not isinstance(node, ast.Module) else "module-def"
+                    return [(found, via)]
+            node = idx.parents.get(node)
+        imported = idx.import_names.get(name)
+        if imported is not None:
+            base, orig = imported
+            target = self.module_by_dotted.get(base)
+            if target is not None:
+                fn = target.functions.get(orig)
+                if fn is not None:
+                    return [(fn, "import")]
+        return []
+
+    def _resolve_attribute(self, func: ast.Attribute, call: ast.Call, idx: _ModuleIndex) -> List[Resolution]:
+        chain = _attr_chain(func)
+        if not chain or len(chain) < 2:
+            return []
+        head, method = chain[0], chain[-1]
+        if head == "self":
+            cls = idx.enclosing_class(call)
+            if cls is None:
+                return []
+            if len(chain) == 2:  # self.method()
+                return [
+                    (info, "self")
+                    for info in self._lookup_method(idx, cls, method)
+                ]
+            if len(chain) == 3:  # self.attr.method()
+                out: List[Resolution] = []
+                for owner_idx, owner_cls in self._self_attr_types(idx, cls).get(chain[1], []):
+                    out.extend(
+                        (info, "attr")
+                        for info in self._lookup_method(owner_idx, owner_cls, method)
+                    )
+                return out
+            return []
+        # module-alias chains: ``durability.verify_step()``,
+        # ``tpu_nexus.checkpoint.durability.verify_step()``
+        resolved_mod = self._module_for_chain(idx, chain[:-1])
+        if resolved_mod is not None:
+            fn = resolved_mod.functions.get(method)
+            return [(fn, "module")] if fn is not None else []
+        # instance method through a local variable/parameter type
+        if len(chain) == 2:
+            out = []
+            encl = idx.enclosing_function(call)
+            if encl is not None:
+                for owner_idx, owner_cls in self._local_var_types(idx, encl).get(head, []):
+                    out.extend(
+                        (info, "var")
+                        for info in self._lookup_method(owner_idx, owner_cls, method)
+                    )
+            return out
+        return []
+
+    def _module_for_chain(self, idx: _ModuleIndex, parts: Sequence[str]) -> Optional[_ModuleIndex]:
+        """Resolve ["durability"] or ["tpu_nexus","checkpoint","durability"]
+        to a scanned module, through the module's import aliases."""
+        if not parts:
+            return None
+        head = parts[0]
+        candidates: List[str] = []
+        if head in idx.import_modules:
+            candidates.append(".".join([idx.import_modules[head], *parts[1:]]))
+        imported = idx.import_names.get(head)
+        if imported is not None:
+            base, orig = imported
+            candidates.append(".".join([base, orig, *parts[1:]] if base else [orig, *parts[1:]]))
+        for dotted in candidates:
+            target = self.module_by_dotted.get(dotted)
+            if target is not None:
+                return target
+        return None
+
+    def _resolve_class(self, idx: _ModuleIndex, expr: ast.expr) -> List[Tuple[_ModuleIndex, ast.ClassDef]]:
+        """The project class(es) a constructor/annotation expression names."""
+        chain = _attr_chain(expr) if isinstance(expr, ast.Attribute) else None
+        if isinstance(expr, ast.Name):
+            local = idx.classes.get(expr.id)
+            if local is not None:
+                return [(idx, local)]
+            imported = idx.import_names.get(expr.id)
+            if imported is not None:
+                base, orig = imported
+                target = self.module_by_dotted.get(base)
+                if target is not None and orig in target.classes:
+                    return [(target, target.classes[orig])]
+            return []
+        if chain and len(chain) >= 2:
+            target = self._module_for_chain(idx, chain[:-1])
+            if target is not None and chain[-1] in target.classes:
+                return [(target, target.classes[chain[-1]])]
+        return []
+
+    def _lookup_method(
+        self,
+        idx: _ModuleIndex,
+        cls: ast.ClassDef,
+        name: str,
+        _seen: Optional[Set[int]] = None,
+    ) -> List[FunctionInfo]:
+        seen = _seen if _seen is not None else set()
+        if id(cls) in seen:
+            return []
+        seen.add(id(cls))
+        for stmt in cls.body:
+            if isinstance(stmt, _FUNC_DEFS) and stmt.name == name:
+                info = idx.infos.get(id(stmt))
+                return [info] if info is not None else []
+        out: List[FunctionInfo] = []
+        for base in cls.bases:
+            for base_idx, base_cls in self._resolve_class(idx, base):
+                out.extend(self._lookup_method(base_idx, base_cls, name, seen))
+        return out
+
+    def _self_attr_types(
+        self, idx: _ModuleIndex, cls: ast.ClassDef
+    ) -> Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]]:
+        """``self.X = ClassName(...)`` / class-body ``X: ClassName``
+        annotations -> attr name -> candidate classes."""
+        cached = self._attr_types_memo.get(id(cls))
+        if cached is not None:
+            return cached
+        types: Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]] = {}
+        for node in ast.walk(cls):
+            target: Optional[ast.expr] = None
+            value: Optional[ast.expr] = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.annotation
+            if (
+                isinstance(target, ast.Attribute)
+                and isinstance(target.value, ast.Name)
+                and target.value.id == "self"
+            ):
+                attr = target.attr
+            elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+                attr = node.target.id  # class-body annotation
+            else:
+                continue
+            if isinstance(value, ast.Call):
+                value = value.func
+            if value is not None:
+                found = self._resolve_class(idx, value)
+                if found:
+                    types.setdefault(attr, []).extend(found)
+        self._attr_types_memo[id(cls)] = types
+        return types
+
+    def _local_var_types(
+        self, idx: _ModuleIndex, fn: ast.AST
+    ) -> Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]]:
+        """Constructor assignments and annotations inside one function:
+        ``ckpt = TensorCheckpointer(...)``, ``reporter: LedgerReporter``."""
+        cached = self._var_types_memo.get(id(fn))
+        if cached is not None:
+            return cached
+        types: Dict[str, List[Tuple[_ModuleIndex, ast.ClassDef]]] = {}
+        args = fn.args
+        for arg in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if arg.annotation is not None:
+                found = self._resolve_class(idx, arg.annotation)
+                if found:
+                    types.setdefault(arg.arg, []).extend(found)
+        for node in frame_nodes(fn):
+            target = None
+            value = None
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target, value = node.targets[0], node.value
+            elif isinstance(node, ast.AnnAssign):
+                target, value = node.target, node.annotation
+            if not isinstance(target, ast.Name):
+                continue
+            if isinstance(value, ast.Call):
+                value = value.func
+            if value is not None:
+                found = self._resolve_class(idx, value)
+                if found:
+                    types.setdefault(target.id, []).extend(found)
+        self._var_types_memo[id(fn)] = types
+        return types
+
+    # -- effect summaries ------------------------------------------------------
+
+    def deep_hash(self, fn: FunctionInfo, depth: int = MAX_DEPTH) -> str:
+        """``fn``'s body hash folded with its resolved callees' deep
+        hashes, to ``depth`` hops — the summary-cache key component that
+        makes the cache invalidate when a helper's body changes."""
+        key = (id(fn.node), depth)
+        cached = self._deephash_memo.get(key)
+        if cached is not None:
+            return cached
+        if id(fn.node) in self._deephash_inprogress or depth <= 0:
+            return fn.body_hash  # cycle/depth cut: own body only
+        self._deephash_inprogress.add(id(fn.node))
+        try:
+            h = hashlib.sha256(fn.body_hash.encode("utf-8"))
+            callees: Dict[str, FunctionInfo] = {}
+            for node in ast.walk(fn.node):
+                if isinstance(node, ast.Call):
+                    for callee, _via in self.resolve_call(node, fn.module):
+                        callees.setdefault(callee.qualname, callee)
+            for qualname in sorted(callees):
+                h.update(self.deep_hash(callees[qualname], depth - 1).encode("utf-8"))
+            digest = h.hexdigest()[:16]
+        finally:
+            self._deephash_inprogress.discard(id(fn.node))
+        self._deephash_memo[key] = digest
+        return digest
+
+    def summarize(
+        self,
+        fn: FunctionInfo,
+        domain: str,
+        compute: Callable[[FunctionInfo, Callable[[FunctionInfo], object]], object],
+        default: object,
+        depth: int = MAX_DEPTH,
+    ) -> object:
+        """Memoized bounded-depth effect summary.  ``compute(fn, recurse)``
+        supplies the domain logic; ``recurse(callee)`` yields the callee's
+        summary one hop deeper (or ``default`` past the depth bound or on
+        a call-graph cycle — the cycle guard is what makes recursion over
+        mutually-recursive helpers terminate)."""
+        if depth <= 0:
+            return default
+        inprog = self._inprogress.setdefault(domain, set())
+        if id(fn.node) in inprog:
+            return default
+        key = (domain, self.deep_hash(fn), depth)
+        if key in _SUMMARY_CACHE:
+            return _SUMMARY_CACHE[key]
+        inprog.add(id(fn.node))
+        try:
+            _SUMMARY_COMPUTES[0] += 1
+            value = compute(
+                fn, lambda callee: self.summarize(callee, domain, compute, default, depth - 1)
+            )
+        finally:
+            inprog.discard(id(fn.node))
+        _SUMMARY_CACHE[key] = value
+        return value
+
+
+def flow_for(project: Project) -> CallGraph:
+    """The one CallGraph of this lint run, built on first use and shared
+    by every flow-backed rule.  Raises on construction failure — callers
+    fall back to their lexical pass (and NX020 reports the breakage)."""
+    graph = getattr(project, "_nxflow_graph", None)
+    if graph is None:
+        error = getattr(project, "_nxflow_error", None)
+        if error is not None:
+            raise error
+        try:
+            graph = CallGraph(project)
+        except Exception as exc:  # noqa: BLE001 - any graph-build crash must degrade rules to lexical, re-raised for NX020 to report
+            project._nxflow_error = exc
+            raise
+        project._nxflow_graph = graph
+    return graph
+
+
+# -- NX020: the fails-closed contract ------------------------------------------
+
+#: modules whose invariants the flow rules guard: dynamic dispatch the
+#: graph cannot resolve is a FINDING here, not a silent coverage hole
+_STRICT_FRAGMENTS = (
+    "tpu_nexus/serving/",
+    "tpu_nexus/workload/",
+    "tpu_nexus/checkpoint/",
+)
+
+
+def is_strict_module(rel_path: str) -> bool:
+    return any(frag in rel_path for frag in _STRICT_FRAGMENTS)
+
+
+@register
+class FlowIntegrityRule(Rule):
+    """NX020: call-graph resolvability inside the flow-scoped strict
+    modules (``serving/``, ``workload/``, ``checkpoint/``).  The
+    interprocedural rules (NX007/NX008/NX010/NX014/NX017/NX019) are only
+    as sound as resolution: a ``from x import *`` makes every imported
+    name invisible to the graph, and a call to a name bound nowhere in
+    the module (no def, no import, no assignment anywhere — a typo or a
+    runtime-injected global) is dynamic dispatch nothing can resolve.
+    Both fail CLOSED as named findings instead of silently dropping call
+    edges; a genuinely sanctioned dynamic seam takes a per-line
+    ``# nxlint: disable=NX020`` with its rationale.  Also surfaces
+    call-graph construction failure itself — a crash in flow.py must
+    degrade loudly (rules fall back to lexical), never silently."""
+
+    rule_id = "NX020"
+    description = (
+        "flow-scoped modules must stay call-graph resolvable "
+        "(no star imports or unbound call targets)"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        try:
+            graph = flow_for(project)
+        except Exception as exc:  # noqa: BLE001 - ANY graph-build failure becomes the named fails-closed finding below
+            for module in project.modules:
+                if module.tree is not None:
+                    yield self.finding(
+                        module,
+                        module.tree,
+                        f"call-graph construction failed ({type(exc).__name__}: "
+                        f"{exc}) — interprocedural rules degraded to their "
+                        "lexical fallbacks",
+                    )
+                    return
+            return
+        for idx in graph.indexes.values():
+            if not is_strict_module(idx.module.rel_path):
+                continue
+            for star in idx.star_imports:
+                yield self.finding(
+                    idx.module,
+                    star,
+                    "star import defeats call-graph resolution in a "
+                    "flow-scoped module — import names explicitly so "
+                    "interprocedural rules can see through them",
+                )
+            if idx.star_imports:
+                continue  # unbound-name checks would all be false positives
+            for node in ast.walk(idx.module.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id not in idx.bound_names
+                ):
+                    yield self.finding(
+                        idx.module,
+                        node,
+                        f"call to '{node.func.id}' resolves to no binding in "
+                        "this module (unresolvable dynamic dispatch in a "
+                        "flow-scoped module) — define/import it, or mark a "
+                        "sanctioned dynamic seam with a justified disable",
+                    )

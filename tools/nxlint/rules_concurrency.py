@@ -1,0 +1,339 @@
+"""NX017: lock discipline on thread-reachable mutations (ISSUE 16).
+
+The serving and workload planes are single-threaded BY CONTRACT almost
+everywhere — the dispatch loop owns ``ServingEngine``/``KVBlockManager``
+state, the fleet reconciler owns replica state — and the few real threads
+(the step watchdog, the emergency saver, the telemetry shipper) touch
+shared state through explicit locks.  That contract is invisible to the
+runtime until a race corrupts a KV page table; this rule makes it
+checkable:
+
+1.  Thread ENTRY POINTS are every callable handed to
+    ``threading.Thread(target=...)`` / ``threading.Timer(...)``, resolved
+    through the call graph (``self._run`` bound methods, nested closures,
+    imported functions).
+2.  The REACHABLE set is the call-graph closure from those entries.
+3.  Inside reachable methods of a GUARDED class (table below), any
+    mutation of ``self`` state must lexically sit under ``with
+    self.<lock>:`` for lock-owning classes — or is a finding outright for
+    classes whose contract is "never touched from a thread" (lock
+    ``None``: the single-threaded seam).
+
+Fails closed: a guarded class that disappears from its module, or a
+declared lock attribute that is never assigned in the class, is itself a
+finding — a rename must update the table, not silently disarm the rule.
+An unresolvable thread target inside the flow-scoped strict modules is
+also a finding (the closure cannot be trusted if its roots are unknown).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.flow import (
+    CallGraph,
+    FunctionInfo,
+    flow_for,
+    frame_nodes,
+    is_strict_module,
+)
+
+#: class name -> (defining module rel_path, owning lock attribute).
+#: Lock ``None`` declares the SINGLE-THREADED SEAM contract: the class is
+#: owned by one loop (dispatch loop, reconciler) and must never be mutated
+#: from code reachable off a thread entry point.  The ISSUE names a
+#: ``DispatchPipeline``; this tree's equivalent staged-dispatch actor is
+#: ``PipelineStageActor`` (``core/pipeline.py``), whose cross-thread
+#: ingest handoff is guarded by ``_ingest_lock``.
+GUARDED_CLASSES: Dict[str, Tuple[str, Optional[str]]] = {
+    "ServingEngine": ("tpu_nexus/serving/engine.py", None),
+    "KVBlockManager": ("tpu_nexus/serving/cache_manager.py", None),
+    "ServingFleet": ("tpu_nexus/serving/fleet.py", None),
+    "FleetSupervisor": ("tpu_nexus/serving/fleet.py", None),
+    "StepWatchdog": ("tpu_nexus/workload/health.py", "_lock"),
+    "PipelineStageActor": ("tpu_nexus/core/pipeline.py", "_ingest_lock"),
+}
+
+#: method names whose call on a ``self`` attribute mutates it in place.
+#: ``set`` (threading.Event) and queue ``put*`` are deliberately absent:
+#: events and queues ARE synchronization primitives.
+_MUTATOR_METHODS = frozenset(
+    {
+        "append",
+        "appendleft",
+        "extend",
+        "insert",
+        "remove",
+        "pop",
+        "popleft",
+        "popitem",
+        "clear",
+        "update",
+        "add",
+        "discard",
+        "setdefault",
+    }
+)
+
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition"})
+
+
+def _terminal(func: ast.expr) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _self_attr(expr: ast.expr) -> Optional[str]:
+    """``self.x`` -> "x"; also the base attr of ``self.x[k]``."""
+    if isinstance(expr, ast.Subscript):
+        expr = expr.value
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return expr.attr
+    return None
+
+
+def thread_entry_exprs(tree: ast.Module) -> Iterator[Tuple[ast.Call, ast.expr]]:
+    """The callable expressions handed to Thread/Timer constructors."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _terminal(node.func)
+        if name == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    yield node, kw.value
+        elif name == "Timer":
+            if len(node.args) >= 2:
+                yield node, node.args[1]
+            for kw in node.keywords:
+                if kw.arg == "function":
+                    yield node, kw.value
+
+
+class _Mutation:
+    """One ``self``-state mutation site inside a method's own frame."""
+
+    def __init__(self, node: ast.AST, attr: str, desc: str) -> None:
+        self.node = node
+        self.attr = attr
+        self.desc = desc
+
+
+def _frame_mutations(fn: ast.AST, skip_attrs: Set[str]) -> List[_Mutation]:
+    out: List[_Mutation] = []
+    for node in frame_nodes(fn):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            if isinstance(node, ast.AnnAssign) and node.value is None:
+                continue
+            for target in targets:
+                elts = target.elts if isinstance(target, (ast.Tuple, ast.List)) else [target]
+                for elt in elts:
+                    attr = _self_attr(elt)
+                    if attr is not None and attr not in skip_attrs:
+                        out.append(_Mutation(node, attr, f"assignment to self.{attr}"))
+        elif isinstance(node, ast.Delete):
+            for target in node.targets:
+                attr = _self_attr(target)
+                if attr is not None and attr not in skip_attrs:
+                    out.append(_Mutation(node, attr, f"del of self.{attr}"))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in _MUTATOR_METHODS
+        ):
+            attr = _self_attr(node.func.value)
+            if attr is not None and attr not in skip_attrs:
+                out.append(
+                    _Mutation(node, attr, f"self.{attr}.{node.func.attr}() mutation")
+                )
+    return out
+
+
+def _under_lock(node: ast.AST, fn: ast.AST, parents: Dict[ast.AST, ast.AST], lock: str) -> bool:
+    """True when ``node`` sits lexically inside ``with self.<lock>:`` within
+    ``fn``'s frame."""
+    cur = parents.get(node)
+    while cur is not None and cur is not fn:
+        if isinstance(cur, (ast.With, ast.AsyncWith)):
+            for item in cur.items:
+                if _self_attr(item.context_expr) == lock:
+                    return True
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class LockDisciplineRule(Rule):
+    """NX017: guarded-class state reachable from thread entry points must
+    be mutated under the owning lock (or not at all, for classes whose
+    contract is single-threaded ownership)."""
+
+    rule_id = "NX017"
+    description = (
+        "thread-reachable mutations of guarded classes must hold the owning lock"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        try:
+            graph = flow_for(project)
+        except Exception:  # noqa: BLE001 - without a graph there is no reachability; NX020 already reports the breakage
+            return
+        guarded = self._active_guarded(project)
+        yield from self._fails_closed(project, guarded)
+        if not guarded:
+            return
+        entries, unresolved = self._entries(graph)
+        for module, call in unresolved:
+            if is_strict_module(module.rel_path):
+                yield self.finding(
+                    module,
+                    call,
+                    "thread target does not resolve through the call graph — "
+                    "the lock-discipline closure cannot see past it; bind a "
+                    "named function or justify a disable",
+                )
+        reachable = self._closure(graph, entries)
+        for info, entry_desc in reachable:
+            cls = info.class_name
+            if cls not in guarded:
+                continue
+            decl_path, lock = GUARDED_CLASSES[cls]
+            if decl_path not in info.module.rel_path and info.module.rel_path != decl_path:
+                continue
+            idx = graph.index_for(info.module)
+            skip = {lock} if lock else set()
+            for mut in _frame_mutations(info.node, skip):
+                if lock is None:
+                    yield self.finding(
+                        info.module,
+                        mut.node,
+                        f"{mut.desc} in {cls}.{info.name} is reachable from a "
+                        f"thread entry point ({entry_desc}) but {cls} is a "
+                        "single-threaded seam — route the mutation through the "
+                        "owning loop, or give the class a lock and register it "
+                        "in the NX017 table",
+                    )
+                elif not _under_lock(mut.node, info.node, idx.parents, lock):
+                    yield self.finding(
+                        info.module,
+                        mut.node,
+                        f"{mut.desc} in {cls}.{info.name} is reachable from a "
+                        f"thread entry point ({entry_desc}) and must hold "
+                        f"self.{lock} (wrap it in 'with self.{lock}:')",
+                    )
+
+    # -- pieces ---------------------------------------------------------------
+
+    def _active_guarded(self, project: Project) -> Set[str]:
+        """Guarded classes whose declared module is in this lint scope."""
+        active: Set[str] = set()
+        for cls, (rel_path, _lock) in GUARDED_CLASSES.items():
+            if project.find_module(rel_path) is not None:
+                active.add(cls)
+        return active
+
+    def _fails_closed(self, project: Project, active: Set[str]) -> Iterator[Finding]:
+        for cls, (rel_path, lock) in GUARDED_CLASSES.items():
+            module = project.find_module(rel_path)
+            if module is None or module.tree is None:
+                continue  # module outside this lint invocation's paths
+            cls_node = next(
+                (
+                    n
+                    for n in module.tree.body
+                    if isinstance(n, ast.ClassDef) and n.name == cls
+                ),
+                None,
+            )
+            if cls_node is None:
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"guarded class {cls} no longer exists in {rel_path} — "
+                    "NX017's table is stale; update tools/nxlint/"
+                    "rules_concurrency.py (fails closed)",
+                )
+                continue
+            if lock is None:
+                continue
+            if not self._lock_assigned(cls_node, lock):
+                yield self.finding(
+                    module,
+                    cls_node,
+                    f"guarded class {cls} declares lock self.{lock} in NX017's "
+                    "table but never assigns it a threading lock — the "
+                    "discipline check has nothing to hold (fails closed)",
+                )
+
+    @staticmethod
+    def _lock_assigned(cls_node: ast.ClassDef, lock: str) -> bool:
+        for node in ast.walk(cls_node):
+            if (
+                isinstance(node, ast.Assign)
+                and any(_self_attr(t) == lock for t in node.targets)
+                and isinstance(node.value, ast.Call)
+                and _terminal(node.value.func) in _LOCK_FACTORIES
+            ):
+                return True
+        return False
+
+    def _entries(
+        self, graph: CallGraph
+    ) -> Tuple[List[Tuple[FunctionInfo, str]], List[Tuple[Module, ast.Call]]]:
+        entries: List[Tuple[FunctionInfo, str]] = []
+        unresolved: List[Tuple[Module, ast.Call]] = []
+        for idx in graph.indexes.values():
+            for call, expr in thread_entry_exprs(idx.module.tree):
+                infos = self._resolve_target(graph, idx, call, expr)
+                desc = (
+                    f"thread target at {idx.module.rel_path}:{call.lineno}"
+                )
+                if infos:
+                    entries.extend((info, desc) for info in infos)
+                else:
+                    unresolved.append((idx.module, call))
+        return entries, unresolved
+
+    @staticmethod
+    def _resolve_target(graph, idx, call: ast.Call, expr: ast.expr) -> List[FunctionInfo]:
+        if isinstance(expr, ast.Name):
+            return [info for info, _via in graph._resolve_name(expr.id, call, idx)]
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Name)
+            and expr.value.id == "self"
+        ):
+            cls = idx.enclosing_class(call)
+            if cls is not None:
+                return graph._lookup_method(idx, cls, expr.attr)
+        if isinstance(expr, ast.Lambda):
+            return []  # opaque: surfaces as unresolved in strict modules
+        return []
+
+    @staticmethod
+    def _closure(
+        graph: CallGraph, entries: List[Tuple[FunctionInfo, str]]
+    ) -> List[Tuple[FunctionInfo, str]]:
+        reachable: Dict[int, Tuple[FunctionInfo, str]] = {}
+        work = list(entries)
+        while work:
+            info, desc = work.pop()
+            if id(info.node) in reachable:
+                continue
+            reachable[id(info.node)] = (info, desc)
+            for node in ast.walk(info.node):
+                if isinstance(node, ast.Call):
+                    for callee, _via in graph.resolve_call(node, info.module):
+                        if id(callee.node) not in reachable:
+                            work.append((callee, desc))
+        return list(reachable.values())

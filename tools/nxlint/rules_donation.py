@@ -1,0 +1,407 @@
+"""NX019: buffer-donation safety (ISSUE 16).
+
+``jax.jit(..., donate_argnums=...)`` invalidates the donated device buffer
+the moment the call returns: any later use of the old reference raises
+``RuntimeError: invalid buffer`` — the DeviceStateLost bug class the
+serving engine's swap/rollback machinery exists to avoid.  The safe idiom
+is rebinding the donated operand IN the call's own assignment, which is
+how every engine dispatch is written::
+
+    next_tokens, self.cache = self._step(self.params, self.cache, ...)
+
+This rule checks that structurally.  Donation SITES are ``jax.jit`` /
+``pjit`` calls carrying ``donate_argnums=``, and the engines'
+``self._make_jit(fn, donate=...)`` factory seam.  Donated positions
+resolve from tuple/int literals in the donate expression (a conditional
+``(1,) if tpu else ()`` contributes its literals — may-donate is the
+conservative reading), or through a class-level ``self._donate = ...``
+assignment.  A donate expression that resolves to no literal positions at
+all fails CLOSED as a finding — except when it is itself a parameter of
+the enclosing function, which marks a jit FACTORY (the engine
+``_make_jit`` body): the obligation belongs to the factory's call sites.
+
+For every call to a donated callable (bound to ``self.X`` and called from
+the owning class, or bound to a local name and called in the same scope),
+each donated positional argument that is a plain name or ``self.attr``
+must be rebound in the call statement's own targets, or never loaded
+again in the enclosing scope.  A donated argument that is a PARAMETER of
+the enclosing function and dies there moves the obligation one hop up:
+callers of that function (resolved through the call graph) are checked
+against the same contract at the forwarding position.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Sequence, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.flow import CallGraph, FunctionInfo, flow_for, frame_nodes
+
+_JIT_NAMES = frozenset({"jit", "pjit"})
+_FACTORY_NAMES = frozenset({"_make_jit"})
+
+
+def _terminal(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _donate_kw(call: ast.Call) -> Optional[ast.expr]:
+    name = _terminal(call.func)
+    if name in _JIT_NAMES:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return kw.value
+    elif name in _FACTORY_NAMES:
+        for kw in call.keywords:
+            if kw.arg == "donate":
+                return kw.value
+    return None
+
+
+def _literal_positions(expr: ast.expr) -> Set[int]:
+    return {
+        node.value
+        for node in ast.walk(expr)
+        if isinstance(node, ast.Constant) and isinstance(node.value, int)
+        and not isinstance(node.value, bool)
+    }
+
+
+#: an argument identity we can track across statements: a plain local name
+#: or a ``self.attr`` — anything else is a fresh temporary
+ArgKey = Tuple[str, str]  # ("name"|"selfattr", identifier)
+
+
+def _arg_key(expr: ast.expr) -> Optional[ArgKey]:
+    if isinstance(expr, ast.Name):
+        return ("name", expr.id)
+    if (
+        isinstance(expr, ast.Attribute)
+        and isinstance(expr.value, ast.Name)
+        and expr.value.id == "self"
+    ):
+        return ("selfattr", expr.attr)
+    return None
+
+
+def _keys_in(expr: ast.expr, ctx=ast.Load) -> Set[ArgKey]:
+    out: Set[ArgKey] = set()
+    for node in ast.walk(expr):
+        key = _arg_key(node) if isinstance(node, (ast.Name, ast.Attribute)) else None
+        if key is not None and isinstance(getattr(node, "ctx", None), ctx):
+            out.add(key)
+    return out
+
+
+@register
+class DonationSafetyRule(Rule):
+    """NX019: a buffer passed to a donated argnum position must be rebound
+    by the call statement or never referenced afterwards."""
+
+    rule_id = "NX019"
+    description = "donated buffers must not be referenced after the donating call"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        try:
+            graph = flow_for(project)
+        except Exception:  # noqa: BLE001 - no graph, no 1-hop propagation; NX020 reports the breakage
+            graph = None
+        #: (FunctionInfo qualname) -> [(param position, site description)]
+        param_donations: Dict[str, List[Tuple[FunctionInfo, int, str]]] = {}
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            yield from self._check_module(module, graph, param_donations)
+        if graph is not None:
+            yield from self._propagate_one_hop(graph, param_donations)
+
+    # -- per-module pass -------------------------------------------------------
+
+    def _check_module(self, module, graph, param_donations) -> Iterator[Finding]:
+        tree = module.tree
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+
+        #: id(class node) -> {attr: positions}
+        donated_attrs: Dict[int, Dict[str, Set[int]]] = {}
+        #: id(scope node) -> {name: positions}
+        donated_locals: Dict[int, Dict[str, Set[int]]] = {}
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            donate = _donate_kw(node)
+            if donate is None:
+                continue
+            positions = self._resolve_positions(donate, node, parents)
+            if positions is None:
+                if self._is_factory_param(donate, node, parents):
+                    continue  # the _make_jit body itself: checked at its call sites
+                yield self.finding(
+                    module,
+                    node,
+                    "donate expression does not resolve to literal argnum "
+                    "positions — NX019 cannot see which buffers this jit "
+                    "invalidates (fails closed); use a tuple literal or a "
+                    "class-level self._donate assignment",
+                )
+                continue
+            if not positions:
+                continue
+            target = self._bound_target(node, parents)
+            if target is None:
+                continue
+            kind, name, scope = target
+            if kind == "selfattr":
+                cls = self._enclosing(parents, node, ast.ClassDef)
+                if cls is not None:
+                    donated_attrs.setdefault(id(cls), {}).setdefault(name, set()).update(positions)
+            else:
+                donated_locals.setdefault(id(scope), {}).setdefault(name, set()).update(positions)
+
+        if not donated_attrs and not donated_locals:
+            return
+
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            cls = self._enclosing(parents, fn, ast.ClassDef)
+            attrs = donated_attrs.get(id(cls), {}) if cls is not None else {}
+            local_scopes = [donated_locals.get(id(fn), {})]
+            for node in frame_nodes(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                positions: Optional[Set[int]] = None
+                desc = ""
+                key = _arg_key(node.func)
+                if key is not None and key[0] == "selfattr" and key[1] in attrs:
+                    positions = attrs[key[1]]
+                    desc = f"self.{key[1]}"
+                elif isinstance(node.func, ast.Name):
+                    for scope_map in local_scopes:
+                        if node.func.id in scope_map:
+                            positions = scope_map[node.func.id]
+                            desc = node.func.id
+                            break
+                if positions is None:
+                    continue
+                yield from self._check_call(
+                    module, fn, node, positions, desc, parents, graph, param_donations
+                )
+
+    # -- donation-site resolution ----------------------------------------------
+
+    def _resolve_positions(
+        self, donate: ast.expr, site: ast.AST, parents
+    ) -> Optional[Set[int]]:
+        positions = _literal_positions(donate)
+        if positions:
+            return positions
+        # empty literal tuple: donation explicitly off
+        if isinstance(donate, ast.Tuple) and not donate.elts:
+            return set()
+        # self._donate: resolve through the class's own assignments, then
+        # its (same-module) base classes — the engines assign the policy in
+        # _ExecutorCommon and consume it from the concrete executors
+        key = _arg_key(donate)
+        if key is not None and key[0] == "selfattr":
+            cls = self._enclosing(parents, site, ast.ClassDef)
+            module_classes = self._module_classes(parents)
+            seen: Set[int] = set()
+            while cls is not None and id(cls) not in seen:
+                seen.add(id(cls))
+                found = False
+                out: Set[int] = set()
+                for node in ast.walk(cls):
+                    if (
+                        isinstance(node, ast.Assign)
+                        and any(_arg_key(t) == key for t in node.targets)
+                    ):
+                        found = True
+                        out.update(_literal_positions(node.value))
+                if found:
+                    return out
+                cls = next(
+                    (
+                        module_classes.get(base.id)
+                        for base in cls.bases
+                        if isinstance(base, ast.Name) and base.id in module_classes
+                    ),
+                    None,
+                )
+        return None
+
+    @staticmethod
+    def _module_classes(parents) -> Dict[str, ast.ClassDef]:
+        out: Dict[str, ast.ClassDef] = {}
+        for node in parents:
+            if isinstance(node, ast.ClassDef):
+                out.setdefault(node.name, node)
+        return out
+
+    @staticmethod
+    def _is_factory_param(donate: ast.expr, site: ast.AST, parents) -> bool:
+        if not isinstance(donate, ast.Name):
+            return False
+        cur = parents.get(site)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                args = cur.args
+                names = {
+                    a.arg
+                    for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]
+                }
+                return donate.id in names
+            cur = parents.get(cur)
+        return False
+
+    def _bound_target(self, call: ast.Call, parents):
+        """('selfattr'|'name', identifier, enclosing scope) when the jit
+        result is bound — ``self.X = jit(...)`` / ``f = jit(...)``."""
+        stmt = parents.get(call)
+        if not isinstance(stmt, ast.Assign) or stmt.value is not call:
+            return None
+        if len(stmt.targets) != 1:
+            return None
+        target = stmt.targets[0]
+        key = _arg_key(target)
+        if key is None:
+            return None
+        scope = self._enclosing(
+            parents, stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+        )
+        return (key[0], key[1], scope)
+
+    @staticmethod
+    def _enclosing(parents, node, kinds):
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, kinds):
+                return cur
+            cur = parents.get(cur)
+        return None
+
+    # -- call-site safety ------------------------------------------------------
+
+    def _check_call(
+        self, module, fn, call, positions, desc, parents, graph, param_donations
+    ) -> Iterator[Finding]:
+        stmt = self._enclosing_stmt(parents, call)
+        rebound: Set[ArgKey] = set()
+        if isinstance(stmt, ast.Assign):
+            for target in stmt.targets:
+                rebound |= _keys_in(target, ctx=(ast.Store,))
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            rebound |= _keys_in(stmt.target, ctx=(ast.Store,))
+        param_names = self._param_names(fn)
+        for pos in sorted(positions):
+            if pos >= len(call.args):
+                continue
+            key = _arg_key(call.args[pos])
+            if key is None:
+                continue  # fresh temporary: nothing can reference it later
+            if key in rebound:
+                continue  # the safe idiom: rebound by the donating statement
+            after = self._loaded_after(fn, stmt, key)
+            if after is not None:
+                yield self.finding(
+                    module,
+                    after,
+                    f"{self._key_desc(key)} was donated to {desc}() at line "
+                    f"{call.lineno} (donate position {pos}) and is referenced "
+                    "here afterwards — the device buffer is gone "
+                    "(DeviceStateLost); rebind it in the donating statement",
+                )
+            elif key[0] == "name" and key[1] in param_names and graph is not None:
+                info = graph.info_for(module, fn)
+                if info is not None:
+                    param_donations.setdefault(info.qualname, []).append(
+                        (info, param_names.index(key[1]), desc)
+                    )
+
+    @staticmethod
+    def _param_names(fn) -> List[str]:
+        args = fn.args
+        names = [a.arg for a in [*args.posonlyargs, *args.args]]
+        if names and names[0] == "self":
+            names = names[1:]
+        return names
+
+    def _enclosing_stmt(self, parents, node):
+        cur = node
+        while cur is not None:
+            parent = parents.get(cur)
+            if isinstance(cur, ast.stmt):
+                return cur
+            cur = parent
+        return node
+
+    @staticmethod
+    def _loaded_after(fn, stmt, key: ArgKey) -> Optional[ast.AST]:
+        """First load of ``key`` in ``fn``'s frame after ``stmt`` ends."""
+        end = getattr(stmt, "end_lineno", stmt.lineno)
+        for node in frame_nodes(fn):
+            if getattr(node, "lineno", 0) <= end:
+                continue
+            if not isinstance(getattr(node, "ctx", None), ast.Load):
+                continue
+            if _arg_key(node) == key if isinstance(node, (ast.Name, ast.Attribute)) else False:
+                return node
+        return None
+
+    @staticmethod
+    def _key_desc(key: ArgKey) -> str:
+        return f"self.{key[1]}" if key[0] == "selfattr" else f"'{key[1]}'"
+
+    # -- 1-hop propagation -----------------------------------------------------
+
+    def _propagate_one_hop(self, graph: CallGraph, param_donations) -> Iterator[Finding]:
+        if not param_donations:
+            return
+        #: id(def node) -> [(pos, jit desc)]
+        by_node: Dict[int, List[Tuple[int, str]]] = {}
+        for entries in param_donations.values():
+            for info, pos, desc in entries:
+                by_node.setdefault(id(info.node), []).append((pos, desc))
+        for idx in graph.indexes.values():
+            for fn in ast.walk(idx.module.tree):
+                if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                for node in frame_nodes(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee, _via in graph.resolve_call(node, idx.module):
+                        donated = by_node.get(id(callee.node))
+                        if not donated:
+                            continue
+                        stmt = self._enclosing_stmt(idx.parents, node)
+                        rebound: Set[ArgKey] = set()
+                        if isinstance(stmt, ast.Assign):
+                            for target in stmt.targets:
+                                rebound |= _keys_in(target, ctx=(ast.Store,))
+                        for pos, desc in donated:
+                            if pos >= len(node.args):
+                                continue
+                            key = _arg_key(node.args[pos])
+                            if key is None or key in rebound:
+                                continue
+                            after = self._loaded_after(fn, stmt, key)
+                            if after is not None:
+                                yield self.finding(
+                                    idx.module,
+                                    after,
+                                    f"{self._key_desc(key)} is referenced here "
+                                    f"after {callee.name}() (line {node.lineno}) "
+                                    f"forwarded it to donated jit {desc}() — "
+                                    "the device buffer is gone "
+                                    "(DeviceStateLost); rebind it in the "
+                                    "calling statement",
+                                )
+        return

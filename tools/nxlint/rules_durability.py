@@ -23,9 +23,11 @@ NX008  params hot-swap discipline (the NX007 contract's serving mirror,
 from __future__ import annotations
 
 import ast
+from collections import namedtuple
 from typing import Iterator, List, Optional, Set, Tuple
 
-from tools.nxlint.engine import Finding, Module, Rule, register
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.flow import CallGraph, flow_for
 
 #: ledger-publisher calls (method name, last attribute segment).  These are
 #: the ONLY sanctioned ways to write tensor_checkpoint_uri; their own
@@ -108,13 +110,125 @@ def _scope_statements(scope: ast.AST) -> List[ast.AST]:
     return out
 
 
+# -- the interprocedural leg (ISSUE 16) ----------------------------------------
+
+#: per-function effect summary for one barrier domain.  ``has_barrier``:
+#: the body references a barrier name (or calls a helper that does), so a
+#: call to this function counts as a barrier at the call site.
+#: ``unbarriered_sink``: the body reaches a sink with no preceding barrier
+#: (or IS a sanctioned sink def), so a call to this function inherits the
+#: sink's obligation — the caller must barrier first.
+_BarrierSummary = namedtuple("_BarrierSummary", "has_barrier unbarriered_sink")
+_NEUTRAL = _BarrierSummary(False, False)
+
+
+class _BarrierFlow:
+    """Flow context for one (module, domain): classifies resolved calls as
+    barrier-equivalent or sink-equivalent via bounded-depth summaries.
+
+    Summaries are computed on the raw AST — a ``# nxlint: disable`` on a
+    wrapper's body suppresses the wrapper's own finding (the sanctioned
+    seam) but never hides the effect, which is exactly how the barrier
+    obligation moves to the wrapper's callers."""
+
+    def __init__(
+        self,
+        graph: CallGraph,
+        module: Module,
+        domain: str,
+        sink_names: frozenset,
+        sink_defs: frozenset,
+        barrier_names: frozenset,
+        check_uri_key: bool,
+    ) -> None:
+        self.graph = graph
+        self.module = module
+        self.domain = domain
+        self.sink_names = sink_names
+        self.sink_defs = sink_defs
+        self.barrier_names = barrier_names
+        self.check_uri_key = check_uri_key
+
+    def _is_sink_call(self, node: ast.Call) -> bool:
+        if _last_segment(node.func) in self.sink_names:
+            return True
+        return self.check_uri_key and _writes_uri_key(node)
+
+    def _compute(self, fn, recurse) -> _BarrierSummary:
+        sink_lines: List[int] = []
+        barrier_lines: Set[int] = set()
+        for node in _scope_statements(fn.node):
+            if isinstance(node, ast.Call):
+                end = getattr(node, "end_lineno", None) or node.lineno
+                if self._is_sink_call(node):
+                    sink_lines.append(end)
+                else:
+                    for callee, _via in self.graph.resolve_call(node, fn.module):
+                        sub = recurse(callee)
+                        if sub.unbarriered_sink:
+                            sink_lines.append(end)
+                        elif sub.has_barrier:
+                            barrier_lines.add(node.lineno)
+            if isinstance(node, (ast.Attribute, ast.Name)):
+                if _last_segment(node) in self.barrier_names:
+                    barrier_lines.add(node.lineno)
+        if fn.name in self.sink_defs:
+            # the sanctioned sink itself: callers inherit the obligation
+            return _BarrierSummary(has_barrier=False, unbarriered_sink=True)
+        has_barrier = bool(barrier_lines)
+        unbarriered = any(
+            not any(b <= line for b in barrier_lines) for line in sink_lines
+        )
+        return _BarrierSummary(has_barrier and not unbarriered, unbarriered)
+
+    def _summary(self, callee) -> _BarrierSummary:
+        return self.graph.summarize(callee, self.domain, self._compute, _NEUTRAL)
+
+    def classify_call(self, node: ast.Call) -> Tuple[bool, Optional[str]]:
+        """(counts as barrier, sink label) for a call that is NOT itself a
+        lexical sink — resolved through the call graph."""
+        is_barrier = False
+        sink_label: Optional[str] = None
+        for callee, _via in self.graph.resolve_call(node, self.module):
+            sub = self._summary(callee)
+            if sub.unbarriered_sink and sink_label is None:
+                sink_label = (
+                    f"{_last_segment(node.func) or callee.name}() "
+                    f"(reaches a {'/'.join(sorted(self.sink_names))} sink "
+                    f"through {callee.name})"
+                )
+            if sub.has_barrier:
+                is_barrier = True
+        return is_barrier, sink_label
+
+    def alias_names(self, scope: ast.AST) -> Set[str]:
+        """Bound-method aliases of a sink in this frame:
+        ``publish = reporter.tensor_checkpoint`` — the classic lexical
+        blind spot (the later ``publish(uri, step)`` carries no sink
+        name)."""
+        out: Set[str] = set()
+        for node in _scope_statements(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr in self.sink_names
+            ):
+                out.add(node.targets[0].id)
+        return out
+
+
 def _publishers_and_barriers(
     scope: ast.AST,
+    flow: Optional[_BarrierFlow] = None,
 ) -> Tuple[List[Tuple[ast.Call, str]], Set[int]]:
     """(publisher calls with a label, line numbers where a barrier name is
-    referenced) within the scope's own frame."""
+    referenced) within the scope's own frame.  With ``flow``, calls that
+    RESOLVE to a helper summarized as barrier/sink count too."""
     publishers: List[Tuple[ast.Call, str]] = []
     barrier_lines: Set[int] = set()
+    aliases = flow.alias_names(scope) if flow is not None else set()
     for node in _scope_statements(scope):
         if isinstance(node, ast.Call):
             name = _last_segment(node.func)
@@ -122,6 +236,16 @@ def _publishers_and_barriers(
                 publishers.append((node, f"{name}()"))
             elif _writes_uri_key(node):
                 publishers.append((node, f"direct {_URI_KEY} write via {name or 'call'}()"))
+            elif isinstance(node.func, ast.Name) and node.func.id in aliases:
+                publishers.append(
+                    (node, f"{name}() (a bound alias of a ledger publisher)")
+                )
+            elif flow is not None:
+                is_barrier, sink_label = flow.classify_call(node)
+                if sink_label is not None:
+                    publishers.append((node, sink_label))
+                elif is_barrier:
+                    barrier_lines.add(node.lineno)
         # barrier: a call OR reference (asyncio.to_thread(self._resolver, ...)
         # passes the barrier as an argument) to a barrier-named attribute
         if isinstance(node, (ast.Attribute, ast.Name)):
@@ -131,13 +255,19 @@ def _publishers_and_barriers(
 
 
 class _DurabilityVisitor(ast.NodeVisitor):
-    def __init__(self, rule: "CheckpointPublishBarrierRule", module: Module) -> None:
+    def __init__(
+        self,
+        rule: "CheckpointPublishBarrierRule",
+        module: Module,
+        flow: Optional[_BarrierFlow] = None,
+    ) -> None:
         self.rule = rule
         self.module = module
+        self.flow = flow
         self.findings: List[Finding] = []
 
     def _check_scope(self, scope: ast.AST, scope_name: Optional[str]) -> None:
-        publishers, barrier_lines = _publishers_and_barriers(scope)
+        publishers, barrier_lines = _publishers_and_barriers(scope, self.flow)
         if not publishers:
             return
         if scope_name in _PUBLISHER_DEFS:
@@ -215,14 +345,30 @@ _SWAP_BARRIER_NAMES = frozenset(
 )
 
 
-def _swaps_and_barriers(scope: ast.AST) -> Tuple[List[ast.Call], Set[int]]:
-    """(swap_params call sites, line numbers where a verified-step
-    resolution is referenced) within the scope's own frame."""
-    swaps: List[ast.Call] = []
+def _swaps_and_barriers(
+    scope: ast.AST,
+    flow: Optional[_BarrierFlow] = None,
+) -> Tuple[List[Tuple[ast.Call, str]], Set[int]]:
+    """(swap call sites with a label, line numbers where a verified-step
+    resolution is referenced) within the scope's own frame.  With
+    ``flow``, calls resolving to a helper summarized as verified-step
+    resolution / swap wrapper count too."""
+    swaps: List[Tuple[ast.Call, str]] = []
     barrier_lines: Set[int] = set()
+    aliases = flow.alias_names(scope) if flow is not None else set()
     for node in _scope_statements(scope):
-        if isinstance(node, ast.Call) and _last_segment(node.func) in _SWAP_CALLS:
-            swaps.append(node)
+        if isinstance(node, ast.Call):
+            name = _last_segment(node.func)
+            if name in _SWAP_CALLS:
+                swaps.append((node, "swap_params()"))
+            elif isinstance(node.func, ast.Name) and node.func.id in aliases:
+                swaps.append((node, f"{name}() (a bound alias of swap_params)"))
+            elif flow is not None:
+                is_barrier, sink_label = flow.classify_call(node)
+                if sink_label is not None:
+                    swaps.append((node, sink_label))
+                elif is_barrier:
+                    barrier_lines.add(node.lineno)
         if isinstance(node, (ast.Attribute, ast.Name)):
             if _last_segment(node) in _SWAP_BARRIER_NAMES:
                 barrier_lines.add(node.lineno)
@@ -230,18 +376,24 @@ def _swaps_and_barriers(scope: ast.AST) -> Tuple[List[ast.Call], Set[int]]:
 
 
 class _SwapVisitor(ast.NodeVisitor):
-    def __init__(self, rule: "ParamsSwapBarrierRule", module: Module) -> None:
+    def __init__(
+        self,
+        rule: "ParamsSwapBarrierRule",
+        module: Module,
+        flow: Optional[_BarrierFlow] = None,
+    ) -> None:
         self.rule = rule
         self.module = module
+        self.flow = flow
         self.findings: List[Finding] = []
 
     def _check_scope(self, scope: ast.AST, scope_name: Optional[str]) -> None:
-        swaps, barrier_lines = _swaps_and_barriers(scope)
+        swaps, barrier_lines = _swaps_and_barriers(scope, self.flow)
         if not swaps:
             return
         if scope_name in _SWAP_DEFS:
             return  # the sink chain itself; the obligation sits with callers
-        for call in swaps:
+        for call, label in swaps:
             # <= end_lineno, same rationale as NX007: the barrier may BE an
             # argument of the swap call, possibly formatter-wrapped —
             # engine.swap_params(ckpt.restore_params(step)) is maximally safe
@@ -251,7 +403,7 @@ class _SwapVisitor(ast.NodeVisitor):
                     self.rule.finding(
                         self.module,
                         call,
-                        "swap_params() installs weights with no preceding "
+                        f"{label} installs weights with no preceding "
                         "verified-step resolution in this scope — resolve "
                         "the step first (restore_params()/"
                         "latest_verified_step()/verify_step()) so a live "
@@ -281,25 +433,56 @@ class _SwapVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+def _graph_or_none(rule: Rule, project: Project) -> Optional[CallGraph]:
+    """The shared CallGraph, or None — rules degrade to their lexical pass
+    when flow is disabled (tests pin each pass separately) or the graph
+    failed to build (NX020 reports that loudly)."""
+    if not getattr(rule, "flow_enabled", True):
+        return None
+    try:
+        return flow_for(project)
+    except Exception:  # noqa: BLE001 - fallback contract: ANY graph failure degrades to lexical; NX020 owns reporting it
+        return None
+
+
 @register
 class ParamsSwapBarrierRule(Rule):
     """NX008: live-engine weight swaps only behind a verified-step
     resolution.  Fails closed: EVERY call spelled ``*.swap_params(...)`` is
     flagged unless a verified-step-resolution name lexically precedes it in
-    the same function scope (same conservative lexical analysis as NX007 —
-    the repo-clean gate plus the rollout chaos drills cover the dynamic
-    side; this rule stops the honest mistake of swapping whatever
-    ``latest_step()`` returned)."""
+    the same function scope — and, through the call graph (ISSUE 16), so
+    is any call RESOLVING to a helper that wraps the swap (including a
+    bound-method alias), while a call to a helper whose body performs the
+    verified-step resolution counts as the barrier.  With flow disabled or
+    broken the rule degrades to the pure lexical pass (the repo-clean gate
+    plus the rollout chaos drills cover the dynamic side; this rule stops
+    the honest mistake of swapping whatever ``latest_step()`` returned)."""
 
     rule_id = "NX008"
     description = "swap_params call sites need a preceding verified-step resolution"
+    flow_enabled = True
 
-    def check_module(self, module: Module) -> Iterator[Finding]:
-        if module.tree is None:
-            return
-        visitor = _SwapVisitor(self, module)
-        visitor.visit(module.tree)
-        yield from visitor.findings
+    def _flow(self, project: Project, module: Module) -> Optional[_BarrierFlow]:
+        graph = _graph_or_none(self, project)
+        if graph is None:
+            return None
+        return _BarrierFlow(
+            graph,
+            module,
+            domain="nx008",
+            sink_names=_SWAP_CALLS,
+            sink_defs=_SWAP_DEFS,
+            barrier_names=_SWAP_BARRIER_NAMES,
+            check_uri_key=False,
+        )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            visitor = _SwapVisitor(self, module, self._flow(project, module))
+            visitor.visit(module.tree)
+            yield from visitor.findings
 
 
 @register
@@ -309,18 +492,38 @@ class CheckpointPublishBarrierRule(Rule):
     publisher (``.tensor_checkpoint(...)``, ``.checkpoint_rollback(...)``,
     or any call passing a dict literal with the ``tensor_checkpoint_uri``
     key) is flagged unless a barrier-named call/reference lexically precedes
-    it in the same function scope.  Lexical-precedence is deliberately
-    conservative static analysis — a barrier on a dead branch passes, but
-    the repo-clean gate plus the chaos drills (tests/test_checkpoint_chaos)
-    cover the dynamic side; this rule stops the honest mistake of
-    publishing right after ``save()``."""
+    it in the same function scope.  The interprocedural leg (ISSUE 16)
+    extends both sides through the call graph: a call resolving to a
+    helper that publishes without its own barrier (or to a bound alias of
+    a publisher) inherits the obligation at the CALL SITE, and a call to a
+    helper whose body runs the barrier counts as the barrier.  With flow
+    disabled or broken the rule degrades to the pure lexical pass —
+    deliberately conservative static analysis either way; the repo-clean
+    gate plus the chaos drills (tests/test_checkpoint_chaos) cover the
+    dynamic side."""
 
     rule_id = "NX007"
     description = "tensor_checkpoint_uri writes need a preceding durability barrier"
+    flow_enabled = True
 
-    def check_module(self, module: Module) -> Iterator[Finding]:
-        if module.tree is None:
-            return
-        visitor = _DurabilityVisitor(self, module)
-        visitor.visit(module.tree)
-        yield from visitor.findings
+    def _flow(self, project: Project, module: Module) -> Optional[_BarrierFlow]:
+        graph = _graph_or_none(self, project)
+        if graph is None:
+            return None
+        return _BarrierFlow(
+            graph,
+            module,
+            domain="nx007",
+            sink_names=_PUBLISHER_CALLS,
+            sink_defs=_PUBLISHER_DEFS,
+            barrier_names=_BARRIER_NAMES,
+            check_uri_key=True,
+        )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            visitor = _DurabilityVisitor(self, module, self._flow(project, module))
+            visitor.visit(module.tree)
+            yield from visitor.findings

@@ -1,0 +1,250 @@
+"""NX018: NEXUS_* env / config / docs parity (ISSUE 16).
+
+The launcher contract is the env surface: every ``NEXUS_*`` variable the
+tree reads is an operator-facing knob, and the only place an operator can
+discover it is ``docs/ENVIRONMENT.md``.  This rule keeps the three views
+welded together, two-way:
+
+* every ``NEXUS_*`` read in the scanned tree must have a row in the
+  docs env table (undocumented knob -> finding at the READ site);
+* every row in the docs env table must still have at least one read
+  (stale row -> finding against the docs table, so a renamed knob cannot
+  leave its documentation behind);
+* each row's "Parsed at" module list must name only modules that really
+  read the variable (a moved parse site must move its row).
+
+Reads are detected structurally, not by grep: ``environ[K]`` /
+``environ.get(K)`` / ``environ.pop(K)`` / ``os.getenv(K)`` / ``K in
+environ`` where the mapping's terminal name is an environ alias and ``K``
+is a string literal or a module-level ``ENV_FOO = "NEXUS_..."`` constant.
+A ``NEXUS_``-prefixed key the rule cannot resolve to a literal fails
+CLOSED (the parity set would silently lose a knob).  ``NEXUS__*`` (double
+underscore) is the generic config-overlay namespace handled by
+``core/config.py`` and is exempt — its keys are field-derived, not a
+fixed catalog.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+
+ENV_DOC_PATH = "docs/ENVIRONMENT.md"
+
+#: terminal names an environ mapping travels under in this tree:
+#: ``os.environ``, a bare ``environ`` import, and the ``from_env(e)`` /
+#: ``def parse(env)`` parameter idioms of the config parsers
+_ENV_BASES = frozenset({"environ", "env", "e", "_e", "_env"})
+
+_VAR_RE = re.compile(r"^NEXUS_[A-Z0-9][A-Z0-9_]*$")
+_OVERLAY_PREFIX = "NEXUS__"
+
+#: docs table row: | `NEXUS_X` | type | `a.py`, `b.py` | description |
+_ROW_RE = re.compile(r"^\|\s*`(NEXUS_[A-Z0-9_]+)`\s*\|([^|]*)\|([^|]*)\|(.*)\|\s*$")
+
+
+def _terminal(expr: ast.expr) -> Optional[str]:
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    return None
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``ENV_FOO = "NEXUS_..."`` string constants."""
+    out: Dict[str, str] = {}
+    for stmt in tree.body:
+        if (
+            isinstance(stmt, ast.Assign)
+            and len(stmt.targets) == 1
+            and isinstance(stmt.targets[0], ast.Name)
+            and isinstance(stmt.value, ast.Constant)
+            and isinstance(stmt.value.value, str)
+        ):
+            out[stmt.targets[0].id] = stmt.value.value
+    return out
+
+
+def _key_exprs(tree: ast.Module) -> Iterator[Tuple[ast.AST, ast.expr]]:
+    """(report node, key expression) for every structural env read."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            func = node.func
+            if (
+                isinstance(func, ast.Attribute)
+                and func.attr in ("get", "pop")
+                and _terminal(func.value) in _ENV_BASES
+                and node.args
+            ):
+                yield node, node.args[0]
+            elif _terminal(func) == "getenv" and node.args:
+                yield node, node.args[0]
+        elif (
+            isinstance(node, ast.Subscript)
+            and _terminal(node.value) in _ENV_BASES
+            and isinstance(node.ctx, ast.Load)
+        ):
+            yield node, node.slice
+        elif (
+            isinstance(node, ast.Compare)
+            and len(node.ops) == 1
+            and isinstance(node.ops[0], (ast.In, ast.NotIn))
+            and _terminal(node.comparators[0]) in _ENV_BASES
+        ):
+            yield node, node.left
+
+
+def env_reads(module: Module) -> Tuple[List[Tuple[ast.AST, str]], List[ast.AST]]:
+    """(resolved NEXUS_* reads, unresolvable NEXUS-suspect key sites)."""
+    reads: List[Tuple[ast.AST, str]] = []
+    unresolved: List[ast.AST] = []
+    if module.tree is None:
+        return reads, unresolved
+    constants = _module_constants(module.tree)
+    for node, key in _key_exprs(module.tree):
+        value: Optional[str] = None
+        if isinstance(key, ast.Constant) and isinstance(key.value, str):
+            value = key.value
+        elif isinstance(key, ast.Name):
+            value = constants.get(key.id)
+            if value is None:
+                # not a module-level string constant: a loop variable or
+                # parameter — only suspect when the name itself says env
+                if key.id.upper().startswith("ENV_"):
+                    unresolved.append(node)
+                continue
+        else:
+            # f-string / concatenation building a key: suspect when any
+            # literal fragment carries the NEXUS_ prefix
+            fragments = [
+                c.value
+                for c in ast.walk(key)
+                if isinstance(c, ast.Constant) and isinstance(c.value, str)
+            ]
+            if any(f.startswith("NEXUS_") for f in fragments):
+                unresolved.append(node)
+            continue
+        if value.startswith(_OVERLAY_PREFIX):
+            continue
+        if _VAR_RE.match(value):
+            reads.append((node, value))
+    return reads, unresolved
+
+
+def parse_doc_rows(text: str) -> List[Tuple[int, str, str, List[str], str]]:
+    """(line, var, type, parsed-at rel-paths, description) per table row."""
+    rows = []
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        match = _ROW_RE.match(line.strip())
+        if not match:
+            continue
+        var, type_col, parsed_at, desc = match.groups()
+        paths = [p.strip().strip("`") for p in parsed_at.split(",") if p.strip()]
+        rows.append((lineno, var, type_col.strip(), paths, desc.strip()))
+    return rows
+
+
+@register
+class EnvDocsParityRule(Rule):
+    """NX018: every NEXUS_* env read documented in docs/ENVIRONMENT.md,
+    every documented row still read, parse-site column accurate."""
+
+    rule_id = "NX018"
+    description = "NEXUS_* env reads and docs/ENVIRONMENT.md must agree two-way"
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        #: var -> [(module, node)], in scan order
+        read_sites: Dict[str, List[Tuple[Module, ast.AST]]] = {}
+        any_read = False
+        for module in project.modules:
+            reads, unresolved = env_reads(module)
+            for node, var in reads:
+                any_read = True
+                read_sites.setdefault(var, []).append((module, node))
+            for node in unresolved:
+                any_read = True
+                yield self.finding(
+                    module,
+                    node,
+                    "env read with a key NX018 cannot resolve to a NEXUS_* "
+                    "literal — the env/docs parity set would silently lose "
+                    "this knob; use a string literal or a module-level "
+                    "ENV_* constant (fails closed)",
+                )
+        if not any_read:
+            return  # tree without an env surface has nothing to document
+
+        doc_file = os.path.join(project.root, ENV_DOC_PATH)
+        anchor = next((m for m in project.modules if m.tree is not None), None)
+        if anchor is None:
+            return
+        try:
+            with open(doc_file, "r", encoding="utf-8") as fh:
+                doc_text = fh.read()
+        except OSError:
+            yield self.finding(
+                anchor,
+                anchor.tree,
+                f"{ENV_DOC_PATH} is missing but the tree reads "
+                f"{len(read_sites)} NEXUS_* variable(s) — the env surface "
+                "must be documented (fails closed)",
+            )
+            return
+
+        rows = parse_doc_rows(doc_text)
+        documented: Dict[str, Tuple[int, str, List[str]]] = {}
+        for lineno, var, type_col, paths, _desc in rows:
+            documented[var] = (lineno, type_col, paths)
+
+        for var in sorted(read_sites):
+            module, node = read_sites[var][0]
+            if var not in documented:
+                yield self.finding(
+                    module,
+                    node,
+                    f"{var} is read here but has no row in {ENV_DOC_PATH} — "
+                    "add it to the env table (Variable | Type | Parsed at | "
+                    "Description)",
+                )
+                continue
+            lineno, type_col, doc_paths = documented[var]
+            if not type_col:
+                yield self.finding(
+                    module,
+                    node,
+                    f"{var}'s row in {ENV_DOC_PATH}:{lineno} has an empty "
+                    "Type column",
+                )
+            actual = {m.rel_path for m, _n in read_sites[var]}
+            for path in doc_paths:
+                if not any(a == path or a.endswith("/" + path) for a in actual):
+                    yield self.finding(
+                        module,
+                        node,
+                        f"{var}'s row in {ENV_DOC_PATH}:{lineno} says it is "
+                        f"parsed at {path}, but no scanned read site lives "
+                        f"there (actual: {', '.join(sorted(actual))}) — the "
+                        "parse site moved without its docs row",
+                    )
+
+        for var, (lineno, _type_col, doc_paths) in sorted(documented.items()):
+            if var in read_sites:
+                continue
+            # scope gate: a partial scan (tpu_nexus/ alone, tools/ alone,
+            # --changed fast path) must not call the OTHER tree's rows
+            # stale — a row is only judged when at least one of its
+            # declared parse-site modules is in this lint invocation
+            if not any(project.find_module(p) is not None for p in doc_paths):
+                continue
+            yield self.finding(
+                anchor,
+                anchor.tree,
+                f"{ENV_DOC_PATH}:{lineno} documents {var} but nothing in "
+                "the scanned tree reads it — stale row (renamed or "
+                "removed knob)",
+            )

@@ -21,6 +21,7 @@ import re
 from typing import Dict, Iterator, Optional, Set, Tuple
 
 from tools.nxlint.engine import Finding, Module, Project, Rule, RuleVisitor, register
+from tools.nxlint.flow import CallGraph, FunctionInfo, flow_for
 from tools.nxlint.rules_control import _attr_names, _module_assign
 
 REQUEST_PATH = "serving/request.py"
@@ -522,41 +523,89 @@ class DispatchLoopReadbackRule(Rule):
         "no blocking host readback on step results in the engine dispatch "
         "loop outside the _materialize* seam"
     )
+    flow_enabled = True
 
-    def check_module(self, module: Module) -> Iterator[Finding]:
-        if module.tree is None:
-            return
-        if (
-            module.rel_path.endswith(OVERLAP_PATH)
-            or module.rel_path.endswith(SHARDED_PATH)
-            or module.rel_path.endswith(TRACING_PATH)
-            or module.rel_path.endswith(LOADSTATS_PATH)
-        ):
-            yield from self._scan(module, module.tree.body)
-            return
-        if not module.rel_path.endswith(ENGINE_PATH):
-            return
-        engine_cls = next(
-            (
-                n
-                for n in module.tree.body
-                if isinstance(n, ast.ClassDef) and n.name == ENGINE_CLASS
-            ),
-            None,
-        )
-        if engine_cls is None:
-            # fail CLOSED: a renamed engine class must not silently drop
-            # the dispatch loop out of coverage (NX005's contract)
-            yield self.finding(
-                module,
-                module.tree,
-                f"{ENGINE_CLASS} class not found in {module.rel_path} — "
-                "dispatch-loop readback discipline unverifiable",
+    #: resolution edges the readback summary follows: plain functions
+    #: within the serving package (the dispatch plane — including modules
+    #: like serving/metrics.py that the lexical scope list never reads)
+    #: plus the engine's OWN self-methods.  Method calls on OTHER objects
+    #: — ``executor.step(...)``, ``drafter.propose(...)`` — are
+    #: deliberately NOT followed: the executors' synchronous entry points
+    #: ARE the blocking oracle path (see class docstring).  Helpers
+    #: outside serving/ (``build_mesh``'s host-side device-list
+    #: ``np.asarray``, config parsing) are construction-time utilities,
+    #: not step-result readbacks, and are not followed either.
+    @staticmethod
+    def _follow(callee: FunctionInfo, via: str) -> bool:
+        if "serving/" not in callee.module.rel_path:
+            return False
+        if via in ("local", "module-def", "import", "module"):
+            return True
+        return via == "self" and callee.class_name == ENGINE_CLASS
+
+    def _readback_summary(self, graph: CallGraph, callee: FunctionInfo) -> bool:
+        def compute(fn: FunctionInfo, recurse) -> bool:
+            if fn.name.startswith(MATERIALIZE_PREFIX):
+                return False  # the sanctioned seam owns its readbacks
+            stack = list(fn.node.body)
+            while stack:
+                node = stack.pop()
+                if isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ) and node.name.startswith(MATERIALIZE_PREFIX):
+                    continue
+                if isinstance(node, ast.Call):
+                    if _blocking_readback(node) is not None:
+                        return True
+                    for sub, via in graph.resolve_call(node, fn.module):
+                        if self._follow(sub, via) and recurse(sub):
+                            return True
+                stack.extend(ast.iter_child_nodes(node))
+            return False
+
+        return bool(graph.summarize(callee, "nx014", compute, False))
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        graph = None
+        if self.flow_enabled:
+            try:
+                graph = flow_for(project)
+            except Exception:  # noqa: BLE001 - fallback contract: graph failure degrades to lexical; NX020 reports it
+                graph = None
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            if (
+                module.rel_path.endswith(OVERLAP_PATH)
+                or module.rel_path.endswith(SHARDED_PATH)
+                or module.rel_path.endswith(TRACING_PATH)
+                or module.rel_path.endswith(LOADSTATS_PATH)
+            ):
+                yield from self._scan(module, module.tree.body, graph)
+                continue
+            if not module.rel_path.endswith(ENGINE_PATH):
+                continue
+            engine_cls = next(
+                (
+                    n
+                    for n in module.tree.body
+                    if isinstance(n, ast.ClassDef) and n.name == ENGINE_CLASS
+                ),
+                None,
             )
-            return
-        yield from self._scan(module, engine_cls.body)
+            if engine_cls is None:
+                # fail CLOSED: a renamed engine class must not silently drop
+                # the dispatch loop out of coverage (NX005's contract)
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"{ENGINE_CLASS} class not found in {module.rel_path} — "
+                    "dispatch-loop readback discipline unverifiable",
+                )
+                continue
+            yield from self._scan(module, engine_cls.body, graph)
 
-    def _scan(self, module: Module, stmts) -> Iterator[Finding]:
+    def _scan(self, module: Module, stmts, graph: Optional[CallGraph]) -> Iterator[Finding]:
         stack = list(stmts)
         while stack:
             node = stack.pop()
@@ -576,4 +625,24 @@ class DispatchLoopReadbackRule(Rule):
                         "deferred seam); anything else silently "
                         "re-serializes the overlapped engine",
                     )
+                elif graph is not None:
+                    # the interprocedural leg (ISSUE 16): a helper wrapping
+                    # the readback — in this module or any other — is the
+                    # same serialization, one call hop away
+                    for callee, via in graph.resolve_call(node, module):
+                        if self._follow(callee, via) and self._readback_summary(
+                            graph, callee
+                        ):
+                            yield self.finding(
+                                module,
+                                node,
+                                f"call to {callee.name}() performs a blocking "
+                                "host readback (through the call graph) in "
+                                "the engine dispatch loop — step results may "
+                                f"only materialize inside a "
+                                f"{MATERIALIZE_PREFIX}* method (the deferred "
+                                "seam); anything else silently re-serializes "
+                                "the overlapped engine",
+                            )
+                            break
             stack.extend(ast.iter_child_nodes(node))

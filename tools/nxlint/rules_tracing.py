@@ -21,6 +21,7 @@ from tools.nxlint.engine import (
     RuleVisitor,
     register,
 )
+from tools.nxlint.flow import CallGraph, flow_for
 
 MESH_PATH = "parallel/mesh.py"
 
@@ -144,11 +145,10 @@ class _FunctionIndex:
         return None
 
 
-def traced_functions(tree: ast.Module) -> Set[ast.AST]:
-    """Function defs that run under a JAX trace: tracing decorators, or the
-    function (possibly through one ``partial`` alias) passed by name to a
-    tracing entry point — resolved lexically from the call site."""
-    index = _FunctionIndex(tree)
+def seed_traced_functions(tree: ast.Module, index: _FunctionIndex) -> Set[ast.AST]:
+    """The DIRECTLY traced defs: tracing decorators, or the function
+    (possibly through one ``partial`` alias) passed by name to a tracing
+    entry point — resolved lexically from the call site."""
     traced: Set[ast.AST] = set()
     for node in index.all_functions():
         if any(_is_tracing_decorator(d) for d in node.decorator_list):
@@ -163,6 +163,16 @@ def traced_functions(tree: ast.Module) -> Set[ast.AST]:
                 fn = index.resolve(arg.id, node)
                 if fn is not None:
                     traced.add(fn)
+    return traced
+
+
+def traced_functions(tree: ast.Module) -> Set[ast.AST]:
+    """Function defs that run under a JAX trace, closed transitively over
+    same-module name calls (the LEXICAL pass — the flow-backed closure in
+    :class:`HostSyncInJitRule` also follows ``self.method`` and imported
+    helpers through the call graph)."""
+    index = _FunctionIndex(tree)
+    traced = seed_traced_functions(tree, index)
     # transitive closure: a function called by name from a traced body is
     # itself traced (helpers like a sampler called inside a scanned body)
     changed = True
@@ -334,24 +344,109 @@ class HostSyncInJitRule(Rule):
     """NX010: ``.item()`` / ``float()``/``int()`` casts / ``np.array`` /
     ``jax.device_get`` / ``print`` inside functions that run under
     ``jax.jit`` / ``shard_map`` / ``lax`` control flow.  On TPU these either
-    fail at trace time or silently freeze a trace-time constant."""
+    fail at trace time or silently freeze a trace-time constant.
+
+    With the call graph available (ISSUE 16) the traced closure also
+    follows ``self.method()`` calls through the enclosing class and
+    from-imported helpers into their defining modules — a sampler moved
+    from the jitted body into a sibling module stays covered.  When the
+    graph cannot be built the per-module lexical closure still runs
+    (NX020 reports the breakage)."""
 
     rule_id = "NX010"
     description = "no host-synchronizing ops inside traced functions"
+    #: flip off to force the lexical fallback (also the behavior when the
+    #: call graph fails to build)
+    flow_enabled = True
 
-    def check_module(self, module: Module) -> Iterator[Finding]:
+    #: provenance edges the traced closure follows.  "attr"/"var" edges
+    #: (instance methods through inferred attribute types) are excluded:
+    #: an object handed INTO a jitted function is a static argument, and
+    #: following its methods would drag untraced config helpers in.
+    _FOLLOW_VIAS = frozenset({"local", "module-def", "import", "self"})
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        graph: Optional[CallGraph] = None
+        if self.flow_enabled:
+            try:
+                graph = flow_for(project)
+            except Exception:  # noqa: BLE001 - graph failure degrades to the lexical pass; NX020 reports it
+                graph = None
+        if graph is None:
+            for module in project.modules:
+                yield from self._check_module_lexical(module)
+            return
+        yield from self._check_project_flow(project, graph)
+
+    def _check_module_lexical(self, module: Module) -> Iterator[Finding]:
         if module.tree is None:
             return
         seen: Set[Tuple[int, int, str]] = set()
         for fn in traced_functions(module.tree):
-            taint = _TaintTracker(fn)
-            visitor = _HostSyncVisitor(self, module, taint)
-            self._scan(fn.body, visitor, taint)
-            for finding in visitor.findings:
-                key = (finding.line, finding.col, finding.message)
-                if key not in seen:
-                    seen.add(key)
-                    yield finding
+            yield from self._scan_traced(fn, module, seen)
+
+    def _check_project_flow(
+        self, project: Project, graph: CallGraph
+    ) -> Iterator[Finding]:
+        indexes: Dict[str, _FunctionIndex] = {}
+        #: id(def node) -> (def node, module it lives in)
+        traced: Dict[int, Tuple[ast.AST, Module]] = {}
+        for module in project.modules:
+            if module.tree is None:
+                continue
+            index = _FunctionIndex(module.tree)
+            indexes[module.rel_path] = index
+            for fn in seed_traced_functions(module.tree, index):
+                traced[id(fn)] = (fn, module)
+        changed = True
+        while changed:
+            changed = False
+            for fn, module in list(traced.values()):
+                index = indexes[module.rel_path]
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    for callee, mod in self._call_targets(node, module, index, graph):
+                        if id(callee) not in traced:
+                            traced[id(callee)] = (callee, mod)
+                            changed = True
+        seen_by_module: Dict[str, Set[Tuple[int, int, str]]] = {}
+        for fn, module in traced.values():
+            seen = seen_by_module.setdefault(module.rel_path, set())
+            yield from self._scan_traced(fn, module, seen)
+
+    def _call_targets(
+        self,
+        node: ast.Call,
+        module: Module,
+        index: _FunctionIndex,
+        graph: CallGraph,
+    ) -> List[Tuple[ast.AST, Module]]:
+        """Defs this call pulls into the traced closure.  Lexical (partial-
+        aware) resolution wins for plain names; the graph adds the
+        cross-module and ``self.method`` edges the lexical pass cannot
+        see."""
+        if isinstance(node.func, ast.Name):
+            local = index.resolve(node.func.id, node)
+            if local is not None:
+                return [(local, module)]
+        return [
+            (info.node, info.module)
+            for info, via in graph.resolve_call(node, module)
+            if via in self._FOLLOW_VIAS
+        ]
+
+    def _scan_traced(
+        self, fn: ast.AST, module: Module, seen: Set[Tuple[int, int, str]]
+    ) -> Iterator[Finding]:
+        taint = _TaintTracker(fn)
+        visitor = _HostSyncVisitor(self, module, taint)
+        self._scan(fn.body, visitor, taint)
+        for finding in visitor.findings:
+            key = (finding.line, finding.col, finding.message)
+            if key not in seen:
+                seen.add(key)
+                yield finding
 
     def _scan(self, stmts, visitor: _HostSyncVisitor, taint: _TaintTracker) -> None:
         """Statement-ordered scan so taint bindings apply before later uses;

@@ -81,7 +81,7 @@ from tpu_nexus.workload.health import (
     classified_failure_text,
     hang_cause,
 )
-from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+from tpu_nexus.workload.tensor_checkpoint import CheckpointError, TensorCheckpointer
 from tpu_nexus.workload.train import (
     TrainConfig,
     batch_shardings,
@@ -381,7 +381,10 @@ def _restore_train_state(
         legacy_template = {k: v for k, v in state.items() if k != "health"}
         try:
             restored = ckpt.restore(legacy_template, step)
-        except Exception:  # noqa: BLE001 - migration probe failed: surface the ORIGINAL structure error, not the probe's
+        except (CheckpointError, OSError, ValueError, KeyError, TypeError):
+            # the probe shares restore's failure surface (classified
+            # Checkpoint* verdicts, I/O, structure mismatch); whichever
+            # fires, surface the ORIGINAL structure error, not the probe's
             raise exc from None
         logger.info(
             "restored pre-health checkpoint at step %d (sentinel state reseeded)",

@@ -447,7 +447,11 @@ class StepWatchdog:
             step, deadline = armed
             if time.monotonic() < deadline:
                 continue
-            self.fired = True
+            with self._lock:
+                # published under the lock so the harness thread observing
+                # `fired` after a join-timeout sees it together with the
+                # armed-state it was derived from
+                self.fired = True
             logger.error(
                 "step-hang watchdog: step %d exceeded its %.3gs deadline",
                 step, self.timeout_s,

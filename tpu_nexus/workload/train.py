@@ -260,7 +260,10 @@ def state_shardings(init_fn, key, model, mesh, rules) -> Any:
             # mirror the param TREE but hold rank-1 row/col factors whose
             # shapes the param shardings do not fit — those replicate
             return [leaf.shape for leaf in jax.tree.leaves(subtree)] == param_shapes
-        except Exception:  # noqa: BLE001 - unhashable/exotic pytree nodes: not a param mirror
+        except (TypeError, ValueError):
+            # unhashable/exotic pytree nodes (TypeError from structure
+            # hashing, ValueError from registry flattening): not a param
+            # mirror either way
             return False
 
     def subtree_sharding(subtree):
