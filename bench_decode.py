@@ -19,8 +19,17 @@ this script prints carries:
                     cache is int8);
   * ``x_floor``   — ms_step / floor_ms, the honest "how done is this" number.
 
-Variants: bf16 | int8 weights | int8 KV cache | int8 weights + int8 KV
-(``NEXUS_DECODE_VARIANTS`` to restrict, comma-separated).
+Variants: bf16 | int8 weights | int4 weights | int8 KV cache | int8
+weights + int8 KV (``NEXUS_DECODE_VARIANTS`` to restrict,
+comma-separated).
+
+Weight-quantized variants (``int8w``/``int4w``) are additionally measured
+per WEIGHT-matmul implementation: the fused dequant-inside-matmul pallas
+kernel (``ops/quant_matmul.py``) AND the XLA gather/astype fallback, so
+the kernel's win is read off the same table as the decode-attention
+kernel's (``wq_kernel`` field; ``NEXUS_DECODE_WQ_KERNELS`` to restrict).
+Off TPU the "pallas" rows run the kernel in interpret mode — a
+correctness floor, not a speed number (PERF.md prices the TPU roofline).
 
 Every variant is measured per decode-attention implementation — the fused
 split-KV pallas kernel (``ops/decode_attention.py``) AND the masked-einsum
@@ -96,7 +105,7 @@ def main() -> None:
     if env_shapes:
         shapes = [tuple(int(x) for x in s.split("x")) for s in env_shapes.split(",")]
 
-    known_variants = ("bf16", "int8w", "int8kv", "int8w+int8kv")
+    known_variants = ("bf16", "int8w", "int4w", "int8kv", "int8w+int8kv")
     variants = list(known_variants)
     env_variants = os.environ.get("NEXUS_DECODE_VARIANTS")
     if env_variants:
@@ -136,8 +145,24 @@ def main() -> None:
         _init = llama_init
     params = _init(jax.random.PRNGKey(0), cfg)
     qparams = quantize_params(params)
+    qparams4 = quantize_params(params, mode="int4")
     w_bytes_full = quantized_bytes(params)
     w_bytes_int8 = quantized_bytes(qparams)
+    w_bytes_int4 = quantized_bytes(qparams4)
+
+    # weight-matmul implementations for the quantized-weight variants:
+    # "pallas" pins the fused dequant kernel (interpret mode off TPU),
+    # "xla" pins the gather/astype fallback — the kernel-on/off pair the
+    # ISSUE 17 BENCH artifact reads its win from
+    wq_kernels = ("pallas", "xla")
+    env_wq = os.environ.get("NEXUS_DECODE_WQ_KERNELS")
+    if env_wq:
+        wq_kernels = tuple(env_wq.split(","))
+        bad = [kn for kn in wq_kernels if kn not in ("auto", "pallas", "xla")]
+        if bad:
+            raise SystemExit(
+                f"unknown NEXUS_DECODE_WQ_KERNELS {bad}; use auto, pallas, xla"
+            )
 
     l, hkv, d = cfg.n_layers, cfg.n_kv_heads, cfg.head_dim
 
@@ -153,19 +178,39 @@ def main() -> None:
         )
         for variant in variants:
           for kernel in kernels:
-            p = qparams if "int8w" in variant else params
+           for wq_kernel in (wq_kernels if "int8w" in variant or "int4w" in variant else ("",)):
+            if "int4w" in variant:
+                p = qparams4
+            elif "int8w" in variant:
+                p = qparams
+            else:
+                p = params
             kv_quant = "int8" if "int8kv" in variant else ""
 
-            def run(n_tokens, p=p, kv_quant=kv_quant, kernel=kernel):
-                fn = jax.jit(
-                    functools.partial(
-                        generate, cfg=cfg, max_new_tokens=n_tokens,
-                        max_len=max_len, kv_quant=kv_quant,
-                        decode_kernel=kernel,
-                    ),
-                    static_argnames=(),
-                )
-                out = fn(p, prompt)
+            def run(n_tokens, p=p, kv_quant=kv_quant, kernel=kernel,
+                    wq_kernel=wq_kernel):
+                # weight_einsum reads NEXUS_QUANT_KERNEL at TRACE time, so
+                # pinning it around the jit call routes this row's weight
+                # matmuls; restored after tracing so rows stay independent
+                prev = os.environ.get("NEXUS_QUANT_KERNEL")
+                if wq_kernel:
+                    os.environ["NEXUS_QUANT_KERNEL"] = wq_kernel
+                try:
+                    fn = jax.jit(
+                        functools.partial(
+                            generate, cfg=cfg, max_new_tokens=n_tokens,
+                            max_len=max_len, kv_quant=kv_quant,
+                            decode_kernel=kernel,
+                        ),
+                        static_argnames=(),
+                    )
+                    out = fn(p, prompt)
+                finally:
+                    if wq_kernel:
+                        if prev is None:
+                            os.environ.pop("NEXUS_QUANT_KERNEL", None)
+                        else:
+                            os.environ["NEXUS_QUANT_KERNEL"] = prev
                 # warmup must ALSO sync via a device->host pull: plain
                 # block_until_ready under-syncs on remote-relay backends
                 # (bench.py), leaking warmup execution into the timed window
@@ -183,7 +228,12 @@ def main() -> None:
             # time-to-first-token estimate: the short call minus its decode
             # share — includes prefill, sampling setup, and dispatch
             ttft_ms = max(t_short * 1000.0 - short_n * ms_step, 0.0)
-            w_bytes = w_bytes_int8 if "int8w" in variant else w_bytes_full
+            if "int4w" in variant:
+                w_bytes = w_bytes_int4
+            elif "int8w" in variant:
+                w_bytes = w_bytes_int8
+            else:
+                w_bytes = w_bytes_full
             total_bytes = w_bytes + kv_bytes(batch, max_len, bool(kv_quant))
             floor_ms = total_bytes / bw * 1000.0 if bw else 0.0
             print(json.dumps({
@@ -192,6 +242,7 @@ def main() -> None:
                 "batch": batch, "prompt": prompt_len, "max_len": max_len,
                 "variant": variant,
                 "kernel": kernel,
+                "wq_kernel": wq_kernel,
                 "ms_step": round(ms_step, 3),
                 "floor_ms": round(floor_ms, 3),
                 "x_floor": round(ms_step / floor_ms, 2) if floor_ms else 0.0,

@@ -1,0 +1,306 @@
+"""Fused dequant-inside-matmul weight kernels (ISSUE 17).
+
+Bit-parity discipline mirrors tests/test_decode_attention.py: the kernel
+runs in pallas INTERPRET mode on the CPU mesh against a same-op-order XLA
+reference — exact when K fits one block (identical f32 accumulation
+order), allclose across K blocks (partial-sum reassociation only).  The
+dispatch tests pin the ``weight_einsum`` discipline: plain arrays are
+bit-identical to the pre-quant einsum, auto falls back off-TPU, and the
+``NEXUS_QUANT_KERNEL`` escape hatch routes/validates exactly like
+``NEXUS_DECODE_KERNEL``.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models.llama import LlamaConfig, llama_init
+from tpu_nexus.models.quant import (
+    DEFAULT_INT4_GROUP,
+    QTensor,
+    QTensor4,
+    _pack_nibbles,
+    _unpack_nibbles,
+    quantize_params,
+    quantize_tensor,
+    quantize_tensor_int4,
+    quantized_bytes,
+)
+from tpu_nexus.ops.quant_matmul import (
+    MAX_FUSED_M,
+    quant_matmul,
+    quant_matmul_supported,
+    weight_einsum,
+)
+
+
+def _rand(key, shape, dtype=jnp.float32):
+    return jax.random.normal(jax.random.PRNGKey(key), shape, dtype=dtype)
+
+
+# -- int4 packing mechanics ----------------------------------------------------
+
+
+class TestNibblePacking:
+    @pytest.mark.parametrize("group", [4, 8, 64])
+    def test_roundtrip_exact(self, group):
+        rng = np.random.default_rng(0)
+        q4 = jnp.asarray(rng.integers(-7, 8, size=(128, 16)), jnp.int8)
+        packed = _pack_nibbles(q4, group)
+        assert packed.shape == (64, 16) and packed.dtype == jnp.int8
+        np.testing.assert_array_equal(np.asarray(_unpack_nibbles(packed, group)), np.asarray(q4))
+
+    def test_half_split_is_block_local(self):
+        """Packed row i of a group holds unpacked rows (i, i + G/2): a
+        K-block covering whole groups unpacks with no cross-block reads —
+        the property the kernel's in-block dequant relies on."""
+        group = 8
+        q4 = jnp.asarray(np.arange(-7, 9).reshape(16, 1) % 8 - 4, jnp.int8)
+        packed = _pack_nibbles(q4, group)
+        lo = np.asarray(jnp.right_shift(jnp.left_shift(packed, 4), 4))
+        hi = np.asarray(jnp.right_shift(packed, 4))
+        for g in range(2):  # two groups of 8 rows -> 4 packed rows each
+            for i in range(group // 2):
+                assert lo[g * 4 + i, 0] == int(q4[g * group + i, 0])
+                assert hi[g * 4 + i, 0] == int(q4[g * group + i + group // 2, 0])
+
+
+class TestQTensor4:
+    def test_quantize_shapes_and_error_bound(self):
+        w = _rand(0, (128, 64))
+        qt = quantize_tensor_int4(w, (-2,), 32, name="w_up")
+        assert isinstance(qt, QTensor4)
+        assert qt.q.shape == (64, 64) and qt.q.dtype == jnp.int8
+        assert qt.s.shape == (4, 64) and qt.s.dtype == jnp.float32
+        assert qt.shape == (128, 64) and qt.group == 32
+        deq = np.asarray(qt.astype(jnp.float32)).reshape(4, 32, 64)
+        err = np.abs(deq - np.asarray(w).reshape(4, 32, 64))
+        # symmetric 4-bit with per-group scales: error <= scale/2 per group
+        assert np.all(err <= np.asarray(qt.s)[:, None, :] / 2 + 1e-7)
+
+    def test_per_layer_slicing_preserves_aux(self):
+        """Stacked [L, K/2, N] leaves slice per layer under tree.map/scan
+        with contract/out aux intact — the generate() scan contract."""
+        w = _rand(1, (3, 64, 32))
+        qt = quantize_tensor_int4(w, (-2,), 16, name="w")
+        layer = jax.tree.map(lambda a: a[1], qt)
+        assert isinstance(layer, QTensor4)
+        assert layer.shape == (64, 32) and layer.group == 16
+        np.testing.assert_allclose(
+            np.asarray(layer.astype(jnp.float32)),
+            np.asarray(qt.astype(jnp.float32))[1],
+            rtol=0, atol=0,
+        )
+
+    def test_odd_group_rejected(self):
+        with pytest.raises(ValueError, match="even"):
+            quantize_tensor_int4(_rand(0, (64, 16)), (-2,), 3, name="wq")
+
+    def test_non_dividing_group_names_the_weight(self):
+        with pytest.raises(ValueError, match="wq.*NEXUS_QUANT_GROUP"):
+            quantize_tensor_int4(_rand(0, (96, 16)), (-2,), 64, name="wq")
+
+
+class TestQuantizeParams:
+    CFG = LlamaConfig.tiny()
+
+    def test_mode_validated(self):
+        p = llama_init(jax.random.PRNGKey(0), self.CFG)
+        with pytest.raises(ValueError, match="quantize mode"):
+            quantize_params(p, mode="fp4")
+
+    def test_int4_leaves_and_idempotence(self):
+        p = llama_init(jax.random.PRNGKey(0), self.CFG)
+        qp = quantize_params(p, mode="int4")
+        assert isinstance(qp["layers"]["wq"], QTensor4)
+        assert qp["layers"]["wq"].group == DEFAULT_INT4_GROUP
+        # embeddings/norms stay plain (gather-consumed / tiny)
+        assert not isinstance(qp["embed"]["tokens"], (QTensor, QTensor4))
+        qp2 = quantize_params(qp, mode="int4")
+        assert qp2["layers"]["wq"] is qp["layers"]["wq"]
+
+    def test_quantized_bytes_counts_packed_nibbles(self):
+        p = llama_init(jax.random.PRNGKey(0), self.CFG)
+        full = quantized_bytes(p)
+        b8 = quantized_bytes(quantize_params(p, mode="int8"))
+        b4 = quantized_bytes(quantize_params(p, mode="int4", group=16))
+        assert b4 < b8 < full
+        # exact accounting for one leaf: wq [L, E, H, D] at group 16
+        cfg = self.CFG
+        k, n = cfg.hidden, cfg.n_heads * cfg.head_dim
+        wq4 = quantize_params(p, mode="int4", group=16)["layers"]["wq"]
+        leaf_bytes = sum(a.size * a.dtype.itemsize for a in (wq4.q, wq4.s))
+        assert leaf_bytes == cfg.n_layers * (k // 2 * n + k // 16 * n * 4)
+
+
+# -- kernel parity (interpret mode) --------------------------------------------
+
+
+class TestInt8KernelParity:
+    def test_single_k_block_bit_exact(self):
+        x = _rand(0, (4, 64))
+        qt = quantize_tensor(_rand(1, (64, 128)), (-2,))
+        out = quant_matmul(x, qt, block_k=64, block_n=128)
+        ref = (
+            jax.lax.dot_general(
+                x, qt.q.astype(x.dtype),
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            * qt.s.reshape(1, -1)
+        ).astype(x.dtype)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_multi_block_allclose(self):
+        x = _rand(2, (8, 256))
+        qt = quantize_tensor(_rand(3, (256, 128)), (-2,))
+        out = quant_matmul(x, qt, block_k=64, block_n=64)
+        ref = x @ qt.astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestInt4KernelParity:
+    def test_single_k_block_bit_exact(self):
+        x = _rand(0, (4, 64))
+        qt = quantize_tensor_int4(_rand(1, (64, 128)), (-2,), 16, name="w")
+        out = quant_matmul(x, qt, block_k=64, block_n=128)
+        ref = jax.lax.dot_general(
+            x, qt.astype(x.dtype),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(x.dtype)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    def test_multi_block_whole_groups_allclose(self):
+        x = _rand(2, (8, 256))
+        qt = quantize_tensor_int4(_rand(3, (256, 128)), (-2,), 32, name="w")
+        out = quant_matmul(x, qt, block_k=64, block_n=64)  # 64 % 32 == 0
+        ref = x @ qt.astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_block_not_multiple_of_group_clamps_to_k(self):
+        """A block_k that splits a group falls back to one whole-K block
+        (the packing is only block-local on whole groups)."""
+        x = _rand(4, (2, 96))
+        qt = quantize_tensor_int4(_rand(5, (96, 64)), (-2,), 48, name="w")
+        out = quant_matmul(x, qt, block_k=64, block_n=64)
+        ref = x @ qt.astype(jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+class TestQuantMatmulValidation:
+    QT = None
+
+    def _qt(self):
+        return quantize_tensor(_rand(1, (64, 128)), (-2,))
+
+    def test_wrong_k_named(self):
+        with pytest.raises(ValueError, match="x K 32 != weight contraction width 64"):
+            quant_matmul(_rand(0, (4, 32)), self._qt())
+
+    def test_non_2d_rejected(self):
+        with pytest.raises(ValueError, match="must be 2D"):
+            quant_matmul(_rand(0, (2, 4, 64)), self._qt())
+
+    def test_oversized_m_names_the_cap(self):
+        with pytest.raises(ValueError, match=f"MAX_FUSED_M {MAX_FUSED_M}"):
+            quant_matmul(_rand(0, (MAX_FUSED_M + 1, 64)), self._qt())
+
+    def test_moe_lead_dims_rejected(self):
+        stacked = quantize_tensor(_rand(1, (2, 64, 32)), (-2,))
+        with pytest.raises(ValueError, match="lead dims"):
+            quant_matmul(_rand(0, (4, 64)), stacked)
+
+
+# -- dispatch discipline -------------------------------------------------------
+
+
+class TestWeightEinsum:
+    def test_plain_array_bit_identical_to_einsum(self):
+        x, w = _rand(0, (2, 8, 64)), _rand(1, (64, 128))
+        out = weight_einsum("bse,ef->bsf", x, w, jnp.float32)
+        np.testing.assert_array_equal(
+            np.asarray(out), np.asarray(jnp.einsum("bse,ef->bsf", x, w))
+        )
+
+    def test_auto_off_tpu_is_xla_fallback(self):
+        x = _rand(0, (2, 4, 64))
+        qt = quantize_tensor(_rand(1, (64, 128)), (-2,))
+        assert not quant_matmul_supported(x.reshape(8, 64), qt)  # CPU backend
+        out = weight_einsum("bse,ef->bsf", x, qt, jnp.float32)
+        ref = jnp.einsum("bse,ef->bsf", x, qt.astype(jnp.float32))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_forced_pallas_interpret_matches_xla(self, mode):
+        x = _rand(0, (2, 4, 64))
+        w = _rand(1, (64, 128))
+        qt = (
+            quantize_tensor(w, (-2,))
+            if mode == "int8"
+            else quantize_tensor_int4(w, (-2,), 16, name="w")
+        )
+        out = weight_einsum("bse,ef->bsf", x, qt, jnp.float32, impl="pallas")
+        ref = weight_einsum("bse,ef->bsf", x, qt, jnp.float32, impl="xla")
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_env_routes_auto(self, monkeypatch):
+        x = _rand(0, (2, 4, 64))
+        qt = quantize_tensor_int4(_rand(1, (64, 128)), (-2,), 16, name="w")
+        monkeypatch.setenv("NEXUS_QUANT_KERNEL", "pallas")
+        out = weight_einsum("bse,ef->bsf", x, qt, jnp.float32)
+        monkeypatch.setenv("NEXUS_QUANT_KERNEL", "xla")
+        ref = weight_einsum("bse,ef->bsf", x, qt, jnp.float32)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+    def test_env_validated(self, monkeypatch):
+        qt = quantize_tensor(_rand(1, (64, 128)), (-2,))
+        monkeypatch.setenv("NEXUS_QUANT_KERNEL", "triton")
+        with pytest.raises(ValueError, match="NEXUS_QUANT_KERNEL"):
+            weight_einsum("bse,ef->bsf", _rand(0, (2, 4, 64)), qt, jnp.float32)
+
+    def test_impl_validated(self):
+        with pytest.raises(ValueError, match="weight_einsum impl"):
+            weight_einsum(
+                "bse,ef->bsf", _rand(0, (2, 4, 64)), _rand(1, (64, 128)),
+                jnp.float32, impl="cuda",
+            )
+
+    def test_forced_pallas_on_unsupported_names_clauses(self):
+        qt = quantize_tensor(_rand(1, (64, 128)), (-2,))
+        with pytest.raises(ValueError, match="does not end with the weight contraction"):
+            weight_einsum("bse,ef->bsf", _rand(0, (2, 4, 32)), qt, jnp.float32, impl="pallas")
+
+
+# -- end-to-end through generate (both widths, both impls) ---------------------
+
+
+class TestGenerateParity:
+    """The serving decode path itself: quantized params stream through the
+    UNCHANGED generate() with weight matmuls routed per impl — forced
+    interpret-mode pallas must reproduce the XLA fallback's tokens (f32
+    compute, PR 6/9 near-tie precedent)."""
+
+    CFG = LlamaConfig(
+        vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        head_dim=16, intermediate=128, max_seq_len=256, remat=False,
+        dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_tokens_identical_across_impls(self, mode, monkeypatch):
+        from tpu_nexus.models.generate import generate
+
+        params = llama_init(jax.random.PRNGKey(0), self.CFG)
+        qp = quantize_params(params, mode=mode, group=16)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(1, 256, size=(2, 8)), jnp.int32
+        )
+        streams = {}
+        for impl in ("xla", "pallas"):
+            monkeypatch.setenv("NEXUS_QUANT_KERNEL", impl)
+            streams[impl] = np.asarray(
+                generate(qp, prompt, self.CFG, max_new_tokens=8, max_len=16)
+            )
+        np.testing.assert_array_equal(streams["xla"], streams["pallas"])

@@ -1,0 +1,294 @@
+"""Quantized-weight serving lifecycle (ISSUE 17).
+
+The executors OWN the quantize transform (construction and every
+``swap_params``), so rolling updates ship plain bf16/f32 checkpoints and
+every replica — contiguous, paged, speculative, overlap, TP-sharded —
+serves packed weights with zero host gather.  Token identity is the gate,
+at BOTH widths: the quantization error is deterministic, so every
+serving mode must emit exactly ``generate(quantize(params))``'s stream.
+
+f32 compute for the parity matrices (the PR 6/9 near-tie precedent:
+different traced programs may resolve a bf16-tied argmax differently;
+docs/SERVING.md).  Group 16 everywhere — the tiny config's smallest
+contraction (hidden 64) holds 4 groups, so group scales are exercised
+rather than degenerating to per-channel.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import LlamaConfig, llama_init
+from tpu_nexus.models.quant import QTensor, QTensor4, quantize_params
+from tpu_nexus.serving import (
+    ModelExecutor,
+    NGramDrafter,
+    PagedModelExecutor,
+    RequestState,
+    ServingEngine,
+    ServingFleet,
+)
+from tpu_nexus.serving.sharded import (
+    ShardedModelExecutor,
+    ShardingError,
+    build_serve_mesh,
+    validate_serve_mesh,
+)
+from tpu_nexus.workload.serve import ServeConfig
+
+CFG = LlamaConfig(
+    vocab_size=256, hidden=64, n_layers=2, n_heads=4, n_kv_heads=4,
+    head_dim=16, intermediate=128, max_seq_len=256, remat=False,
+    dtype=jnp.float32, param_dtype=jnp.float32,
+)
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+PARAMS_NEW = llama_init(jax.random.PRNGKey(7), CFG)
+GROUP = 16
+
+S, T, SLOTS = 8, 8, 3
+RNG = np.random.default_rng(13)
+PROMPTS = [
+    RNG.integers(1, CFG.vocab_size, size=int(RNG.integers(4, S + 1))).astype(np.int32)
+    for _ in range(SLOTS)
+]
+
+
+def _qp(params, mode):
+    return quantize_params(params, mode=mode, group=GROUP)
+
+
+def _ref(params, mode, prompt, n=T):
+    return list(
+        np.asarray(
+            generate(
+                _qp(params, mode), jnp.asarray(prompt[None]), CFG,
+                max_new_tokens=n, max_len=len(prompt) + n,
+            )
+        )[0]
+    )
+
+
+def _drain(engine, prompts=PROMPTS, n=T):
+    reqs = [engine.submit(p, n, request_id=f"r{i}") for i, p in enumerate(prompts)]
+    engine.run_until_drained(max_steps=5000)
+    assert all(r.state == RequestState.FINISHED for r in reqs)
+    return {r.request_id: list(r.output_tokens) for r in reqs}
+
+
+# -- parse-time validation (ServeConfig) ---------------------------------------
+
+
+class TestServeConfigQuant:
+    def test_int8_and_int4_accepted(self):
+        assert ServeConfig(model=CFG, quantize="int8").quantize == "int8"
+        cfg = ServeConfig(model=CFG, quantize="int4", quant_group=16)
+        assert cfg.quant_group == 16
+
+    def test_unknown_mode_named(self):
+        with pytest.raises(ValueError, match="unknown quantize mode 'fp4'"):
+            ServeConfig(model=CFG, quantize="fp4")
+
+    def test_negative_group_named(self):
+        with pytest.raises(ValueError, match="NEXUS_QUANT_GROUP.*got -8"):
+            ServeConfig(model=CFG, quantize="int4", quant_group=-8)
+
+    def test_group_without_int4_rejected(self):
+        with pytest.raises(
+            ValueError, match="NEXUS_QUANT_GROUP=64.*quantize='int4'.*'int8'"
+        ):
+            ServeConfig(model=CFG, quantize="int8", quant_group=64)
+        with pytest.raises(ValueError, match="NEXUS_QUANT_GROUP=64"):
+            ServeConfig(model=CFG, quant_group=64)
+
+    def test_odd_group_rejected(self):
+        with pytest.raises(ValueError, match="must be even.*got 9"):
+            ServeConfig(model=CFG, quantize="int4", quant_group=9)
+
+    def test_non_dividing_group_names_the_width(self):
+        # hidden 64 % 48 != 0: the error names the width and the knob
+        with pytest.raises(ValueError, match="NEXUS_QUANT_GROUP=48.*64 hidden"):
+            ServeConfig(model=CFG, quantize="int4", quant_group=48)
+
+    def test_from_env_parses_group(self):
+        cfg = ServeConfig.from_env({
+            "NEXUS_MODEL_PRESET": "tiny", "NEXUS_QUANTIZE": "int4",
+            "NEXUS_QUANT_GROUP": "16",
+        })
+        assert (cfg.quantize, cfg.quant_group) == ("int4", 16)
+
+
+class TestValidateServeMeshInt4:
+    def test_packed_dims_divisible_passes(self):
+        validate_serve_mesh(
+            {"tp": 2}, CFG, n_devices=2, quantize="int4", quant_group=GROUP
+        )
+
+    def test_tp_must_divide_packed_and_scale_rows(self):
+        # wo contraction n_heads*head_dim = 64: group 32 leaves 2 scale
+        # rows — tp=4 cannot shard them, and the error names the values
+        with pytest.raises(ShardingError, match="tp=4.*int4"):
+            validate_serve_mesh(
+                {"tp": 4}, CFG, n_devices=4, quantize="int4", quant_group=32
+            )
+
+    def test_bf16_unaffected(self):
+        validate_serve_mesh({"tp": 4}, CFG, n_devices=4)
+
+
+# -- executor-owned quantize ---------------------------------------------------
+
+
+class TestQuantizedExecutors:
+    def test_executor_applies_transform_and_reports_bytes(self):
+        ex8 = ModelExecutor(PARAMS, CFG, num_slots=SLOTS, max_len=S + T,
+                            quantize="int8")
+        ex4 = ModelExecutor(PARAMS, CFG, num_slots=SLOTS, max_len=S + T,
+                            quantize="int4", quant_group=GROUP)
+        assert isinstance(ex8.params["layers"]["wq"], QTensor)
+        assert isinstance(ex4.params["layers"]["wq"], QTensor4)
+        assert 0 < ex4.weight_bytes < ex8.weight_bytes
+
+    def test_unknown_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown quantize mode 'fp8'"):
+            ModelExecutor(PARAMS, CFG, num_slots=SLOTS, max_len=S + T,
+                          quantize="fp8")
+
+    def test_pre_quantized_tree_passes_idempotently(self):
+        ex = ModelExecutor(_qp(PARAMS, "int4"), CFG, num_slots=SLOTS,
+                           max_len=S + T, quantize="int4", quant_group=GROUP)
+        assert isinstance(ex.params["layers"]["wq"], QTensor4)
+
+    def test_load_snapshot_surfaces_weight_bytes(self):
+        ex = ModelExecutor(PARAMS, CFG, num_slots=SLOTS, max_len=S + T,
+                           quantize="int4", quant_group=GROUP)
+        eng = ServingEngine(ex)
+        snap = eng.load_snapshot()
+        assert snap.weight_bytes == ex.weight_bytes > 0
+
+
+# -- cross-mode token identity, both widths ------------------------------------
+
+
+class TestCrossModeTokenIdentity:
+    """The acceptance pin: at a fixed width, contiguous, paged,
+    speculative, overlap/multi-step, and TP-sharded engines all emit
+    exactly ``generate(quantize(params))``'s greedy stream — the
+    executors quantize internally from the SAME plain tree."""
+
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_matrix(self, mode):
+        kw = dict(num_slots=SLOTS, max_len=S + T, quantize=mode,
+                  quant_group=GROUP if mode == "int4" else 0)
+        engines = {
+            "contig": ServingEngine(ModelExecutor(PARAMS, CFG, **kw)),
+            "paged": ServingEngine(
+                PagedModelExecutor(PARAMS, CFG, page_size=4, **kw)
+            ),
+            "spec": ServingEngine(
+                ModelExecutor(PARAMS, CFG, **kw),
+                spec_k=2, drafter=NGramDrafter(SLOTS),
+            ),
+            "overlap": ServingEngine(
+                ModelExecutor(PARAMS, CFG, decode_steps=2, **kw),
+                overlap=True,
+            ),
+            "sharded": ServingEngine(
+                ShardedModelExecutor(
+                    PARAMS, CFG, mesh=build_serve_mesh({"tp": 2}), **kw
+                )
+            ),
+        }
+        expected = {
+            f"r{i}": _ref(PARAMS, mode, p) for i, p in enumerate(PROMPTS)
+        }
+        for name, eng in engines.items():
+            assert _drain(eng) == expected, (mode, name)
+
+
+# -- rolling updates: plain checkpoints onto quantized replicas ----------------
+
+
+def _checkpointed(tmp_path, params, step=2):
+    from tpu_nexus.workload.tensor_checkpoint import TensorCheckpointer
+
+    ck = TensorCheckpointer(str(tmp_path / "ckpt"))
+    ck.save(step, {"params": params})
+    ck.commit(step)
+    return ck
+
+
+class TestQuantizedRollingUpdate:
+    """ISSUE 17 drill: the fleet ships ONE plain bf16/f32 verified
+    checkpoint; each replica quantizes at its own swap seam, per shard,
+    with zero device-to-host gather (transfer guard)."""
+
+    @pytest.mark.parametrize("mode", ["int8", "int4"])
+    def test_swap_quantizes_per_shard_without_host_gather(self, tmp_path, mode):
+        ck = _checkpointed(tmp_path, PARAMS_NEW)
+        try:
+            executor = ShardedModelExecutor(
+                PARAMS, CFG, mesh=build_serve_mesh({"tp": 2}),
+                num_slots=2, max_len=S + T,
+                quantize=mode, quant_group=GROUP if mode == "int4" else 0,
+            )
+            eng = ServingEngine(executor)
+            inflight = [
+                eng.submit(PROMPTS[i], T, request_id=f"old{i}") for i in range(2)
+            ]
+            for _ in range(2):
+                eng.step()
+            assert any(not r.is_terminal() for r in inflight)
+
+            eng.quiesce(grace_s=60.0)
+            new_params = ck.restore_params(2)  # plain f32 HOST tree
+            with jax.transfer_guard_device_to_host("disallow"):
+                eng.swap_params(new_params)
+            eng.resume_admission()
+
+            # the swap seam quantized the verified tree at the serving width
+            wq = eng.executor.params["layers"]["wq"]
+            assert isinstance(wq, QTensor if mode == "int8" else QTensor4)
+            for i, req in enumerate(inflight):
+                assert req.state == RequestState.FINISHED
+                assert list(req.output_tokens) == _ref(PARAMS, mode, PROMPTS[i]), i
+            post = eng.submit(PROMPTS[0], T, request_id="post")
+            eng.run_until_drained(max_steps=2000)
+            assert list(post.output_tokens) == _ref(PARAMS_NEW, mode, PROMPTS[0])
+            assert eng.weight_swaps == 1
+        finally:
+            ck.close()
+
+    def test_fleet_rollout_over_mixed_width_replicas(self, tmp_path):
+        """One plain checkpoint rolls onto an int8 AND an int4 replica in
+        the same fleet: each lands at its own width, no request dropped."""
+        ck = _checkpointed(tmp_path, PARAMS_NEW)
+        try:
+            fleet = ServingFleet()
+            for name, mode in (("rep-int8", "int8"), ("rep-int4", "int4")):
+                executor = ShardedModelExecutor(
+                    PARAMS, CFG, mesh=build_serve_mesh({"tp": 2}),
+                    num_slots=2, max_len=S + T,
+                    quantize=mode, quant_group=GROUP if mode == "int4" else 0,
+                )
+                fleet.add_replica(name, ServingEngine(executor), step=1)
+            assert fleet.start_rollout(ck, 2, grace_s=60.0)
+            reqs = []
+            for i in range(4):
+                reqs.append(fleet.submit(PROMPTS[i % len(PROMPTS)], T))
+                fleet.tick()
+            for _ in range(500):
+                fleet.tick()
+                if not fleet.rollout_active and not fleet.has_work:
+                    break
+            fleet.run_until_drained()
+            assert fleet.converged(2)
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            widths = {
+                name: type(rep.engine.executor.params["layers"]["wq"])
+                for name, rep in fleet.replicas.items()
+            }
+            assert widths == {"rep-int8": QTensor, "rep-int4": QTensor4}
+        finally:
+            ck.close()

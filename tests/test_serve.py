@@ -112,8 +112,8 @@ class TestServeConfigValidation:
     any model/device work starts."""
 
     def test_bad_quantize_fails_at_construction(self):
-        with pytest.raises(ValueError, match="unknown quantize mode 'int4'"):
-            ServeConfig(quantize="int4")
+        with pytest.raises(ValueError, match="unknown quantize mode 'fp4'"):
+            ServeConfig(quantize="fp4")
 
     def test_bad_quantize_kv_fails_at_construction(self):
         with pytest.raises(ValueError, match="unknown quantize_kv mode 'fp8'"):

@@ -2463,6 +2463,60 @@ def test_nx019_factory_param_donate_is_the_callers_obligation():
     assert _lint_nx019(src) == []
 
 
+def test_nx019_stale_pretransform_tree_after_install_flagged():
+    """The quantize-at-swap seam: binding quantize_params to a FRESH name
+    and touching the pre-transform tree after _install_params() is the
+    stale-host-tree variant of DeviceStateLost."""
+    src = """
+    class Engine:
+        def swap_params(self, host_tree):
+            quantized = quantize_params(host_tree, mode=self.quantize)
+            self.params = self._install_params(quantized)
+            return host_tree
+    """
+    findings = _lint_nx019(src, rel_path="tpu_nexus/serving/engine.py")
+    assert [f.rule_id for f in findings] == ["NX019"]
+    assert "pre-transform host tree" in findings[0].message
+    assert "DeviceStateLost" in findings[0].message
+
+
+def test_nx019_transform_rebinding_its_input_passes():
+    src = """
+    class Engine:
+        def swap_params(self, host_tree):
+            host_tree = quantize_params(host_tree, mode=self.quantize)
+            self.params = self._install_params(host_tree)
+            return host_tree
+    """
+    assert _lint_nx019(src, rel_path="tpu_nexus/serving/engine.py") == []
+
+
+def test_nx019_pretransform_name_dead_after_install_passes():
+    """Fresh-name binding is fine when the pre-transform tree is never
+    loaded again past the install — the contract is about liveness, not
+    naming style."""
+    src = """
+    class Engine:
+        def swap_params(self, host_tree):
+            spec = tree_spec(host_tree)
+            quantized = quantize_params(host_tree, mode=self.quantize)
+            self.params = self._install_params(quantized)
+            return spec
+    """
+    assert _lint_nx019(src, rel_path="tpu_nexus/serving/engine.py") == []
+
+
+def test_nx019_install_transform_scoped_to_install_frames():
+    """Frames that never call _install_params are out of scope: holding a
+    transformed copy next to the original is normal host-side code."""
+    src = """
+    def compare(params):
+        quantized = quantize_params(params, mode="int8")
+        return quantized, params
+    """
+    assert _lint_nx019(src, rel_path="tpu_nexus/models/quant.py") == []
+
+
 def test_nx019_repo_is_clean():
     findings = lint_paths(
         [os.path.join(REPO_ROOT, "tpu_nexus")],

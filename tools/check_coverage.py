@@ -36,6 +36,8 @@ REQUIRED = [
     "tpu_nexus/workload/goodput.py",            # wall-time buckets + MFU accounting
     "tpu_nexus/workload/health.py",             # sentinel + rollback-and-skip + watchdog
     "tpu_nexus/workload/tensor_checkpoint.py",
+    "tpu_nexus/models/quant.py",                # int8/int4 QTensor layouts + quantize transform
+    "tpu_nexus/ops/quant_matmul.py",            # fused dequant-inside-matmul weight kernels
     "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates

@@ -1,0 +1,188 @@
+"""Int8/int4 WEIGHT quality gate (ISSUE 17; mirrors tools/int8_gate_1b.py).
+
+The int8-KV gate (INT8_GATE_1B_r05.json) priced the KV-cache half of
+quantized serving; this script gates the WEIGHT half at both widths:
+train the same noisy affine-bigram corpus the r5 gate used, then measure
+held-out perplexity
+
+  * through ``make_eval_step`` (teacher-forced forward): bf16 vs int8
+    weight-only vs packed int4 with group-wise scales — the numbers the
+    fused-dequant serving path (ops/quant_matmul.py) needs;
+  * through the DECODE path (prefill + decode_step scan, the code serving
+    actually runs): the same three trees, bf16 KV throughout so the
+    delta is pure weight error.
+
+Gate bars match INT8_GATE_1B_r05.json: int8 rel ppl delta < 1%; int4
+< 2% (4-bit group-wise is the "+ int8 KV" error-budget tier of the r5
+gate, and the r5 combined bar was 2%).
+
+``NEXUS_GATE_MODEL`` picks the config: ``nexus_1b`` (default, chip
+scale), ``nexus_moe``, ``small``, or ``tiny`` — CPU-feasible tiers for
+boxes without an accelerator (PR 2 precedent: report the honest floor);
+the artifact records which ran.  The int4 artifact tier is ``small``
+(hidden 256): group-wise int4 noise on a contraction of width K scales
+like 1/sqrt(K), and tiny's hidden 64 is too narrow to meet a bar
+calibrated at 1B scale no matter the group size (measured sweep at
+hidden 64: group 64 → +5.2% ppl, 16 → +3.3%, 8 → +2.3%; hidden 256
+passes — see PERF.md r13).  ``NEXUS_QUANT_GROUP`` overrides the int4
+group size (0 = DEFAULT_INT4_GROUP).
+
+Prints one JSON line per measurement:
+
+    python tools/int4_gate_1b.py                       # chip, ~10 min
+    NEXUS_GATE_MODEL=tiny python tools/int4_gate_1b.py # CPU tier
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    from tpu_nexus.models import LlamaConfig
+    from tpu_nexus.models.generate import teacher_forced_decode_ce
+    from tpu_nexus.models.quant import quantize_params
+    from tpu_nexus.parallel import LOGICAL_RULES_FSDP_TP, MeshSpec, build_mesh
+    from tpu_nexus.workload.data import token_file_batches, write_token_npy
+    from tpu_nexus.workload.train import (
+        TrainConfig,
+        init_train_state,
+        make_eval_step,
+        make_train_step,
+    )
+
+    steps = int(os.environ.get("NEXUS_GATE_STEPS", "300"))
+    model = os.environ.get("NEXUS_GATE_MODEL", "nexus_1b")
+    group = int(os.environ.get("NEXUS_QUANT_GROUP", "0") or 0)
+    batch, seq = 16, 2048
+    vocab = 32768
+
+    # same corpus recipe as the r5 gate (512-token support of the vocab:
+    # learnable structure in minutes, which is all the quantization delta
+    # needs to be meaningful) — scaled down with the model on the CPU tier
+    support = 512
+    n = 8 * 1024 * 1024
+    if model == "nexus_moe":
+        from tpu_nexus.models import MoeConfig
+
+        cfg = MoeConfig.nexus_moe()
+        batch = 32
+    elif model == "small":
+        # CPU artifact tier: hidden 256 is the narrowest width at which
+        # the 1B-calibrated int4 bar is meetable (noise ~ 1/sqrt(K));
+        # seq 128 keeps the host train under ~20 min
+        cfg = LlamaConfig(
+            vocab_size=1024, hidden=256, n_layers=2, n_heads=8, n_kv_heads=4,
+            head_dim=32, intermediate=512, max_seq_len=256, remat=False,
+        )
+        batch, seq = 8, 128
+        n = 1024 * 1024
+    elif model == "tiny":
+        # CPU smoke tier: structure-identical shapes, vocab wide enough to
+        # hold the 512-token support; corpus/batch sized for minutes on a
+        # host.  Too narrow for the int4 bar (see module docstring) — use
+        # ``small`` for the artifact run
+        cfg = LlamaConfig.tiny(vocab_size=1024)
+        batch, seq = 8, 256
+        n = 1024 * 1024
+    else:
+        cfg = LlamaConfig.nexus_1b()
+    rng = np.random.default_rng(0)
+    toks = np.empty(n, np.int32)
+    toks[0] = 1
+    noise = rng.integers(0, 16, size=n)
+    for i in range(1, n):
+        toks[i] = (toks[i - 1] * 31 + 7 + noise[i]) % support
+    path = write_token_npy(
+        os.path.join(tempfile.gettempdir(), f"gate4_corpus_{model}.npy"), toks
+    )
+
+    tcfg = TrainConfig(warmup_steps=20, total_steps=max(steps, 2), learning_rate=1e-3)
+    mesh = build_mesh(MeshSpec(fsdp=-1))
+    state = init_train_state(jax.random.PRNGKey(0), cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    step_fn = make_train_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    split = int(n * 0.98)
+    train_data = token_file_batches(path, batch=batch, seq_len=seq, seed=1, end=split)
+
+    t0 = time.perf_counter()
+    with mesh:
+        for i in range(steps):
+            state, m = step_fn(state, jnp.asarray(next(train_data)))
+            if (i + 1) % 50 == 0:
+                print(json.dumps({
+                    "phase": "train", "step": i + 1, "loss": round(float(m["loss"]), 4),
+                    "elapsed_s": round(time.perf_counter() - t0, 1),
+                }), flush=True)
+
+    eval_fn = make_eval_step(cfg, tcfg, mesh, LOGICAL_RULES_FSDP_TP)
+    heldout = token_file_batches(path, batch=batch, seq_len=seq, seed=99, start=split)
+    eval_batches = [jnp.asarray(next(heldout)) for _ in range(8)]
+
+    def forward_ppl(params):
+        with mesh:
+            ces = [float(eval_fn({"params": params}, b)["ce_loss"]) for b in eval_batches]
+        return float(np.exp(np.mean(ces)))
+
+    params = state["params"]
+    qparams8 = quantize_params(params, mode="int8")
+    qparams4 = quantize_params(params, mode="int4", group=group)
+    ppl_full = forward_ppl(params)
+    ppl_int8 = forward_ppl(qparams8)
+    ppl_int4 = forward_ppl(qparams4)
+    assert ppl_full < support / 2, (
+        f"model did not train (ppl {ppl_full} vs {support}-support uniform {support})"
+    )
+    print(json.dumps({
+        "phase": "gate_forward", "model": model, "steps": steps, "support": support,
+        "int4_group": group, "ppl_bf16": round(ppl_full, 4),
+        "ppl_int8w": round(ppl_int8, 4), "ppl_int4w": round(ppl_int4, 4),
+        "rel_delta_int8": round((ppl_int8 - ppl_full) / ppl_full, 6),
+        "rel_delta_int4": round((ppl_int4 - ppl_full) / ppl_full, 6),
+        "gate_int8_lt": 0.01, "gate_int4_lt": 0.02,
+        "pass": bool(abs(ppl_int8 - ppl_full) / ppl_full < 0.01
+                     and abs(ppl_int4 - ppl_full) / ppl_full < 0.02),
+    }), flush=True)
+
+    # -- decode-path gate (the exact serving code; bf16 KV so the delta is
+    # pure weight error) ----------------------------------------------------
+    dec_seq = min(1024, cfg.max_seq_len)
+    dec_batch = 8
+
+    @functools.partial(jax.jit, static_argnames=())
+    def decode_ce(p, batch_toks):
+        return teacher_forced_decode_ce(p, batch_toks, cfg)
+
+    dec_stream = token_file_batches(path, batch=dec_batch, seq_len=dec_seq, seed=7, start=split)
+    dec_batches = [jnp.asarray(next(dec_stream)) for _ in range(2)]
+
+    def decode_ppl(p):
+        return float(np.exp(np.mean([float(decode_ce(p, b)) for b in dec_batches])))
+
+    d_full = decode_ppl(params)
+    d_int8 = decode_ppl(qparams8)
+    d_int4 = decode_ppl(qparams4)
+    print(json.dumps({
+        "phase": "gate_decode", "model": model, "seq": dec_seq,
+        "int4_group": group, "ppl_bf16": round(d_full, 4),
+        "ppl_int8w": round(d_int8, 4), "ppl_int4w": round(d_int4, 4),
+        "rel_delta_int8": round((d_int8 - d_full) / d_full, 6),
+        "rel_delta_int4": round((d_int4 - d_full) / d_full, 6),
+        "gate_int8_lt": 0.01, "gate_int4_lt": 0.02,
+        "pass": bool(abs(d_int8 - d_full) / d_full < 0.01
+                     and abs(d_int4 - d_full) / d_full < 0.02),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -41,6 +41,14 @@ from tools.nxlint.flow import CallGraph, FunctionInfo, flow_for, frame_nodes
 _JIT_NAMES = frozenset({"jit", "pjit"})
 _FACTORY_NAMES = frozenset({"_make_jit"})
 
+#: param-tree transforms whose INPUT becomes stale once the transformed
+#: result is installed on device (the quantize-at-swap seam, ISSUE 17)
+_TRANSFORM_NAMES = frozenset({"quantize_params"})
+#: the device-install seam those transforms feed (PR 11's per-shard
+#: ``device_put``); frames that call it are the only scope checked — a
+#: gate/test that quantizes a copy AND keeps the bf16 tree is fine
+_INSTALL_NAMES = frozenset({"_install_params"})
+
 
 def _terminal(expr: ast.expr) -> Optional[str]:
     if isinstance(expr, ast.Name):
@@ -129,6 +137,8 @@ class DonationSafetyRule(Rule):
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
 
+        yield from self._check_install_transforms(module, tree, parents)
+
         #: id(class node) -> {attr: positions}
         donated_attrs: Dict[int, Dict[str, Set[int]]] = {}
         #: id(scope node) -> {name: positions}
@@ -195,6 +205,69 @@ class DonationSafetyRule(Rule):
                 yield from self._check_call(
                     module, fn, node, positions, desc, parents, graph, param_donations
                 )
+
+    # -- quantize-at-swap transform safety (ISSUE 17) --------------------------
+
+    def _check_install_transforms(self, module, tree, parents) -> Iterator[Finding]:
+        """The serving swap seam runs a param-tree transform BETWEEN
+        restore and device install::
+
+            params = quantize_params(params, mode=..., group=...)
+            ...
+            self.params = self._install_params(params)
+
+        Binding the transform result to a FRESH name instead leaves the
+        pre-transform host tree live past the install: any later load of
+        it works with weights the engine is no longer serving — at best a
+        silently-unquantized tree shipped on the next dispatch, at worst
+        the use-after-donate ``DeviceStateLost`` class when the install
+        path donates the host buffers.  Contract (checked structurally,
+        scoped to frames that call ``_install_params``): the transform
+        rebinds its own input, or the pre-transform name is never loaded
+        after the install statement."""
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            installs = [
+                node
+                for node in frame_nodes(fn)
+                if isinstance(node, ast.Call)
+                and _terminal(node.func) in _INSTALL_NAMES
+            ]
+            if not installs:
+                continue
+            install = min(installs, key=lambda n: n.lineno)
+            install_stmt = self._enclosing_stmt(parents, install)
+            for node in frame_nodes(fn):
+                if not (
+                    isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and _terminal(node.value.func) in _TRANSFORM_NAMES
+                    and node.value.args
+                ):
+                    continue
+                src = _arg_key(node.value.args[0])
+                if src is None:
+                    continue
+                rebound: Set[ArgKey] = set()
+                for target in node.targets:
+                    rebound |= _keys_in(target, ctx=(ast.Store,))
+                if src in rebound:
+                    continue  # the safe idiom: transform over its own input
+                after = self._loaded_after(fn, install_stmt, src)
+                if after is not None:
+                    yield self.finding(
+                        module,
+                        after,
+                        f"{self._key_desc(src)} holds the pre-transform host "
+                        f"tree ({_terminal(node.value.func)} at line "
+                        f"{node.lineno} bound its result to a fresh name) and "
+                        f"is referenced here after _install_params() (line "
+                        f"{install.lineno}) shipped the transformed tree to "
+                        "device — stale/possibly-donated buffer "
+                        "(DeviceStateLost bug class); rebind the transform "
+                        "over its input or drop the stale name",
+                    )
 
     # -- donation-site resolution ----------------------------------------------
 

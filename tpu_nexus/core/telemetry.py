@@ -328,6 +328,7 @@ METRIC_NAMES: Dict[str, tuple] = {
     "load.blocks_used": ("gauge", "per-replica paged KV blocks in use (0 = contiguous), tagged replica:"),
     "load.blocks_free": ("gauge", "per-replica paged KV blocks free (0 = contiguous), tagged replica:"),
     "load.blocks_reclaimable": ("gauge", "per-replica evictable cached-prefix blocks (sampled trie walk), tagged replica:"),
+    "load.weight_bytes": ("gauge", "per-replica stored weight-tree bytes at the serving quantization width, tagged replica:"),
     "load.weight_swaps": ("gauge", "per-replica completed hot weight swaps, tagged replica:"),
     "load.shed_total": ("gauge", "per-replica admission sheds since boot, tagged replica:"),
     "load.requests_retired": ("gauge", "per-replica total retirements since boot, tagged replica:"),

@@ -50,6 +50,7 @@ from tpu_nexus.models.llama import (
     _rope,
 )
 from tpu_nexus.models.moe import MoeConfig, moe_ffn, moe_head, moe_hidden
+from tpu_nexus.ops.quant_matmul import weight_einsum
 from tpu_nexus.ops.rmsnorm import rms_norm
 
 ModelConfig = Any  # LlamaConfig or MoeConfig — same stacked-layer layout
@@ -482,9 +483,9 @@ def decode_step(
         # [L, B, max_len, H, D] stack every decode step (measured: the
         # stacked-ys copy dominated at long context, ~8x over the floor)
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = weight_einsum("bse,ehd->bshd", h, layer["wq"], ct)
+        k = weight_einsum("bse,ehd->bshd", h, layer["wk"], ct)
+        v = weight_einsum("bse,ehd->bshd", h, layer["wv"], ct)
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
         if kv_quant:
@@ -514,7 +515,7 @@ def decode_step(
             block_tables=bt, logical_limit=logical_limit,
             impl=decode_kernel, **scales,
         )
-        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = x + weight_einsum("bshd,hde->bse", o, layer["wo"], ct)
         x = _ffn_block(x, layer, cfg)
         return x, c
 
@@ -712,9 +713,9 @@ def extend_step(
 
     def layer_body(x, c, layer, li):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = weight_einsum("bse,ehd->bshd", h, layer["wq"], ct)
+        k = weight_einsum("bse,ehd->bshd", h, layer["wk"], ct)
+        v = weight_einsum("bse,ehd->bshd", h, layer["wv"], ct)
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
         if kv_quant:
@@ -741,7 +742,7 @@ def extend_step(
             block_tables=bt, logical_limit=logical_limit,
             impl=decode_kernel, **scales,
         )
-        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = x + weight_einsum("bshd,hde->bse", o, layer["wo"], ct)
         x = _ffn_block(x, layer, cfg)
         return x, c
 
@@ -864,9 +865,9 @@ def verify_step(
 
     def layer_body(x, c, layer, li):
         h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-        q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-        k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-        v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+        q = weight_einsum("bse,ehd->bshd", h, layer["wq"], ct)
+        k = weight_einsum("bse,ehd->bshd", h, layer["wk"], ct)
+        v = weight_einsum("bse,ehd->bshd", h, layer["wv"], ct)
         q = _rope(q, cos, sin)
         k = _rope(k, cos, sin)
         if kv_quant:
@@ -894,7 +895,7 @@ def verify_step(
             block_tables=bt, logical_limit=logical_limit,
             q_starts=pos, impl=decode_kernel, **scales,
         )
-        x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+        x = x + weight_einsum("bshd,hde->bse", o, layer["wo"], ct)
         x = _ffn_block(x, layer, cfg)
         return x, c
 

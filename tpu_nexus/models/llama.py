@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 
 from tpu_nexus.ops import attention as _ops_attention
+from tpu_nexus.ops.quant_matmul import weight_einsum
 from tpu_nexus.ops.rmsnorm import rms_norm
 
 AttnFn = Callable[..., jax.Array]
@@ -217,9 +218,9 @@ def attention_block(x, layer, cfg, cos, sin, attn_fn, *, collect_kv: bool = Fals
 
     ct = cfg.dtype
     h = rms_norm(x, layer["attn_norm"], cfg.norm_eps)
-    q = jnp.einsum("bse,ehd->bshd", h, layer["wq"].astype(ct))
-    k = jnp.einsum("bse,ehd->bshd", h, layer["wk"].astype(ct))
-    v = jnp.einsum("bse,ehd->bshd", h, layer["wv"].astype(ct))
+    q = weight_einsum("bse,ehd->bshd", h, layer["wq"], ct)
+    k = weight_einsum("bse,ehd->bshd", h, layer["wk"], ct)
+    v = weight_einsum("bse,ehd->bshd", h, layer["wv"], ct)
     # post-RoPE q/k/v are the attention backward's inputs; naming them lets
     # the "qkv" remat policy skip re-running norm+projections+RoPE in the
     # replay (free under other policies — unsaved names cost nothing)
@@ -227,7 +228,7 @@ def attention_block(x, layer, cfg, cos, sin, attn_fn, *, collect_kv: bool = Fals
     k = _ckpt(_rope(k, cos, sin), "k_rope")
     v = _ckpt(v, "v_rope")
     o = attn_fn(q, k, v, causal=True)
-    x = x + jnp.einsum("bshd,hde->bse", o, layer["wo"].astype(ct))
+    x = x + weight_einsum("bshd,hde->bse", o, layer["wo"], ct)
     if collect_kv:
         return x, (k, v)
     return x
@@ -238,9 +239,9 @@ def mlp_block(x: jax.Array, layer: Dict[str, Any], cfg: LlamaConfig) -> jax.Arra
     pipelined forwards."""
     ct = cfg.dtype
     h = rms_norm(x, layer["mlp_norm"], cfg.norm_eps)
-    gate = jnp.einsum("bse,ef->bsf", h, layer["w_gate"].astype(ct))
-    up = jnp.einsum("bse,ef->bsf", h, layer["w_up"].astype(ct))
-    return x + jnp.einsum("bsf,fe->bse", jax.nn.silu(gate) * up, layer["w_down"].astype(ct))
+    gate = weight_einsum("bse,ef->bsf", h, layer["w_gate"], ct)
+    up = weight_einsum("bse,ef->bsf", h, layer["w_up"], ct)
+    return x + weight_einsum("bsf,fe->bse", jax.nn.silu(gate) * up, layer["w_down"], ct)
 
 
 def remat_policy(name: str):

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpu_nexus.models.llama import attention_block, remat_policy, rope_tables
+from tpu_nexus.ops.quant_matmul import weight_einsum
 from tpu_nexus.ops.rmsnorm import rms_norm
 
 AttnFn = Any
@@ -205,9 +206,9 @@ def _router(flat: jax.Array, layer: Dict[str, jax.Array], cfg: MoeConfig):
 def _expert_swiglu(buf: jax.Array, layer: Dict[str, jax.Array], ct) -> jax.Array:
     """Per-expert SwiGLU as batched einsums over the (ep-shardable) leading
     expert axis: [E, C, e] -> [E, C, e]."""
-    g = jnp.einsum("Ece,Eef->Ecf", buf, layer["w_gate"].astype(ct))
-    u = jnp.einsum("Ece,Eef->Ecf", buf, layer["w_up"].astype(ct))
-    return jnp.einsum("Ecf,Efe->Ece", jax.nn.silu(g) * u, layer["w_down"].astype(ct))
+    g = weight_einsum("Ece,Eef->Ecf", buf, layer["w_gate"], ct)
+    u = weight_einsum("Ece,Eef->Ecf", buf, layer["w_up"], ct)
+    return weight_einsum("Ecf,Efe->Ece", jax.nn.silu(g) * u, layer["w_down"], ct)
 
 
 def _aux_losses(logits, probs, eidx, keep, cfg: MoeConfig):

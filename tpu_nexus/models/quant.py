@@ -1,31 +1,49 @@
-"""Int8 weight-only quantization for memory-bound decoding.
+"""Int8/int4 weight-only quantization for memory-bound decoding.
 
 Decode re-reads every parameter each step and measured ~63% of HBM
-bandwidth on weight traffic (PERF.md r3 decode section) — so halving the
-bytes is the serving lever, and weight-only int8 does it without touching
-activations or accumulation.
+bandwidth on weight traffic (PERF.md r3 decode section) — so halving
+(int8) or quartering (int4) the bytes is the serving lever, and
+weight-only quantization does it without touching activations or
+accumulation.
 
-Design: a :class:`QTensor` pytree wrapper (int8 values + per-output-channel
-f32 scales) that implements ``.astype(dtype)`` as dequantization.  Every
-matmul weight in the model zoo is consumed as ``layer[name].astype(ct)``
-(models/llama.py, models/moe.py), so quantized params flow through the
-UNCHANGED forward/decode code — ``lax.scan`` slices the stacked q/s leaves
-per layer like any other weight, and XLA fuses the convert+scale into the
-dot-general's operand read, so the weights cross HBM as int8.
+Design: pytree wrappers that implement ``.astype(dtype)`` as
+dequantization.  Every matmul weight in the model zoo is consumed as
+``layer[name].astype(ct)`` or through
+:func:`tpu_nexus.ops.quant_matmul.weight_einsum` (models/llama.py,
+models/moe.py, models/generate.py), so quantized params flow through the
+UNCHANGED forward/decode code — ``lax.scan`` slices the stacked q/s
+leaves per layer like any other weight, and either XLA fuses the
+convert+scale into the dot-general's operand read or the fused Pallas
+kernel (ops/quant_matmul.py) dequantizes inside the matmul, so the
+weights cross HBM packed.
 
-Scales are symmetric per output channel (amax over the contraction dims /
-127), the standard weight-only recipe.  Embeddings/norms stay in the
-original dtype: norms are tiny, and the embedding table is consumed by
-row-gather (and, tied, as the head) where a full-table dequant per step
-would cost more than it saves.
+* :class:`QTensor` — int8 values in the weight's ORIGINAL shape +
+  per-output-channel f32 scales (amax over the contraction dims / 127),
+  the standard weight-only recipe.
+* :class:`QTensor4` — packed int4 (two signed nibbles per int8 byte) in a
+  2D-ified ``[*lead, K/2, N]`` layout + GROUP-WISE (sub-channel) f32
+  scales ``[*lead, K/G, N]``: per-channel scaling is too coarse at 4 bits
+  (one outlier poisons the whole channel), group scales bound the error
+  to a ``G``-row window.  Packing is per-group half-split (nibble pairs
+  ``(k, k + G/2)`` within each group) so a K-blocked kernel unpacks with
+  one sublane concat instead of an element interleave.
+
+Embeddings/norms stay in the original dtype: norms are tiny, and the
+embedding table is consumed by row-gather (and, tied, as the head) where
+a full-table dequant per step would cost more than it saves.
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+
+#: default int4 group size: divides every contraction width in the model
+#: zoo (tiny hidden 64 .. nexus_1b intermediate 8192) and is coarse
+#: enough that group scales stay <7% of the packed-nibble bytes
+DEFAULT_INT4_GROUP = 64
 
 
 @jax.tree_util.register_pytree_node_class
@@ -58,6 +76,89 @@ class QTensor:
         return f"QTensor(int8 {self.q.shape}, scales {self.s.shape})"
 
 
+def _pack_nibbles(q4: jax.Array, group: int) -> jax.Array:
+    """``[*lead, K, N]`` int4-valued int8 -> ``[*lead, K/2, N]`` packed.
+
+    Per-group half-split order: within each ``group``-row window the low
+    nibble of packed row ``i`` holds unpacked row ``i`` and the high
+    nibble holds row ``i + group/2`` — block-local for any kernel K-block
+    that is a whole number of groups (ops/quant_matmul.py relies on
+    this)."""
+    lead = q4.shape[:-2]
+    k, n = q4.shape[-2], q4.shape[-1]
+    g = q4.reshape(*lead, k // group, group, n)
+    lo, hi = g[..., : group // 2, :], g[..., group // 2 :, :]
+    packed = jnp.bitwise_or(jnp.bitwise_and(lo, 15), jnp.left_shift(hi, 4))
+    return packed.reshape(*lead, k // 2, n)
+
+
+def _unpack_nibbles(packed: jax.Array, group: int) -> jax.Array:
+    """Inverse of :func:`_pack_nibbles`: sign-extend both nibbles and undo
+    the per-group half-split."""
+    lead = packed.shape[:-2]
+    kp, n = packed.shape[-2], packed.shape[-1]
+    g = packed.reshape(*lead, (2 * kp) // group, group // 2, n)
+    lo = jnp.right_shift(jnp.left_shift(g, 4), 4)  # arithmetic: sign-extends
+    hi = jnp.right_shift(g, 4)
+    return jnp.concatenate([lo, hi], axis=-2).reshape(*lead, 2 * kp, n)
+
+
+@jax.tree_util.register_pytree_node_class
+class QTensor4:
+    """Packed int4 values + group-wise f32 scales; ``astype`` dequantizes.
+
+    ``q`` is ``[*lead, K/2, N]`` int8 (nibble-packed along the contraction
+    dim), ``s`` is ``[*lead, K/G, N]`` f32.  Only the TRAILING logical
+    shape lives in aux data (``contract_shape``/``out_shape``/``group``),
+    so per-layer slicing — ``jax.tree.map(lambda a: a[i], layers)`` and
+    ``lax.scan`` over the stacked leaves — reconstructs a valid QTensor4
+    with the lead dims naturally dropped."""
+
+    def __init__(
+        self,
+        q: jax.Array,
+        s: jax.Array,
+        contract_shape: Tuple[int, ...],
+        out_shape: Tuple[int, ...],
+        group: int,
+    ) -> None:
+        self.q = q
+        self.s = s
+        self.contract_shape = tuple(contract_shape)
+        self.out_shape = tuple(out_shape)
+        self.group = int(group)
+
+    def tree_flatten(self):
+        return (self.q, self.s), (self.contract_shape, self.out_shape, self.group)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def shape(self):
+        return tuple(self.q.shape[:-2]) + self.contract_shape + self.out_shape
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    def astype(self, dtype) -> jax.Array:
+        lead = self.q.shape[:-2]
+        k = 2 * self.q.shape[-2]
+        n = self.q.shape[-1]
+        vals = _unpack_nibbles(self.q, self.group).astype(jnp.float32)
+        vals = vals.reshape(*lead, k // self.group, self.group, n)
+        w = vals * self.s.astype(jnp.float32)[..., :, None, :]
+        return w.reshape(*lead, *self.contract_shape, *self.out_shape).astype(dtype)
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return (
+            f"QTensor4(packed int4 {self.q.shape}, group {self.group} "
+            f"scales {self.s.shape}, logical {self.shape})"
+        )
+
+
 #: contraction axes per weight name, counted from the END so the same rule
 #: covers the Llama stacks [L, ...] and the MoE expert stacks [L, E, ...]:
 #: qkv projections contract the embedding dim at -3; the output projection
@@ -82,21 +183,83 @@ def quantize_tensor(w: jax.Array, axes: tuple) -> QTensor:
     return QTensor(q, s)
 
 
-def quantize_params(params: Dict[str, Any]) -> Dict[str, Any]:
+def _split_shape(shape: tuple, axes: tuple) -> Tuple[tuple, tuple, tuple]:
+    """``(lead, contract, out)`` sub-shapes for one ``_CONTRACT_AXES``
+    entry.  Every quantizable weight is laid out ``[*lead, *contract,
+    *out]`` (the axes are a contiguous negative run just before the output
+    dims), which is what makes the 2D-ified ``[K, N]`` layout a contiguous
+    reshape."""
+    n_out = -max(axes) - 1
+    n_contract = len(axes)
+    lead = shape[: len(shape) - n_contract - n_out]
+    contract = shape[len(lead) : len(lead) + n_contract]
+    out = shape[len(shape) - n_out :]
+    return lead, contract, out
+
+
+def quantize_tensor_int4(w: jax.Array, axes: tuple, group: int, *, name: str = "?") -> QTensor4:
+    """Symmetric int4 with group-wise scales: amax over each ``group``-row
+    window of the 2D-ified ``[K, N]`` weight / 7 (the nibble range is kept
+    symmetric at [-7, 7])."""
+    lead, contract, out = _split_shape(w.shape, axes)
+    k = 1
+    for d in contract:
+        k *= d
+    n = 1
+    for d in out:
+        n *= d
+    if group <= 0 or group % 2:
+        raise ValueError(
+            f"int4 group size must be a positive even number, got {group}"
+        )
+    if k % group:
+        raise ValueError(
+            f"int4 group size {group} does not divide weight {name!r}'s "
+            f"contraction width {k} (shape {tuple(w.shape)}) — pick a "
+            "group that divides every quantized contraction dim "
+            "(NEXUS_QUANT_GROUP)"
+        )
+    w32 = w.astype(jnp.float32).reshape(*lead, k // group, group, n)
+    s = jnp.max(jnp.abs(w32), axis=-2, keepdims=True) / 7.0
+    s = jnp.maximum(s, 1e-12)
+    q4 = jnp.clip(jnp.round(w32 / s), -7, 7).astype(jnp.int8)
+    packed = _pack_nibbles(q4.reshape(*lead, k, n), group)
+    return QTensor4(packed, s[..., 0, :], contract, out, group)
+
+
+def quantize_params(
+    params: Dict[str, Any], mode: str = "int8", group: int = 0
+) -> Dict[str, Any]:
     """Quantize every matmul weight stack of a Llama/MoE params tree
     (norms, router, and embeddings keep their dtype).  The result drops
     into :func:`tpu_nexus.models.generate.generate` (and the full forward)
-    unchanged."""
+    unchanged.  IDEMPOTENT: already-quantized leaves pass through, so the
+    executors' quantize-at-swap seam composes with pre-quantized trees
+    (fleet transforms, tests).  ``group`` is the int4 group size (0 =
+    :data:`DEFAULT_INT4_GROUP`; ignored for int8)."""
+    if mode not in ("int8", "int4"):
+        raise ValueError(f"unknown quantize mode {mode!r}; use 'int8' or 'int4'")
+    g = group or DEFAULT_INT4_GROUP
     layers = dict(params["layers"])
     for name, axes in _CONTRACT_AXES.items():
-        if name in layers:
-            layers[name] = quantize_tensor(layers[name], axes)
+        w = layers.get(name)
+        if w is None or isinstance(w, (QTensor, QTensor4)):
+            continue
+        if mode == "int8":
+            layers[name] = quantize_tensor(w, axes)
+        else:
+            layers[name] = quantize_tensor_int4(w, axes, g, name=name)
     return {**params, "layers": layers}
 
 
 def quantized_bytes(params: Dict[str, Any]) -> int:
     """Weight bytes a decode step reads (diagnostic for the memory-bound
-    model: int8 leaves count 1 byte + scales, others their itemsize)."""
+    model, and the ``load.weight_bytes`` snapshot gauge).  Counts leaves
+    at their STORED width: int8 ``QTensor`` values 1 byte + per-channel
+    scales; ``QTensor4`` packed nibbles at their int8 byte count (two
+    weights per byte — ``q.size`` is already ``K*N/2``) + the f32 group
+    scales (``K/G`` rows, not the per-channel 1); everything else its
+    itemsize."""
     total = 0
     for leaf in jax.tree.leaves(params):
         total += leaf.size * leaf.dtype.itemsize

@@ -1,0 +1,326 @@
+"""Pallas TPU fused dequant-inside-matmul for weight-only-quantized decode.
+
+Decode streams every weight byte every step, so the serving win of
+``models/quant.py`` is banked only if the PACKED tensor is what crosses
+HBM.  The int8 ``QTensor`` path leans on XLA to fuse ``.astype()`` into
+the dot-general's operand read — usually true, never guaranteed, and for
+packed int4 there is no XLA story at all: the nibble unpack (shift/mask/
+concat) is a separate HLO that materializes a full-width int8 copy of the
+weight in HBM before the dot ever runs, unwinding the 4x.
+
+This kernel makes the dequant explicit, inside the matmul block, the same
+move ``ops/decode_attention.py`` makes for the int8 KV cache:
+
+* **K-streamed grid** — ``grid = (N/bn, K/bk)`` with K minor-most; an f32
+  VMEM accumulator persists across the K axis and the output tile flushes
+  once on the final K step.  The quantized weight is the dot's memory
+  operand: int8 (or packed nibbles at half the bytes) crosses HBM, the
+  convert happens on the VMEM tile.
+* **int8: deferred per-channel scale** — the scale is constant along the
+  contracted K, so ``(x · q8) * s == x · (q8 * s)`` exactly; one multiply
+  per OUTPUT tile at finalize instead of one per weight element
+  (``decode_attention``'s k_scale identity, transposed to weights).
+* **int4: in-block group dequant** — group scales vary along K, so the
+  scale cannot be deferred past the dot.  Each K block covers a whole
+  number of groups (``block_k % group == 0``), the per-group HALF-SPLIT
+  packing (``models/quant.py::_pack_nibbles``) makes the unpack
+  block-local and sublane-shaped: arithmetic-shift sign-extension of the
+  two nibble planes + one concat on the second-minor axis — no element
+  interleave, which Mosaic would relayout.
+* **M stays whole** — decode activations are ``[B(*q_len), E]`` with tiny
+  M; one output row-block keeps the accumulator at ``[M, bn]`` f32 VMEM.
+
+Dispatch discipline matches the decode-attention kernel: models call
+:func:`weight_einsum`, which routes plain arrays to the unchanged
+``jnp.einsum`` (bit-identical to the pre-quant forward), quantized
+weights to the kernel when :func:`quant_matmul_supported` says the shapes
+tile (XLA gather/astype fallback otherwise), with the
+``NEXUS_QUANT_KERNEL`` env var replacing the ``auto`` default at trace
+time.  Forcing ``pallas`` on unsupported shapes raises a ValueError that
+names every violated clause.  Bit-parity against the same-op-order XLA
+reference is pinned in interpret mode (tests/test_quant_kernels.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os as _os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpu_nexus.models.quant import QTensor, QTensor4
+
+# Weight tile edges.  Decode is bandwidth-bound like the KV kernel: the
+# tile only has to amortize grid bookkeeping against the DMA.  256x256
+# keeps the int4 worst case (q + dequant temp + acc) well under VMEM at
+# M<=256; env overrides for sweeps.
+BLOCK_K = int(_os.environ.get("NEXUS_QUANT_BLOCK_K", 256))
+BLOCK_N = int(_os.environ.get("NEXUS_QUANT_BLOCK_N", 256))
+
+#: fused-path cap on the activation rows.  The kernel keeps M un-tiled
+#: (acc [M, bn] f32 + x block [M, bk] in VMEM) — right for decode
+#: (M = batch * q_len <= a few hundred) and deliberately NOT for prefill,
+#: whose M = batch * seq belongs on the XLA matmul path anyway
+#: (compute-bound; dequant cost is amortized over S).
+MAX_FUSED_M = 256
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() in ("tpu", "axon")
+    except RuntimeError:  # pragma: no cover - backend init failure
+        return False
+
+
+def _geometry(w):
+    """``(lead, contract, out)`` sub-shapes of a quantized weight.
+
+    ``QTensor4`` carries its split in aux data.  ``QTensor`` stores q in
+    the ORIGINAL weight shape; its contraction dims are exactly the dims
+    its keepdims scale collapsed to 1 — a contiguous run (the
+    ``_CONTRACT_AXES`` table), with anything before it a batching lead
+    (MoE expert stacks) and anything after it the output dims."""
+    if isinstance(w, QTensor4):
+        nl = w.q.ndim - 2
+        return w.q.shape[:nl], w.contract_shape, w.out_shape
+    dims = [
+        d for d in range(w.q.ndim) if w.s.shape[d] == 1 and w.q.shape[d] != 1
+    ]
+    first, last = dims[0], dims[-1]
+    return w.q.shape[:first], w.q.shape[first : last + 1], w.q.shape[last + 1 :]
+
+
+def _prod(shape) -> int:
+    out = 1
+    for d in shape:
+        out *= d
+    return out
+
+
+def quant_matmul_supported(x: jax.Array, w) -> bool:
+    """Shapes the fused kernel handles; ``weight_einsum`` falls back to
+    the XLA astype path otherwise.  Clauses: quantized weight with no
+    batching lead dims (MoE expert stacks stay on the batched einsum); x's
+    trailing dims match the weight's contraction dims; decode-sized M
+    (see :data:`MAX_FUSED_M`); Mosaic tiling of the weight operand —
+    lanes N % 128, second-minor K % 32 for int8 / packed K/2 % 32 for
+    int4; TPU backend."""
+    if not isinstance(w, (QTensor, QTensor4)):
+        return False
+    lead, contract, out = _geometry(w)
+    if lead:
+        return False
+    nc = len(contract)
+    if x.ndim <= nc or x.shape[x.ndim - nc :] != tuple(contract):
+        return False
+    if _prod(x.shape[: x.ndim - nc]) > MAX_FUSED_M:
+        return False
+    k, n = _prod(contract), _prod(out)
+    if not _on_tpu():
+        return False
+    if n % 128:
+        return False
+    if isinstance(w, QTensor4):
+        return (k // 2) % 32 == 0
+    return k % 32 == 0
+
+
+def _int8_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x_blk = x_ref[...]  # [M, bk]
+    # int8 is the dot's memory operand (packed bytes crossed HBM); the
+    # convert to x's compute dtype happens on the VMEM tile
+    acc_ref[...] += jax.lax.dot_general(
+        x_blk, q_ref[...].astype(x_blk.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        # deferred dequant: the per-output-channel scale is constant along
+        # the contracted K, so scaling the f32 accumulation is exact
+        o_ref[...] = (acc_ref[...] * s_ref[...]).astype(o_ref.dtype)
+
+
+def _int4_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int, group: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    x_blk = x_ref[...]  # [M, bk]
+    packed = q_ref[...]  # [bk/2, bn] int8, whole groups (bk % group == 0)
+    bkp, bn = packed.shape
+    planes = packed.reshape((2 * bkp) // group, group // 2, bn)
+    lo = jnp.right_shift(jnp.left_shift(planes, 4), 4)  # arithmetic: sign-extends
+    hi = jnp.right_shift(planes, 4)
+    # per-group half-split packing: the halves concatenate on the
+    # second-minor (sublane) axis — no element interleave for Mosaic to
+    # fight.  Group scales vary along K, so dequant happens HERE, before
+    # the dot (the int8 defer identity does not hold).
+    vals = jnp.concatenate([lo, hi], axis=1).astype(jnp.float32)  # [bk/G, G, bn]
+    w_blk = (vals * s_ref[...][:, None, :]).reshape(2 * bkp, bn)
+    acc_ref[...] += jax.lax.dot_general(
+        x_blk, w_blk.astype(x_blk.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(ki == n_k - 1)
+    def _finalize():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def quant_matmul(
+    x: jax.Array,
+    w,
+    *,
+    block_k: int = 0,
+    block_n: int = 0,
+    interpret=None,
+) -> jax.Array:
+    """Fused ``x @ dequant(w)`` for a 2D activation ``x`` [M, K] against a
+    lead-dim-free :class:`QTensor` (int8, per-output-channel scales) or
+    :class:`QTensor4` (packed int4, group scales).  Returns [M, N] in x's
+    dtype with f32 accumulation — op-order-identical to the XLA reference
+    ``x @ w.astype(x.dtype)`` when K fits one block.
+
+    ``interpret`` defaults to True off-TPU so the kernel is testable on
+    the CPU mesh (pallas interpreter mode)."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    int4 = isinstance(w, QTensor4)
+    lead, contract, out = _geometry(w)
+    k, n = _prod(contract), _prod(out)
+    problems = []
+    if lead:
+        problems.append(
+            f"weight has batching lead dims {tuple(lead)} (MoE expert "
+            "stack) — the kernel is 2D"
+        )
+    if x.ndim != 2:
+        problems.append(f"x must be 2D [M, K], got {x.shape}")
+    elif x.shape[1] != k:
+        problems.append(f"x K {x.shape[1]} != weight contraction width {k}")
+    if x.ndim == 2 and x.shape[0] > MAX_FUSED_M:
+        problems.append(
+            f"M {x.shape[0]} > MAX_FUSED_M {MAX_FUSED_M} (prefill-sized "
+            "activations belong on the XLA matmul path)"
+        )
+    if not (interpret or not _on_tpu()):
+        if n % 128:
+            problems.append(f"N {n} % 128 != 0 (Mosaic lane tiling)")
+        kk = k // 2 if int4 else k
+        if kk % 32:
+            problems.append(
+                f"{'packed K/2' if int4 else 'K'} {kk} % 32 != 0 "
+                "(Mosaic second-minor tiling)"
+            )
+    if problems:
+        raise ValueError(
+            "quant_matmul unsupported shapes: " + "; ".join(problems)
+            + " — use the XLA astype path (weight_einsum auto dispatch)"
+        )
+
+    m = x.shape[0]
+    bk = min(block_k or BLOCK_K, k)
+    bn = min(block_n or BLOCK_N, n)
+    if int4 and (bk % w.group or k % bk):
+        bk = k  # K is a whole number of groups by construction
+    elif k % bk:
+        bk = k
+    if n % bn:
+        bn = n
+    n_k, n_n = k // bk, n // bn
+
+    if int4:
+        q2 = w.q.reshape(k // 2, n)
+        s2 = w.s.reshape(k // w.group, n)
+        kernel = functools.partial(_int4_kernel, n_k=n_k, group=w.group)
+        in_specs = [
+            pl.BlockSpec((m, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk // 2, bn), lambda i, j: (j, i)),
+            pl.BlockSpec((bk // w.group, bn), lambda i, j: (j, i)),
+        ]
+    else:
+        q2 = w.q.reshape(k, n)
+        s2 = w.s.reshape(1, n).astype(jnp.float32)
+        kernel = functools.partial(_int8_kernel, n_k=n_k)
+        in_specs = [
+            pl.BlockSpec((m, bk), lambda i, j: (0, j)),
+            pl.BlockSpec((bk, bn), lambda i, j: (j, i)),
+            pl.BlockSpec((1, bn), lambda i, j: (0, i)),
+        ]
+
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((m, n), x.dtype),
+        grid=(n_n, n_k),  # K minor-most: the acc carry persists across it
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((m, bn), lambda i, j: (0, i)),
+        scratch_shapes=[pltpu.VMEM((m, bn), jnp.float32)],
+        cost_estimate=pl.CostEstimate(
+            flops=2 * m * k * n,
+            # the bandwidth story: packed weight bytes dominate; x/out/
+            # scales are noise at decode M
+            bytes_accessed=q2.size * q2.dtype.itemsize
+            + s2.size * 4
+            + (m * k + m * n) * x.dtype.itemsize,
+            transcendentals=0,
+        ),
+        interpret=interpret,
+    )(x, q2, s2)
+
+
+def weight_einsum(spec: str, x: jax.Array, w, ct, *, impl: str = "auto") -> jax.Array:
+    """The models' weight-matmul call site: ``einsum(spec, x, w)`` with
+    the weight consumed at compute dtype ``ct``.
+
+    Plain arrays take the unchanged ``jnp.einsum(spec, x, w.astype(ct))``
+    — bit-identical to the pre-quantization forward.  Quantized weights
+    auto-dispatch to :func:`quant_matmul` when the shapes tile
+    (:func:`quant_matmul_supported`), else the XLA astype fallback, with
+    ``NEXUS_QUANT_KERNEL`` in {``pallas``, ``xla``} replacing the ``auto``
+    default at trace time (same escape hatch as ``NEXUS_DECODE_KERNEL``).
+
+    The fused path assumes the spec's standard weight-matmul shape —
+    ``x``'s trailing dims are exactly the weight's contraction dims and
+    the output appends the weight's out dims (true of every projection/
+    MLP spec in the model zoo); batched specs (MoE expert stacks) carry
+    lead dims and always take the einsum paths."""
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"unknown weight_einsum impl {impl!r}; use 'auto', 'pallas', or 'xla'"
+        )
+    if not isinstance(w, (QTensor, QTensor4)):
+        return jnp.einsum(spec, x, w.astype(ct))
+    if impl == "auto":
+        impl = _os.environ.get("NEXUS_QUANT_KERNEL", "") or "auto"
+        if impl not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"NEXUS_QUANT_KERNEL={impl!r} is not a weight-matmul impl; "
+                "use 'pallas' or 'xla' (unset = auto)"
+            )
+    if impl == "xla" or (impl == "auto" and not quant_matmul_supported(x, w)):
+        return jnp.einsum(spec, x, w.astype(ct))
+    _, contract, out = _geometry(w)
+    nc = len(contract)
+    if x.ndim < nc or tuple(x.shape[x.ndim - nc :]) != tuple(contract):
+        raise ValueError(
+            f"quant_matmul unsupported shapes: x {tuple(x.shape)} does not "
+            f"end with the weight contraction dims {tuple(contract)} — use "
+            "the XLA astype path (weight_einsum auto dispatch)"
+        )
+    batch = x.shape[: x.ndim - nc]
+    x2 = x.astype(ct).reshape(_prod(batch), _prod(contract))
+    return quant_matmul(x2, w).reshape(*batch, *out)

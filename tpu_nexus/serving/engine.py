@@ -156,16 +156,23 @@ class _ExecutorCommon:
         seed: int,
         decode_steps: int = 1,
         stop_token: int = -1,
+        quantize: str = "",
+        quant_group: int = 0,
     ):
         import functools
 
         import jax
 
         from tpu_nexus.models.generate import sample_logits
+        from tpu_nexus.models.quant import quantize_params, quantized_bytes
 
         if decode_kernel not in ("auto", "pallas", "xla"):
             raise ValueError(
                 f"unknown decode_kernel mode {decode_kernel!r}; use auto, pallas, or xla"
+            )
+        if quantize not in ("", "int8", "int4"):
+            raise ValueError(
+                f"unknown quantize mode {quantize!r}; use 'int8' or 'int4'"
             )
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
@@ -173,7 +180,20 @@ class _ExecutorCommon:
             raise ValueError("top_k/top_p truncation requires temperature > 0")
         if decode_steps < 1:
             raise ValueError(f"decode_steps must be >= 1, got {decode_steps}")
+        #: weight-quantization mode the executor SERVES at.  The transform
+        #: is applied here (idempotently — pre-quantized trees pass
+        #: through, e.g. the sharded mixin quantizes before computing its
+        #: shard layout) and re-applied to every :meth:`swap_params` tree,
+        #: so rolling updates hand the executor plain bf16 checkpoints.
+        self.quantize = quantize
+        self.quant_group = int(quant_group)
+        if quantize:
+            params = quantize_params(params, mode=quantize, group=self.quant_group)
         self.params = params
+        #: stored weight-tree bytes (packed widths), surfaced per replica
+        #: in ``ServingEngine.load_snapshot`` — the replicas-per-chip
+        #: headroom gauge
+        self.weight_bytes = int(quantized_bytes(self.params))
         self.cfg = cfg
         self.num_slots = num_slots
         self.max_len = max_len
@@ -246,24 +266,42 @@ class _ExecutorCommon:
         mismatched swap would otherwise silently retrace every jit
         (doubling compile cost mid-rollout) or fail deep inside XLA.
 
+        Quantized serving (``self.quantize``): the incoming tree is the
+        verified HOST checkpoint in bf16/f32; the executor applies its own
+        quantize transform here, BEFORE the spec check and the per-shard
+        install, so rolling updates ship plain checkpoints and sharded
+        replicas quantize locally (no host gather).  The transform is
+        idempotent, so pre-quantized trees (fleet-level transforms) also
+        pass.
+
         Contract (nxlint NX008): the caller resolved ``params`` from a
         VERIFIED checkpoint step — ``restore_params()`` / a
         ``latest_verified_step()`` resolution — never from a bare
         ``save()``; this is the serving mirror of the NX007 publish
         barrier.  The ENGINE-level protocol (quiesce first, reset the
         prefix index) lives in :meth:`ServingEngine.swap_params`."""
+        if self.quantize:
+            from tpu_nexus.models.quant import quantize_params
+
+            params = quantize_params(
+                params, mode=self.quantize, group=self.quant_group
+            )
 
         def spec(tree):
             # treedef alone is blind to leaf shapes/dtypes — the exact
             # mismatch (same-architecture model, different hidden size;
-            # unquantized weights into an int8 fleet) this guard exists for
-            return self._jax.tree.map(
-                lambda leaf: (
+            # unquantized weights into an int8 fleet) this guard exists
+            # for.  Compare (treedef, per-leaf spec) rather than a mapped
+            # tree: QTensor/QTensor4 container nodes compare by identity,
+            # so a mapped tree of equal leaf specs would still be unequal
+            leaves, treedef = self._jax.tree.flatten(tree)
+            return treedef, [
+                (
                     tuple(getattr(leaf, "shape", ())),
                     str(getattr(leaf, "dtype", type(leaf).__name__)),
-                ),
-                tree,
-            )
+                )
+                for leaf in leaves
+            ]
 
         old, new = spec(self.params), spec(params)
         if old != new:
@@ -273,6 +311,9 @@ class _ExecutorCommon:
                 "missing quantization transform"
             )
         self.params = self._install_params(params)
+        from tpu_nexus.models.quant import quantized_bytes
+
+        self.weight_bytes = int(quantized_bytes(self.params))
 
     def _guard_cache(self, exc: RuntimeError) -> None:
         """After a faulted jitted call: if the DONATED cache buffer was
@@ -320,6 +361,8 @@ class ModelExecutor(_ExecutorCommon):
         seed: int = 0,
         decode_steps: int = 1,
         stop_token: int = -1,
+        quantize: str = "",
+        quant_group: int = 0,
     ) -> None:
         from tpu_nexus.models.generate import (
             decode_scan,
@@ -333,6 +376,7 @@ class ModelExecutor(_ExecutorCommon):
             kv_quant=kv_quant, decode_kernel=decode_kernel,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             decode_steps=decode_steps, stop_token=stop_token,
+            quantize=quantize, quant_group=quant_group,
         )
         jnp = jax.numpy
         self.cache = self._fresh_cache()
@@ -552,6 +596,8 @@ class PagedModelExecutor(_ExecutorCommon):
         seed: int = 0,
         decode_steps: int = 1,
         stop_token: int = -1,
+        quantize: str = "",
+        quant_group: int = 0,
     ) -> None:
         from tpu_nexus.models.generate import (
             decode_scan,
@@ -567,6 +613,7 @@ class PagedModelExecutor(_ExecutorCommon):
             kv_quant=kv_quant, decode_kernel=decode_kernel,
             temperature=temperature, top_k=top_k, top_p=top_p, seed=seed,
             decode_steps=decode_steps, stop_token=stop_token,
+            quantize=quantize, quant_group=quant_group,
         )
         jnp = jax.numpy
         if page_size < 1:
@@ -1781,6 +1828,7 @@ class ServingEngine:
             blocks_used=blocks_used,
             blocks_free=blocks_free,
             blocks_reclaimable=reclaimable,
+            weight_bytes=getattr(self.executor, "weight_bytes", 0),
             weight_swaps=self.weight_swaps,
             shed_total=self.metrics.shed_total,
             requests_retired=self.retired_total,

@@ -130,6 +130,10 @@ class LoadSnapshot:
     blocks_used: int = 0
     blocks_free: int = 0
     blocks_reclaimable: int = 0
+    #: stored weight-tree bytes at the executor's serving width (packed
+    #: int8/int4 counted at their quantized size) — the replicas-per-chip
+    #: headroom weight quantization buys, visible per fleet snapshot
+    weight_bytes: int = 0
     weight_swaps: int = 0
     shed_total: int = 0
     requests_retired: int = 0
@@ -246,6 +250,7 @@ def emit_load_snapshot(
     metrics.gauge("load.blocks_used", snap.blocks_used, tags=tags)
     metrics.gauge("load.blocks_free", snap.blocks_free, tags=tags)
     metrics.gauge("load.blocks_reclaimable", snap.blocks_reclaimable, tags=tags)
+    metrics.gauge("load.weight_bytes", snap.weight_bytes, tags=tags)
     metrics.gauge("load.weight_swaps", snap.weight_swaps, tags=tags)
     metrics.gauge("load.shed_total", snap.shed_total, tags=tags)
     metrics.gauge("load.requests_retired", snap.requests_retired, tags=tags)

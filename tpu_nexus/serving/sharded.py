@@ -97,6 +97,19 @@ SERVING_PARAM_RULES: Tuple[Tuple[str, Tuple[Optional[str], ...]], ...] = (
     ("layers/wk", ("layers", "embed", "kv_heads", "head_dim")),
     ("layers/wv", ("layers", "embed", "kv_heads", "head_dim")),
     ("layers/wo", ("layers", "heads", "head_dim", "embed")),
+    # int4 (QTensor4) attention children are 2D-ified [L, K/2, N] packed
+    # nibbles + [L, K/G, N] group scales — RANK 3, so these rows never
+    # shadow the rank-4 bf16/int8 rows above.  Both children of a weight
+    # partition alike (the flattened out dim carries the head sharding;
+    # wo's CONTRACTION rows carry it, exactly like its rank-4 row), and
+    # group-scale rows ride the same rule — their collapsed dims are
+    # size-1 and replicate via serving_param_shardings.  The dense MLP
+    # int4 children are already rank 3 and match the w_gate/w_up/w_down
+    # rows below unchanged.
+    ("layers/wq", ("layers", None, "heads")),
+    ("layers/wk", ("layers", None, "kv_heads")),
+    ("layers/wv", ("layers", None, "kv_heads")),
+    ("layers/wo", ("layers", "heads", None)),
     # dense (Llama) MLP: [L, E, F] / [L, F, E]
     ("layers/w_gate", ("layers", "embed", "mlp")),
     ("layers/w_up", ("layers", "embed", "mlp")),
@@ -163,13 +176,25 @@ def parse_serve_mesh(spec: str) -> Dict[str, int]:
 
 
 def validate_serve_mesh(
-    axes: Dict[str, int], model_cfg: Any, n_devices: Optional[int] = None
+    axes: Dict[str, int],
+    model_cfg: Any,
+    n_devices: Optional[int] = None,
+    *,
+    quantize: str = "",
+    quant_group: int = 0,
 ) -> None:
     """Fail-fast checks a serve mesh must pass BEFORE any device work:
     total size fits the available devices, and the tp/ep factors divide
     the model's sharded dimensions (heads, kv-heads, mlp width, vocab —
     a non-divisible head count would otherwise die deep inside GSPMD
-    with a shape error naming no config knob)."""
+    with a shape error naming no config knob).
+
+    ``quantize="int4"`` extends the tp checks to the PACKED layout: the
+    int4 children are 2D-ified ``[L, K/2, N]`` / ``[L, K/G, N]``, so
+    where TP shards a contraction dim (``w_down``'s mlp rows, ``wo``'s
+    head rows) it must divide the halved packed row count AND the
+    group-scale row count — dimensions that do not exist in the bf16
+    tree and would otherwise only fail at ``device_put`` time."""
     size = 1
     for s in axes.values():
         size *= s
@@ -198,6 +223,41 @@ def validate_serve_mesh(
                     f"({attr}) — pick a tp that divides every sharded "
                     "dimension"
                 )
+        if quantize == "int4":
+            from tpu_nexus.models.quant import DEFAULT_INT4_GROUP
+
+            g = quant_group or DEFAULT_INT4_GROUP
+            packed: List[Tuple[int, str]] = []
+            f = getattr(model_cfg, "intermediate", None)
+            if f is not None:
+                packed.append(
+                    (f // 2, f"packed MLP contraction rows (intermediate {f} / 2, w_down)")
+                )
+                packed.append(
+                    (f // g, f"MLP group-scale rows (intermediate {f} / group {g}, w_down)")
+                )
+            hq = getattr(model_cfg, "n_heads", None)
+            d = getattr(model_cfg, "head_dim", None)
+            if hq is not None and d is not None:
+                packed.append(
+                    (
+                        hq * d // 2,
+                        f"packed output-projection rows (n_heads*head_dim {hq * d} / 2, wo)",
+                    )
+                )
+                packed.append(
+                    (
+                        hq * d // g,
+                        f"output-projection group-scale rows (n_heads*head_dim {hq * d} / group {g}, wo)",
+                    )
+                )
+            for dim, what in packed:
+                if dim % tp:
+                    raise ShardingError(
+                        f"tp={tp} does not divide the int4 model's {dim} "
+                        f"{what} — pick a tp/NEXUS_QUANT_GROUP pair that "
+                        "divides every sharded packed dimension"
+                    )
     ep = axes.get("ep", 1)
     if ep > 1:
         n_exp = getattr(model_cfg, "n_experts", None)
@@ -406,13 +466,29 @@ class _ShardedExecutorMixin:
 
         self.mesh = mesh
         axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-        # fail-fast on the model facts (head/width divisibility) before
-        # any allocation; mesh size vs devices was checked at mesh build
+        # fail-fast on the model facts (head/width divisibility, packed
+        # int4 dims) before any allocation; mesh size vs devices was
+        # checked at mesh build
         validate_serve_mesh(
             {k: v for k, v in axis_sizes.items() if v > 1},
             cfg,
             n_devices=int(mesh.devices.size),
+            quantize=kwargs.get("quantize", ""),
+            quant_group=kwargs.get("quant_group", 0),
         )
+        # quantize BEFORE computing the shard layout: the sharding tree
+        # must mirror the tree the executor actually serves (packed int4
+        # children have their own rank-3 rules), and the base
+        # ``_init_common`` quantize is idempotent so the already-quantized
+        # tree passes through it untouched
+        if kwargs.get("quantize", ""):
+            from tpu_nexus.models.quant import quantize_params
+
+            params = quantize_params(
+                params,
+                mode=kwargs["quantize"],
+                group=kwargs.get("quant_group", 0),
+            )
         self._param_shardings = serving_param_shardings(
             params, mesh, rule_table, rules
         )

@@ -58,11 +58,21 @@ class ServeConfig:
     heartbeat_every: int = 2
     checkpoint_dir: str = ""
     seed: int = 0
-    #: "int8" = weight-only quantized decoding (models/quant.py): ~1.9x
+    #: weight-only quantized decoding (models/quant.py): "int8" = ~1.9x
     #: less weight traffic per decode step; composed with the KV-carry fix
     #: it measures 1.15-1.43x alone (batch 64 -> 1), and 1.6x together
-    #: with quantize_kv (PERF.md r5 roofline table); "" = full precision
+    #: with quantize_kv (PERF.md r5 roofline table); "int4" = packed
+    #: nibbles + group scales, ~4x less weight traffic, gated like int8
+    #: (tools/int4_gate_1b.py); "" = full precision.  The executors apply
+    #: the transform themselves (construction AND every swap), so rolling
+    #: updates ship plain bf16 checkpoints (NEXUS_QUANTIZE)
     quantize: str = ""
+    #: int4 group size — contraction rows per scale (models/quant.py
+    #: DEFAULT_INT4_GROUP when 0).  Must divide every quantized
+    #: contraction width (hidden, intermediate, n_heads*head_dim) and is
+    #: only meaningful with quantize="int4" — both validated at parse
+    #: (NEXUS_QUANT_GROUP)
+    quant_group: int = 0
     #: "int8" = int8 KV cache (models/generate.py): halves cache traffic
     #: and doubles the context budget per byte; dequant deferred past the
     #: attention dots, so composed with quantize="int8" it is the fastest
@@ -196,8 +206,51 @@ class ServeConfig:
         # config (NEXUS_QUANTIZE=int4, NEXUS_DECODE_KERNEL=triton, ...)
         # must fail at parse time in BOTH the lockstep loop and the
         # continuous-batching engine, before any model/device work starts
-        if self.quantize not in ("", "int8"):
-            raise ValueError(f"unknown quantize mode {self.quantize!r}; use 'int8'")
+        if self.quantize not in ("", "int8", "int4"):
+            raise ValueError(
+                f"unknown quantize mode {self.quantize!r}; use 'int8' or 'int4'"
+            )
+        if self.quant_group < 0:
+            raise ValueError(
+                f"quant_group (NEXUS_QUANT_GROUP) must be >= 0, got "
+                f"{self.quant_group}"
+            )
+        if self.quant_group and self.quantize != "int4":
+            # a group size silently ignored under int8/full-precision would
+            # let a typo'd NEXUS_QUANTIZE ship the wrong width unnoticed
+            raise ValueError(
+                f"quant_group (NEXUS_QUANT_GROUP={self.quant_group}) only "
+                f"applies to quantize='int4', got quantize={self.quantize!r}"
+            )
+        if self.quantize == "int4":
+            from tpu_nexus.models.quant import DEFAULT_INT4_GROUP
+
+            group = self.quant_group or DEFAULT_INT4_GROUP
+            if group % 2:
+                raise ValueError(
+                    f"quant_group (NEXUS_QUANT_GROUP) must be even (two "
+                    f"nibbles pack per byte within a group), got {group}"
+                )
+            model_cfg = getattr(self.model, "config", self.model)
+            widths = []
+            hidden = getattr(model_cfg, "hidden", None)
+            if hidden is not None:
+                widths.append((hidden, "hidden (wq/wk/wv/w_gate/w_up contraction)"))
+            inter = getattr(model_cfg, "intermediate", None)
+            if inter is not None:
+                widths.append((inter, "intermediate (w_down contraction)"))
+            hq = getattr(model_cfg, "n_heads", None)
+            hd = getattr(model_cfg, "head_dim", None)
+            if hq is not None and hd is not None:
+                widths.append((hq * hd, "n_heads*head_dim (wo contraction)"))
+            for width, what in widths:
+                if width % group:
+                    raise ValueError(
+                        f"quant_group (NEXUS_QUANT_GROUP={group}) does not "
+                        f"divide the model's {width} {what} — every "
+                        "quantized contraction width must be a whole "
+                        "number of groups"
+                    )
         if self.quantize_kv not in ("", "int8"):
             raise ValueError(
                 f"unknown quantize_kv mode {self.quantize_kv!r}; use 'int8'"
@@ -303,7 +356,10 @@ class ServeConfig:
             # NEXUS_SERVE_MESH must fail before any device work starts
             axes = parse_serve_mesh(self.serve_mesh)
             model_cfg = getattr(self.model, "config", self.model)
-            validate_serve_mesh(axes, model_cfg)
+            validate_serve_mesh(
+                axes, model_cfg,
+                quantize=self.quantize, quant_group=self.quant_group,
+            )
         if self.reload_check_interval_s and not self.checkpoint_dir:
             raise ValueError(
                 "reload_check_interval_s (NEXUS_RELOAD_CHECK_S) requires "
@@ -371,6 +427,7 @@ class ServeConfig:
             checkpoint_dir=e.get("NEXUS_CHECKPOINT_DIR", ""),
             seed=int(e.get("NEXUS_SEED", "0")),
             quantize=e.get("NEXUS_QUANTIZE", ""),
+            quant_group=int(e.get("NEXUS_QUANT_GROUP", "0") or 0),
             quantize_kv=e.get("NEXUS_QUANTIZE_KV", ""),
             decode_kernel=e.get("NEXUS_DECODE_KERNEL", "auto"),
             deadline_s=float(e.get("NEXUS_DEADLINE_S", "0")),
@@ -399,7 +456,8 @@ class ServeConfig:
 def _load_serving_params(cfg: ServeConfig, ctx: ProcessContext):
     """Shared serving preamble for both loops: resolve the LM adapter,
     init/restore params (params-only tensor checkpoint, template-free),
-    apply int8 weight-only quantization.  Returns ``(adapter, model_cfg,
+    apply the configured weight-only quantization (int8 or int4).
+    Returns ``(adapter, model_cfg,
     params, restored_from)``.  Config VALUES were already validated at
     ``ServeConfig`` construction."""
     adapter = adapter_for(cfg.model)
@@ -449,8 +507,8 @@ def _load_serving_params(cfg: ServeConfig, ctx: ProcessContext):
     if cfg.quantize:
         from tpu_nexus.models.quant import quantize_params
 
-        params = quantize_params(params)
-        logger.info("serving with int8 weight-only quantization")
+        params = quantize_params(params, mode=cfg.quantize, group=cfg.quant_group)
+        logger.info("serving with %s weight-only quantization", cfg.quantize)
     return adapter, adapter.config, params, restored_from
 
 
@@ -459,7 +517,6 @@ def _reload_if_newer(
     latest: Optional[int],
     checkpoint_dir: str,
     current_step: Optional[int],
-    quantize: str,
     grace_s: float,
 ) -> Optional[int]:
     """One reload decision (``reload_check_interval_s`` cadence):
@@ -485,12 +542,12 @@ def _reload_if_newer(
     ckpt = TensorCheckpointer(checkpoint_dir)
     try:
         try:
+            # NOTE: the restored tree is handed to the engine in its plain
+            # (bf16/f32) host layout — ``swap_params`` owns the quantize
+            # transform (engine.quantize), so sharded replicas quantize
+            # locally per shard without a host gather.
             new_params = ckpt.restore_params(latest)
-            if quantize:
-                from tpu_nexus.models.quant import quantize_params
-
-                new_params = quantize_params(new_params)
-        except (CheckpointError, ValueError) as exc:  # noqa: BLE001 - classified Checkpoint* verdict (failed load-time verification) or transform config fact (quantize rejects the restored tree): keep serving the OLD verified weights — the honest alternative to serving torn/misfitting tensors
+        except (CheckpointError, ValueError) as exc:  # noqa: BLE001 - classified Checkpoint* verdict (failed load-time verification): keep serving the OLD verified weights — the honest alternative to serving torn tensors
             logger.warning(
                 "reload check: candidate step %d failed verification/"
                 "transform (%s); keeping current weights (step %s)",
@@ -682,6 +739,12 @@ def _serve_engine_loop(
         num_slots=cfg.batch_size,
         max_len=cfg.prompt_len + cfg.gen_tokens,
         kv_quant=cfg.quantize_kv,
+        # weight-only quantization is an EXECUTOR property, not a one-shot
+        # load transform: the executor re-applies it at every swap_params
+        # so hot-reloaded bf16 checkpoints ship quantized (idempotent over
+        # the already-quantized tree _load_serving_params hands us here)
+        quantize=cfg.quantize,
+        quant_group=cfg.quant_group,
         decode_kernel=cfg.decode_kernel,
         temperature=cfg.temperature,
         top_k=cfg.top_k,
@@ -754,7 +817,11 @@ def _serve_engine_loop(
                 )
             draft_executor = ModelExecutor(
                 draft_params, draft_cfg,
-                **dict(executor_kwargs, kv_quant=""),
+                # draft runs full-precision: quant_group was validated
+                # against the TARGET model's contraction widths, and the
+                # draft's quality budget is acceptance, not memory
+                **dict(executor_kwargs, kv_quant="", quantize="",
+                       quant_group=0),
             )
             drafter = ModelDrafter(draft_executor)
     # observability layer (ISSUE 14, serving/tracing.py): span timelines +
@@ -871,7 +938,7 @@ def _serve_engine_loop(
                 latest = None  # known-bad candidate, directory unchanged
             reloaded = _reload_if_newer(
                 engine, latest, cfg.checkpoint_dir, serving_step,
-                cfg.quantize, cfg.drain_grace_s,
+                cfg.drain_grace_s,
             )
             if reloaded != serving_step:
                 serving_step = reloaded
