@@ -53,6 +53,20 @@ bench model runs f32: TP psum reordering resolves exact bf16 argmax ties
 differently (docs/SERVING.md "Sharded serving").  Artifact:
 ``NEXUS_SERVING_TP_OUT``, default BENCH_SERVING_TP_r10.json.
 
+``--fleet`` (ISSUE 19) benches the FLEET ROUTER: the same skewed Poisson
+arrival schedule (rate doubled over the middle third) through a
+capacity-skewed fleet — one replica at a quarter of the slots with a
+bounded queue — under blind round-robin vs pressure routing (load-ranked
+candidates + shed-and-retry + prefix affinity).  The headline is
+goodput-at-SLO (tokens from requests meeting the TTFT/TPOT targets per
+wall second); outputs are asserted token-identical across policies, and
+a shared-prefix fan-out section shows affinity co-locating the fan-out
+(fleet prefix hits = fanout - 1) where rotation re-prefills the shared
+prompt per replica.  Artifact: ``NEXUS_FLEET_OUT``, default
+BENCH_FLEET_r14.json.  Knobs: ``NEXUS_FLEET_REPLICAS`` /
+``NEXUS_FLEET_WEAK_SLOTS`` / ``NEXUS_FLEET_REQUESTS`` /
+``NEXUS_FLEET_TTFT_SLO_S`` / ``NEXUS_FLEET_TPOT_SLO_S``.
+
 ``--shared-prefix`` (ISSUE 6) instead benches the PAGED engine on the
 millions-of-users workload: one long system prompt, high fan-out, short
 unique tails.  Both engines get the SAME KV HBM budget (``slots ×
@@ -1165,6 +1179,273 @@ def main_slo():
     print(json.dumps(result))
 
 
+# -- fleet routing workload (ISSUE 19) -----------------------------------------
+
+FLEET_REPLICAS = int(os.environ.get("NEXUS_FLEET_REPLICAS", "3"))
+FLEET_WEAK_SLOTS = int(os.environ.get("NEXUS_FLEET_WEAK_SLOTS", "2"))
+FLEET_REQUESTS = int(os.environ.get("NEXUS_FLEET_REQUESTS", str(2 * N_REQUESTS)))
+FLEET_TTFT_SLO_S = float(os.environ.get("NEXUS_FLEET_TTFT_SLO_S", "0.3"))
+FLEET_TPOT_SLO_S = float(os.environ.get("NEXUS_FLEET_TPOT_SLO_S", "0.08"))
+
+
+def _skewed_offsets(rng, n):
+    """Cumulative Poisson arrival offsets with the rate DOUBLED over the
+    middle third of the request stream: the burst piles backlog onto
+    whichever replica the router is feeding right then, which is exactly
+    when blind rotation and load-ranked admission diverge."""
+    rates = np.full(n, ARRIVAL_RPS)
+    rates[n // 3 : 2 * n // 3] *= 2.0
+    return np.cumsum(rng.exponential(1.0 / rates))
+
+
+def _fleet_of_engines(params, cfg, policy, metrics=None):
+    """FLEET_REPLICAS warmed-up contiguous engines behind one router; the
+    LAST replica is WEAK (FLEET_WEAK_SLOTS slots, bounded queue) — the
+    capacity skew round-robin cannot see and the load scorer can.  The
+    strong replicas keep unbounded queues, so fleet-wide exhaustion never
+    sheds: every request finishes under BOTH policies and the token-
+    identity assert covers the full set."""
+    from tpu_nexus.serving import FifoScheduler, SchedulerConfig, ServingFleet
+
+    fleet = ServingFleet(policy=policy, metrics=metrics)
+    for i in range(FLEET_REPLICAS):
+        weak = i == FLEET_REPLICAS - 1
+        slots = FLEET_WEAK_SLOTS if weak else NUM_SLOTS
+        executor = ModelExecutor(
+            params, cfg, num_slots=slots, max_len=MAX_LEN, seed=SEED
+        )
+        # deep enough that rotation actually PARKS work behind the weak
+        # replica (the realistic failure: latency rots long before a shed
+        # bounces the request) yet bounded, so a sustained burst still
+        # exercises the shed-and-retry hop
+        scheduler = (
+            FifoScheduler(SchedulerConfig(max_queue=6 * slots)) if weak else None
+        )
+        engine = ServingEngine(executor, scheduler=scheduler)
+        for width in (PROMPT_RANGE[0], PROMPT_RANGE[1]):
+            engine.submit(np.arange(1, width + 1, dtype=np.int32), 2)
+        engine.run_until_drained()
+        engine.metrics = ServingMetrics()
+        fleet.add_replica(f"rep-{i}", engine)
+    return fleet
+
+
+def run_fleet_poisson(params, cfg, requests, offsets, policy):
+    """One open-loop pass of the skewed arrival schedule through a fresh
+    fleet under ``policy``.  Returns (summary row, per-request outputs) —
+    outputs feed the cross-policy token-identity assert."""
+    from tpu_nexus.core.telemetry import RecordingMetrics
+    from tpu_nexus.serving import QueueFull
+
+    metrics = RecordingMetrics()
+    fleet = _fleet_of_engines(params, cfg, policy, metrics=metrics)
+    t0 = time.perf_counter()
+    idx = 0
+    sheds = 0
+    while idx < len(requests) or fleet.has_work:
+        now = time.perf_counter() - t0
+        while idx < len(requests) and offsets[idx] <= now:
+            r = requests[idx]
+            try:
+                fleet.submit(r["prompt"], r["gen"], request_id=f"fl-{idx}")
+            except QueueFull:
+                sheds += 1  # fleet-wide exhaustion only; the client owns it
+            idx += 1
+        if fleet.has_work:
+            fleet.tick()
+        elif idx < len(requests):
+            time.sleep(min(0.001, offsets[idx] - now))
+    elapsed = time.perf_counter() - t0
+
+    done = [
+        r
+        for r in fleet.all_retired()
+        if r.request_id.startswith("fl-") and r.state == RequestState.FINISHED
+    ]
+
+    def slo_ok(r):
+        if r.first_token_at is None:
+            return False
+        ttft = r.first_token_at - r.submitted_at
+        n = len(r.output_tokens)
+        tpot = (r.last_token_at - r.first_token_at) / (n - 1) if n > 1 else 0.0
+        return ttft <= FLEET_TTFT_SLO_S and tpot <= FLEET_TPOT_SLO_S
+
+    good = [r for r in done if slo_ok(r)]
+    tokens_all = sum(len(r.output_tokens) for r in done)
+    tokens_good = sum(len(r.output_tokens) for r in good)
+    outputs = {r.request_id: list(r.output_tokens) for r in done}
+    landed = {
+        name: sum(1 for r in rep.all_retired() if r.request_id.startswith("fl-"))
+        for name, rep in fleet.replicas.items()
+    }
+    row = {
+        "policy": policy,
+        "requests": len(requests),
+        "requests_finished": len(done),
+        "requests_meeting_slo": len(good),
+        "tokens": tokens_all,
+        "elapsed_s": round(elapsed, 4),
+        "tokens_per_second": round(tokens_all / elapsed, 2) if elapsed else 0.0,
+        "goodput_tokens_at_slo": tokens_good,
+        "goodput_tokens_per_second_at_slo": (
+            round(tokens_good / elapsed, 2) if elapsed else 0.0
+        ),
+        "fleet_sheds": sheds,
+        "router_retries": fleet.router.retries,
+        "router_retry_counter": metrics.counters.get("serving.router_retry", 0),
+        "landed_per_replica": landed,
+    }
+    return row, outputs
+
+
+def run_fleet_prefix(params, cfg, policy):
+    """The 48x fan-out workload (ISSUE 6) through a PAGED fleet: under
+    affinity the whole fan-out co-locates on one replica (fleet-wide
+    prefix hits = fanout - 1, the shared prompt prefilled ONCE); blind
+    rotation scatters it and EVERY replica pays the shared prefill."""
+    from tpu_nexus.serving import ServingFleet
+
+    budget_rows = NUM_SLOTS * PREFIX_MAX_LEN
+    num_blocks = 1 + budget_rows // PAGE_SIZE
+    lanes = int(os.environ.get("NEXUS_PREFIX_LANES", str(4 * NUM_SLOTS)))
+    fleet = ServingFleet(policy=policy)
+    warm = np.arange(1, SHARED_LEN + TAIL_LEN + 1, dtype=np.int32)
+    for i in range(FLEET_REPLICAS):
+        executor = PagedModelExecutor(
+            params, cfg, num_slots=lanes, max_len=PREFIX_MAX_LEN,
+            page_size=PAGE_SIZE, num_blocks=num_blocks, seed=SEED,
+        )
+        engine = ServingEngine(executor)
+        # warmup compiles per replica: full-prefill bucket, then the
+        # extend bucket a prefix hit lands in (warmup tokens < 256 so no
+        # warmup prefix can alias a measured lookup)
+        engine.submit(warm, 2, request_id="warm-full")
+        engine.run_until_drained()
+        engine.submit(
+            np.concatenate([warm[:-1], [255]]).astype(np.int32), 2,
+            request_id="warm-ext",
+        )
+        engine.run_until_drained()
+        engine.metrics = ServingMetrics()
+        fleet.add_replica(f"page-{i}", engine)
+
+    requests = make_prefix_requests(np.random.default_rng(SEED))
+    t0 = time.perf_counter()
+    for i, prompt in enumerate(requests):
+        fleet.submit(prompt, PREFIX_GEN, request_id=f"fan-{i}")
+    fleet.run_until_drained()
+    elapsed = time.perf_counter() - t0
+
+    hits = 0
+    shared_tokens = 0
+    landed = {}
+    for name, rep in fleet.replicas.items():
+        s = rep.engine.metrics.summary()
+        hits += s["prefix_hits"]
+        shared_tokens += s["prefix_shared_tokens"]
+        n = sum(1 for r in rep.engine.retired if r.request_id.startswith("fan-"))
+        if n:
+            landed[name] = n
+    outputs = {
+        r.request_id: list(r.output_tokens)
+        for r in fleet.all_retired()
+        if r.request_id.startswith("fan-") and r.state == RequestState.FINISHED
+    }
+    row = {
+        "policy": policy,
+        "fanout": FANOUT,
+        "shared_len": SHARED_LEN,
+        "elapsed_s": round(elapsed, 4),
+        "prefix_hits_fleetwide": hits,
+        "prefix_shared_tokens_fleetwide": shared_tokens,
+        "replicas_touched": len(landed),
+        "landed_per_replica": landed,
+    }
+    return row, outputs
+
+
+def main_fleet():
+    """``--fleet``: ISSUE 19's router, priced.  The SAME skewed Poisson
+    arrival schedule (doubled rate over the middle third) through the
+    SAME capacity-skewed fleet (one replica at a quarter of the slots)
+    under round-robin and under pressure routing; the headline is
+    goodput-at-SLO — completed tokens from requests that met the
+    TTFT/TPOT targets per wall second — where blind rotation keeps
+    feeding the weak replica its full share and pays the queueing in
+    violated TTFTs.  Outputs are asserted token-identical across
+    policies: routing moves WHERE a request decodes, never WHAT it
+    decodes.  The shared-prefix section reruns the ISSUE 6 fan-out
+    against a paged fleet: affinity must co-locate the fan-out (fleet
+    prefix hits = fanout - 1) while rotation re-prefills the shared
+    prompt on every replica it touches."""
+    from tpu_nexus.serving import ROUTER_PRESSURE, ROUTER_ROUND_ROBIN
+
+    rng = np.random.default_rng(SEED)
+    requests = make_requests(rng, n=FLEET_REQUESTS)
+    offsets = _skewed_offsets(rng, FLEET_REQUESTS)
+    cfg = bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+
+    poisson = {}
+    outputs = {}
+    for policy in (ROUTER_ROUND_ROBIN, ROUTER_PRESSURE):
+        row, outs = run_fleet_poisson(params, cfg, requests, offsets, policy)
+        assert len(outs) == FLEET_REQUESTS, (
+            f"{policy}: {len(outs)}/{FLEET_REQUESTS} requests finished — "
+            "the no-shed fleet dropped work"
+        )
+        poisson[policy] = row
+        outputs[policy] = outs
+    assert outputs[ROUTER_ROUND_ROBIN] == outputs[ROUTER_PRESSURE], (
+        "router policy changed token streams"
+    )
+
+    prefix = {}
+    prefix_outputs = {}
+    for policy in (ROUTER_ROUND_ROBIN, ROUTER_PRESSURE):
+        row, outs = run_fleet_prefix(params, cfg, policy)
+        prefix[policy] = row
+        prefix_outputs[policy] = outs
+    assert prefix_outputs[ROUTER_ROUND_ROBIN] == prefix_outputs[ROUTER_PRESSURE], (
+        "router policy changed token streams (prefix fan-out)"
+    )
+
+    rr = poisson[ROUTER_ROUND_ROBIN]
+    pr = poisson[ROUTER_PRESSURE]
+    ratio = (
+        pr["goodput_tokens_per_second_at_slo"]
+        / rr["goodput_tokens_per_second_at_slo"]
+        if rr["goodput_tokens_per_second_at_slo"]
+        else 0.0
+    )
+    result = {
+        "metric": "fleet_goodput_at_slo_ratio_pressure_vs_round_robin",
+        "value": round(ratio, 4),
+        "unit": "x_goodput_tokens_per_second_at_slo",
+        "slo": {"ttft_s": FLEET_TTFT_SLO_S, "tpot_s": FLEET_TPOT_SLO_S},
+        "fleet": {
+            "replicas": FLEET_REPLICAS,
+            "strong_slots": NUM_SLOTS,
+            "weak_slots": FLEET_WEAK_SLOTS,
+            "weak_queue_bound": 6 * FLEET_WEAK_SLOTS,
+            "arrival_rps_base": ARRIVAL_RPS,
+            "arrival_skew": "rate x2 over the middle third",
+            "requests": FLEET_REQUESTS,
+        },
+        "poisson": poisson,
+        "prefix_fanout": prefix,
+        "prefix_hit_target": FANOUT - 1,
+        "token_identical": True,  # asserted above, both sections
+        "seed": SEED,
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_FLEET_OUT", "BENCH_FLEET_r14.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     rng = np.random.default_rng(SEED)
     cfg = bench_model()
@@ -1222,5 +1503,7 @@ if __name__ == "__main__":
         main_trace()
     elif "--slo" in sys.argv[1:]:
         main_slo()
+    elif "--fleet" in sys.argv[1:]:
+        main_fleet()
     else:
         main()

@@ -1,0 +1,624 @@
+"""Fleet routing + autoscaling chaos drills (ISSUE 19).
+
+The router's contract, drilled from cheapest to nastiest:
+
+* decision tables total over the pressure taxonomy (NX021's runtime twin)
+  and the new metric names registered (NX015);
+* ranking — least-loaded first, SATURATED avoided while anyone healthy
+  has room but kept as the last resort before a fleet-wide shed, a
+  ``down`` GRADE excluded outright;
+* shed-and-retry-elsewhere — a per-replica ``QueueFull`` is a recorded
+  hop (metric tags + ``EV_ROUTER_RETRY`` on the request's timeline),
+  only fleet-wide exhaustion sheds, and THAT shed names every replica
+  tried and why each refused;
+* the snapshot-to-submit race — a replica dying (or leaving the fleet)
+  between ranking and the attempt is retried like any refusal, including
+  ``kill_replica`` racing ``submit`` itself;
+* prefix affinity — fan-out follows the cached prefix, the sticky map
+  covers the pre-registration window, and affinity NEVER beats a full
+  pool (it is a preference among willing replicas, not an admission
+  override);
+* supervisor autoscaling — sustained SATURATED scales up through the
+  fake cluster, sustained healthy idleness drains + scales down, every
+  decision lands cause+details on the ledger, and every request stays
+  terminal throughout;
+* multi-seed fuzz over kills + bursts for the global invariants.
+"""
+
+import numpy as np
+import pytest
+
+from tpu_nexus.core.telemetry import METRIC_NAMES, RecordingMetrics
+from tpu_nexus.serving import (
+    CAUSE_REPLICA_LOST,
+    AutoscaleConfig,
+    FleetSupervisor,
+    QueueFull,
+    RequestState,
+    ServingEngine,
+    ServingFleet,
+)
+from tpu_nexus.serving.fleet import FleetError
+from tpu_nexus.serving.loadstats import (
+    PRESSURE_DOWN,
+    PRESSURE_HEALTHY,
+    PRESSURE_PRESSURED,
+    PRESSURE_SATURATED,
+    PRESSURE_STATES,
+    SloMonitor,
+    SloTargets,
+)
+from tpu_nexus.serving.router import (
+    ELIGIBILITY_RANK,
+    ROUTE_ELIGIBILITY,
+    ROUTER_ROUND_ROBIN,
+    SCALE_DECISIONS,
+    load_score,
+)
+from tpu_nexus.serving.scheduler import FifoScheduler, SchedulerConfig
+from tpu_nexus.serving.tracing import EV_ROUTER_RETRY
+
+from tests.test_rollout_chaos import (
+    ALGO,
+    FLEET_JS,
+    NS,
+    FleetFakeExecutor,
+    _Fixture,
+    _settle,
+    fake_engine,
+    pod_name,
+    serving_jobset,
+)
+
+
+def bounded_engine(slots=1, queue=3, params="v0"):
+    """Fake engine with a BOUNDED queue so per-replica sheds are cheap to
+    stage (capacity before any tick = ``queue`` requests)."""
+    return ServingEngine(
+        FleetFakeExecutor(num_slots=slots, params=params),
+        scheduler=FifoScheduler(SchedulerConfig(max_queue=queue)),
+    )
+
+
+class FakePagedExecutor(FleetFakeExecutor):
+    """Paged twin of :class:`FleetFakeExecutor`: exposing ``page_size`` /
+    ``num_blocks`` flips the engine into block-granular admission, so the
+    REAL ``PagedCacheManager`` + ``PrefixIndex`` run under the router's
+    affinity probes with no device in sight.  Tokens stay a pure function
+    of the prompt — which is exactly what makes cross-policy token
+    identity assertable."""
+
+    def __init__(self, num_slots=2, max_len=64, page_size=4, num_blocks=64,
+                 params="v0"):
+        super().__init__(num_slots=num_slots, max_len=max_len, params=params)
+        self.page_size = page_size
+        self.num_blocks = num_blocks
+
+    def begin(self, slot, prompt, table_row=None, tail_start=0, copies=None):
+        return (int(prompt[-1]) + 1) % 1000
+
+    def step(self, tokens, cursors, tables=None):
+        return np.asarray(tokens) + 1
+
+
+def paged_engine(queue=0, slots=2, params="v0"):
+    return ServingEngine(
+        FakePagedExecutor(num_slots=slots, params=params),
+        scheduler=FifoScheduler(SchedulerConfig(max_queue=queue)),
+    )
+
+
+def _fleet(n=3, engine=fake_engine, metrics=None, policy=None, **kw):
+    kwargs = {"metrics": metrics}
+    if policy is not None:
+        kwargs["policy"] = policy
+    fleet = ServingFleet(**kwargs)
+    for i in range(n):
+        fleet.add_replica(f"rep-{i}", engine(**kw), step=1)
+    return fleet
+
+
+class _Grades:
+    """SLO-monitor stand-in: the router only reads ``.grades``."""
+
+    def __init__(self, grades):
+        self.grades = grades
+
+
+def _landed_on(fleet, req):
+    for name, rep in fleet.replicas.items():
+        if req.request_id in rep.engine.requests:
+            return name
+    raise AssertionError(f"{req.request_id} landed nowhere")
+
+
+def _retry_events(req):
+    return [e for e in req.trace.events if e[1] == EV_ROUTER_RETRY]
+
+
+def _lru_clocks(index):
+    """(node identity -> last_used) over the whole prefix trie."""
+    out = {}
+    stack = [index._root]
+    while stack:
+        node = stack.pop()
+        out[id(node)] = node.last_used
+        stack.extend(node.children.values())
+    return out
+
+
+# -- tables + registry (NX021 / NX015 runtime twins) ----------------------------
+
+
+class TestDecisionTables:
+    def test_tables_total_over_pressure_states(self):
+        assert set(ROUTE_ELIGIBILITY) == set(PRESSURE_STATES)
+        assert set(SCALE_DECISIONS) == set(PRESSURE_STATES)
+        # every eligibility except "never" has a tier; "never" must NOT —
+        # an unroutable state needs no rank
+        assert set(ELIGIBILITY_RANK) == set(ROUTE_ELIGIBILITY.values()) - {"never"}
+
+    def test_router_metrics_registered(self):
+        assert "serving.router_retry" in METRIC_NAMES
+        assert "serving.fleet_shed" in METRIC_NAMES
+        assert "fleet_autoscale" in METRIC_NAMES
+
+    def test_autoscale_config_validates(self):
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscaleConfig(min_replicas=0, max_replicas=2)
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="streak"):
+            AutoscaleConfig(min_replicas=1, max_replicas=2, scale_up_after=0)
+        with pytest.raises(ValueError, match="cooldown"):
+            AutoscaleConfig(min_replicas=1, max_replicas=2, cooldown_s=-1.0)
+
+
+# -- ranking --------------------------------------------------------------------
+
+
+class TestPlanning:
+    def test_least_loaded_routes_first(self):
+        fleet = _fleet(3)
+        # pile work on rep-0 directly; the router must steer around it
+        for _ in range(3):
+            fleet.replicas["rep-0"].engine.submit(np.array([1, 2, 3]), 4)
+        plan = fleet.router.plan(np.array([5, 6, 7]))
+        assert plan[0] != "rep-0" and plan[-1] == "rep-0"
+        req = fleet.submit(np.array([5, 6, 7]), 2)
+        assert _landed_on(fleet, req) != "rep-0"
+
+    def test_load_score_orders_backlog_and_latency(self):
+        fleet = _fleet(2)
+        fleet.replicas["rep-0"].engine.submit(np.array([1, 2, 3]), 4)
+        snaps = fleet.snapshot().replicas
+        assert load_score(snaps["rep-0"]) > load_score(snaps["rep-1"])
+
+    def test_down_replica_never_planned(self):
+        fleet = _fleet(3)
+        fleet.kill_replica("rep-1", f"{CAUSE_REPLICA_LOST}:test")
+        for tail in range(5):
+            assert "rep-1" not in fleet.router.plan(np.array([1, 2, tail]))
+
+    def test_down_grade_excluded_even_while_state_serving(self):
+        # the monitor can grade a replica DOWN (e.g. stale watch) before
+        # the fleet flips its state: the GRADE alone must exclude it
+        fleet = _fleet(3)
+        fleet.router.slo = _Grades({"rep-2": PRESSURE_DOWN})
+        assert "rep-2" not in fleet.router.plan(np.array([1, 2, 3]))
+
+    def test_round_robin_policy_rotates_evenly(self):
+        fleet = _fleet(3, policy=ROUTER_ROUND_ROBIN)
+        for i in range(6):
+            fleet.submit(np.array([1, 2, i + 1]), 2)
+        counts = sorted(
+            len(rep.engine.requests) for rep in fleet.replicas.values()
+        )
+        assert counts == [2, 2, 2]
+
+
+# -- shed-and-retry-elsewhere ---------------------------------------------------
+
+
+class TestRetryAndShed:
+    def test_refusal_retries_next_best_with_metrics_and_trace(self):
+        rec = RecordingMetrics()
+        fleet = _fleet(2, metrics=rec)
+        # rep-0 idle (ranks first) but refusing: admission paused
+        fleet.replicas["rep-0"].engine.pause_admission()
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        assert _landed_on(fleet, req) == "rep-1"
+        assert fleet.router.retries == 1
+        assert fleet.router.last_refusals == [("rep-0", "reloading")]
+        key = ("serving.router_retry", ("cause:reloading", "replica:rep-0"))
+        assert rec.tagged_counts[key] == 1
+        # the retry path rides the request's own span timeline
+        (event,) = _retry_events(req)
+        assert event[2] == {"tried": ["rep-0:reloading"], "landed": "rep-1"}
+        fleet.run_until_drained()
+        assert req.state == RequestState.FINISHED
+
+    def test_fleet_wide_exhaustion_sheds_with_causes(self):
+        rec = RecordingMetrics()
+        fleet = _fleet(2, engine=bounded_engine, metrics=rec, queue=1)
+        fleet.submit(np.array([1, 2, 3]), 2)
+        fleet.submit(np.array([1, 2, 4]), 2)  # both queues now at capacity
+        with pytest.raises(QueueFull, match="no serving replica") as exc:
+            fleet.submit(np.array([1, 2, 5]), 2)
+        msg = str(exc.value)
+        # the shed names every replica tried and why each refused
+        assert "tried" in msg
+        assert "rep-0 (queue-full)" in msg and "rep-1 (queue-full)" in msg
+        assert fleet.router.fleet_sheds == 1
+        assert rec.counters["serving.fleet_shed"] == 1
+        # refusals that ended in a shed are NOT retries (nothing landed)
+        assert "serving.router_retry" not in rec.counters
+
+    def test_draining_replica_refusal_carries_cause(self):
+        fleet = _fleet(2)
+        fleet.replicas["rep-0"].engine.drain(0.0)
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        assert _landed_on(fleet, req) == "rep-1"
+        assert fleet.router.last_refusals == [("rep-0", "draining")]
+
+    def test_saturated_avoided_then_last_resort_then_shed(self):
+        """The full pecking order, graded by the REAL SloMonitor: healthy
+        capacity first, the SATURATED replica only when everyone else is
+        full, fleet-wide shed only when IT fills too."""
+        mon = SloMonitor(
+            SloTargets(shed_rate=0.05, short_window=1, long_window=2,
+                       pressured_burn=1.0, saturated_burn=1.0)
+        )
+        fleet = _fleet(3, engine=bounded_engine, queue=3)
+        fleet.router.slo = mon
+        rep0 = fleet.replicas["rep-0"].engine
+        for i in range(3):
+            rep0.submit(np.array([1, 2, i + 1]), 2)
+        mon.observe(fleet.snapshot())  # seeds shed-rate baselines
+        for obs in range(2):  # one shed per observation sustains the burn
+            with pytest.raises(QueueFull):
+                rep0.submit(np.array([9, 9, obs + 1]), 2)
+            mon.observe(fleet.snapshot())
+        assert mon.grades["rep-0"] == PRESSURE_SATURATED
+        fleet.run_until_drained()  # rep-0 now IDLE but still graded saturated
+        for i in range(6):  # fills rep-1 + rep-2 (3 each), rep-0 untouched
+            req = fleet.submit(np.array([4, 5, i + 1]), 2)
+            assert _landed_on(fleet, req) != "rep-0"
+        assert rep0.scheduler.pending == 0
+        # last resort: capacity behind an SLO burn beats a fleet-wide shed
+        req = fleet.submit(np.array([6, 7, 8]), 2)
+        assert _landed_on(fleet, req) == "rep-0"
+        assert {name for name, _ in fleet.router.last_refusals} == {"rep-1", "rep-2"}
+        for i in range(2):
+            fleet.submit(np.array([6, 7, 10 + i]), 2)  # rep-0 to capacity
+        with pytest.raises(QueueFull, match="no serving replica"):
+            fleet.submit(np.array([6, 7, 20]), 2)
+
+
+# -- the snapshot-to-submit race (satellite 2) ----------------------------------
+
+
+class TestSnapshotSubmitRace:
+    def test_kill_replica_racing_submit_is_retried(self):
+        """The pod dies at the worst instant — INSIDE the chosen replica's
+        submit: the router records the loss as a hop and lands the request
+        on the survivor, zero drops."""
+        fleet = _fleet(2)
+        rep0 = fleet.replicas["rep-0"]
+
+        def dying_submit(*args, **kwargs):
+            fleet.kill_replica("rep-0", f"{CAUSE_REPLICA_LOST}:race")
+            raise FleetError("rep-0 vanished mid-submit")
+
+        rep0.engine.submit = dying_submit
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        assert _landed_on(fleet, req) == "rep-1"
+        assert rep0.state == "down"
+        (refusal,) = fleet.router.last_refusals
+        assert refusal[0] == "rep-0"
+        assert refusal[1].startswith("replica-error:")
+        (event,) = _retry_events(req)
+        assert event[2]["landed"] == "rep-1"
+        fleet.run_until_drained()
+        assert req.state == RequestState.FINISHED
+
+    def test_stale_snapshot_down_state_rechecked(self):
+        # ranked from a snapshot taken BEFORE the kill: the submit-time
+        # state re-check turns the stale candidate into a recorded hop
+        fleet = _fleet(2)
+        stale = fleet.snapshot()
+        fleet.kill_replica("rep-0", f"{CAUSE_REPLICA_LOST}:stale")
+        fleet.snapshot = lambda: stale
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        assert _landed_on(fleet, req) == "rep-1"
+        assert ("rep-0", "state:down") in fleet.router.last_refusals
+
+    def test_stale_snapshot_removed_replica_rechecked(self):
+        fleet = _fleet(2)
+        stale = fleet.snapshot()
+        fleet.remove_replica("rep-0")
+        fleet.snapshot = lambda: stale
+        req = fleet.submit(np.array([1, 2, 3]), 2)
+        assert _landed_on(fleet, req) == "rep-1"
+        assert ("rep-0", "replica-gone") in fleet.router.last_refusals
+
+
+# -- mid-burst kill -------------------------------------------------------------
+
+
+class TestMidBurstKill:
+    def test_zero_silent_drops_with_causes(self):
+        fleet = _fleet(3, slots=2)
+        reqs = [fleet.submit(np.array([1, 2, i + 1]), 4) for i in range(12)]
+        fleet.tick()  # every replica mid-decode
+        victim = fleet.replicas["rep-1"]
+        held = len(victim.engine.requests)
+        assert held > 0  # the kill lands on live traffic
+        cause = f"{CAUSE_REPLICA_LOST}:chaos-kill"
+        fleet.kill_replica("rep-1", cause)
+        assert not victim.engine.requests  # all accounted at the kill
+        # the burst continues: nothing routes to the corpse
+        reqs += [fleet.submit(np.array([3, 4, i + 1]), 4) for i in range(6)]
+        assert not victim.engine.requests
+        fleet.run_until_drained()
+        # zero silent drops: every request terminal, every casualty named
+        assert all(r.is_terminal() for r in reqs)
+        casualties = [r for r in reqs if r.state != RequestState.FINISHED]
+        assert casualties and all(r.cause == cause for r in casualties)
+        assert len([r for r in reqs if r.state == RequestState.FINISHED]) == (
+            len(reqs) - len(casualties)
+        )
+
+
+# -- prefix affinity ------------------------------------------------------------
+
+
+class TestPrefixAffinity:
+    PREFIX = np.arange(1, 17)  # 4 full blocks at page_size=4
+
+    def _fanout(self, i):
+        return np.concatenate([self.PREFIX, [100 + i, 200 + i]])
+
+    def test_fanout_follows_registered_prefix(self):
+        fleet = _fleet(2, engine=paged_engine)
+        seed = fleet.submit(self._fanout(0), 3)
+        home = _landed_on(fleet, seed)
+        fleet.run_until_drained()  # prefill complete -> prefix registered
+        other = ({"rep-0", "rep-1"} - {home}).pop()
+        assert fleet.replicas[home].engine.prefix_shared_len(self._fanout(1)) > 0
+        assert fleet.replicas[other].engine.prefix_shared_len(self._fanout(1)) == 0
+        for i in range(1, 5):
+            req = fleet.submit(self._fanout(i), 3)
+            # the idle OTHER replica loses to the one holding the prefix
+            assert _landed_on(fleet, req) == home
+        fleet.run_until_drained()
+
+    def test_affinity_probe_never_touches_lru(self):
+        fleet = _fleet(2, engine=paged_engine)
+        seed = fleet.submit(self._fanout(0), 3)
+        home = fleet.replicas[_landed_on(fleet, seed)].engine
+        fleet.run_until_drained()
+        clocks_before = _lru_clocks(home.paged.index)
+        for i in range(1, 4):
+            fleet.router.plan(self._fanout(i))  # probes every replica
+        assert _lru_clocks(home.paged.index) == clocks_before
+
+    def test_sticky_map_covers_preregistration_window(self):
+        """A fan-out burst lands WITHIN one step — before any prefill
+        completes, so the trie knows nothing.  The sticky map routes the
+        whole first wave to the first arrival's replica (which load-based
+        ranking alone would scatter)."""
+        fleet = _fleet(2, engine=paged_engine)
+        first = fleet.submit(self._fanout(0), 3)
+        home = _landed_on(fleet, first)
+        for i in range(1, 4):  # no ticks: trie still empty fleet-wide
+            req = fleet.submit(self._fanout(i), 3)
+            assert _landed_on(fleet, req) == home
+        fleet.run_until_drained()
+
+    def test_affinity_never_beats_full_pool(self):
+        """A perfect prefix match is a PREFERENCE: with the home replica
+        full the request lands elsewhere (hop recorded), and with the
+        whole pool full it sheds — affinity must never turn QueueFull
+        into a hang or a drop."""
+        fleet = _fleet(2, engine=paged_engine, queue=1)
+        seed = fleet.submit(self._fanout(0), 3)
+        home = _landed_on(fleet, seed)
+        other = ({"rep-0", "rep-1"} - {home}).pop()
+        fleet.run_until_drained()
+        fleet.replicas[home].engine.submit(self._fanout(50), 3)  # home now full
+        req = fleet.submit(self._fanout(1), 3)
+        assert _landed_on(fleet, req) == other
+        assert (home, "queue-full") in fleet.router.last_refusals
+        # that landing filled ``other`` too (queue=1): the pool is full,
+        # and a perfect prefix match must still shed, not hang or drop
+        with pytest.raises(QueueFull, match="no serving replica"):
+            fleet.submit(self._fanout(2), 3)
+
+    def test_affinity_token_identical_to_round_robin(self):
+        """Acceptance: routing policy changes WHERE a request runs, never
+        WHAT it generates — same prompts, same outputs, either policy."""
+        prompts = [self._fanout(i) for i in range(6)] + [
+            np.arange(5, 12) * 3 for _ in range(2)
+        ]
+        outs = {}
+        for policy in (None, ROUTER_ROUND_ROBIN):
+            fleet = _fleet(2, engine=paged_engine, policy=policy)
+            reqs = [fleet.submit(p, 4) for p in prompts]
+            fleet.run_until_drained()
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            outs[policy] = [list(r.output_tokens) for r in reqs]
+        assert outs[None] == outs[ROUTER_ROUND_ROBIN]
+
+
+# -- supervisor autoscaling -----------------------------------------------------
+
+
+async def autoscale_fixture(cooldown_s=0.0):
+    from datetime import timedelta
+
+    from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+    from tpu_nexus.core.signals import LifecycleContext
+    from tpu_nexus.k8s.fake import FakeKubeClient
+
+    client = FakeKubeClient(jobset_controller=True, emit_pod_events=True)
+    client.inject("ADDED", "JobSet", serving_jobset())
+    store = InMemoryCheckpointStore()
+    fleet = ServingFleet()
+    made = []
+
+    def factory(name, step, kv_blocks):
+        made.append((name, step, kv_blocks))
+        return fake_engine(params=f"params@{step}")
+
+    sup = FleetSupervisor(
+        client, store, NS, fleet, FLEET_JS, ALGO, factory,
+        grace_s=30.0, kv_blocks=64, resync_period=timedelta(0),
+        slo=SloMonitor(
+            SloTargets(shed_rate=0.05, short_window=1, long_window=2,
+                       pressured_burn=1.0, saturated_burn=1.0)
+        ),
+        autoscale=AutoscaleConfig(
+            min_replicas=3, max_replicas=4,
+            scale_up_after=1, scale_down_after=2, cooldown_s=cooldown_s,
+        ),
+    )
+    ctx = LifecycleContext()
+    sup._factory.start(ctx)
+    assert await sup._factory.wait_for_cache_sync(timeout=10.0)
+    await sup.adopt_pods(step=1)
+    return _Fixture(client, store, fleet, sup, ctx, made)
+
+
+class TestAutoscale:
+    async def test_up_then_down_converges_with_all_requests_terminal(self):
+        fx = await autoscale_fixture()
+        try:
+            sup, fleet = fx.sup, fx.fleet
+            reqs = [fleet.submit(np.array([1, 2, i + 1]), 3) for i in range(3)]
+            await sup.reconcile(now=1.0)  # obs 1: seeds baselines
+            assert sup.scaled_up == 0
+            # a refusing replica shedding once per observation window —
+            # direct submits, so the burn is independent of routing order
+            overloaded = fleet.replicas[pod_name(0)].engine
+            overloaded.pause_admission()
+            with pytest.raises(QueueFull):
+                overloaded.submit(np.array([9, 9, 1]), 2)
+            await sup.reconcile(now=2.0)  # obs 2: burning -> PRESSURED, hold
+            assert sup.slo.grades[pod_name(0)] == PRESSURE_PRESSURED
+            assert sup.scaled_up == 0
+            with pytest.raises(QueueFull):
+                overloaded.submit(np.array([9, 9, 2]), 2)
+            await sup.reconcile(now=3.0)  # obs 3: SATURATED -> scale up
+            assert sup.slo.grades[SloMonitor.FLEET] == PRESSURE_SATURATED
+            assert sup.scaled_up == 1 and len(fleet.replicas) == 4
+            new = f"{FLEET_JS}-scale-1"
+            assert new in fleet.replicas
+            assert fleet.replicas[new].state == "serving"
+            assert fx.made[-1][0] == new
+            # the pod exists in the cluster with the scale uid
+            pod = fx.client._objects["Pod"][(NS, new)]
+            assert pod["metadata"]["uid"].startswith("fleet-scale-")
+            # the decision landed on the ledger, row still RUNNING
+            row = fx.ledger()
+            assert "fleet autoscale: scale-up" in row.algorithm_failure_cause
+            assert new in row.algorithm_failure_details
+            # traffic drains: every request terminal, zero drops
+            overloaded.resume_admission()
+            fleet.run_until_drained()
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            # sustained healthy idleness: two reconciles -> scale down once
+            await sup.reconcile(now=4.0)
+            assert sup.scaled_down == 0
+            await sup.reconcile(now=5.0)
+            assert sup.slo.grades[SloMonitor.FLEET] == PRESSURE_HEALTHY
+            assert sup.scaled_down == 1 and len(fleet.replicas) == 3
+            down = sup.scale_events[-1]
+            assert down["decision"] == "scale-down"
+            assert down["drain"]["drain_evicted"] == 0  # zero-drop by idle
+            assert down["pod"] in fx.client.deleted("Pod")
+            assert "fleet autoscale: scale-down" in fx.ledger().algorithm_failure_cause
+            # convergence: at min_replicas the fleet holds, and our own
+            # deletion never echoes back as an incident/recreate
+            await _settle()
+            await sup.reconcile(now=6.0)
+            await sup.reconcile(now=7.0)
+            assert len(fleet.replicas) == 3
+            assert sup.scaled_down == 1 and sup.recreated == 0
+        finally:
+            await fx.close()
+
+    async def test_cooldown_and_max_replicas_gate_scale_up(self):
+        fx = await autoscale_fixture(cooldown_s=100.0)
+        try:
+            sup, fleet = fx.sup, fx.fleet
+            for rep in fleet.replicas.values():
+                rep.engine.pause_admission()
+            await sup.reconcile(now=1.0)  # seeds
+            for tick in range(2):
+                # every replica refuses -> a fleet-wide shed, one burn
+                # sample on each replica per observation
+                with pytest.raises(QueueFull, match="no serving replica"):
+                    fleet.submit(np.array([1, 2, tick + 1]), 2)
+                await sup.reconcile(now=2.0 + tick)
+            assert sup.scaled_up == 1  # saturated -> one scale-up
+            # still saturated, but the cooldown holds the next action
+            fleet.replicas[f"{FLEET_JS}-scale-1"].engine.pause_admission()
+            with pytest.raises(QueueFull):
+                fleet.submit(np.array([1, 2, 9]), 2)
+            await sup.reconcile(now=5.0)
+            assert sup.scaled_up == 1
+            # past the cooldown the fleet is at max_replicas: still capped
+            with pytest.raises(QueueFull):
+                fleet.submit(np.array([1, 2, 11]), 2)
+            await sup.reconcile(now=200.0)
+            assert sup.scaled_up == 1 and len(fleet.replicas) == 4
+        finally:
+            await fx.close()
+
+
+# -- multi-seed fuzz ------------------------------------------------------------
+
+
+class TestRouterFuzz:
+    @pytest.mark.parametrize("seed", range(8))
+    def test_invariants_under_kills_and_bursts(self, seed):
+        """Per seed: random kills + bursts.  Invariants: the plan never
+        names a non-serving replica, nothing routes to a corpse, a shed
+        is only ever fleet-wide exhaustion, and every accepted request
+        reaches a terminal state."""
+        rng = np.random.default_rng(seed)
+        fleet = _fleet(4, engine=bounded_engine, queue=2)
+        dead = set()
+        accepted = []
+        for round_ in range(20):
+            op = rng.integers(0, 10)
+            names = list(fleet.replicas)
+            if op == 0 and len(dead) < 3:
+                victim = names[rng.integers(0, len(names))]
+                if victim not in dead:
+                    fleet.kill_replica(victim, f"{CAUSE_REPLICA_LOST}:fuzz")
+                    dead.add(victim)
+            elif op <= 2:
+                for _ in range(int(rng.integers(1, 4))):
+                    fleet.tick()
+            prompt = rng.integers(1, 900, size=int(rng.integers(2, 6)))
+            plan = fleet.router.plan(prompt)
+            assert all(fleet.replicas[n].state == "serving" for n in plan)
+            assert not (set(plan) & dead)
+            try:
+                accepted.append(fleet.submit(prompt, int(rng.integers(1, 4))))
+            except QueueFull:
+                # legal only when NO serving replica had room
+                serving = [
+                    rep for name, rep in fleet.replicas.items()
+                    if name not in dead
+                ]
+                assert all(rep.engine.scheduler.full for rep in serving)
+        for name in dead:
+            assert not fleet.replicas[name].engine.has_work
+        fleet.run_until_drained()
+        assert all(r.is_terminal() for r in accepted)
+        for req in accepted:
+            # accepted means accounted: FINISHED, or terminal with a cause
+            assert req.state == RequestState.FINISHED or req.cause

@@ -1912,6 +1912,123 @@ def test_nx016_repo_is_clean():
     assert findings == []
 
 
+# -- NX021 router decision totality ---------------------------------------------
+
+ROUTER_OK = """
+ROUTE_ELIGIBILITY = {
+    "healthy": "prefer",
+    "pressured": "accept",
+    "saturated": "avoid",
+    "down": "never",
+}
+
+SCALE_DECISIONS = {
+    "healthy": "scale-down-when-idle",
+    "pressured": "hold",
+    "saturated": "scale-up",
+    "down": "hold",
+}
+"""
+
+
+def _lint_nx021(router_src=ROUTER_OK, loadstats_src=LOADSTATS_OK, extra=None):
+    pairs = [("tpu_nexus/serving/router.py", router_src)] if extra is None else extra
+    return lint_source(
+        loadstats_src,
+        "NX021",
+        rel_path="tpu_nexus/serving/loadstats.py",
+        extra=pairs,
+    )
+
+
+def test_nx021_clean_when_both_tables_total():
+    assert _lint_nx021() == []
+
+
+def test_nx021_flags_eligibility_missing_a_state():
+    src = ROUTER_OK.replace('    "down": "never",\n', "", 1)
+    findings = _lint_nx021(src)
+    assert len(findings) == 1
+    assert "ROUTE_ELIGIBILITY" in findings[0].message
+    assert "'down'" in findings[0].message
+    assert "admission eligibility" in findings[0].message
+
+
+def test_nx021_flags_scale_table_missing_a_state():
+    src = ROUTER_OK.replace('    "saturated": "scale-up",\n', "")
+    findings = _lint_nx021(src)
+    assert len(findings) == 1
+    assert "SCALE_DECISIONS" in findings[0].message
+    assert "scales the fleet" in findings[0].message
+
+
+def test_nx021_flags_unknown_state():
+    src = ROUTER_OK.replace(
+        '    "down": "hold",\n',
+        '    "down": "hold",\n    "melted": "hold",\n',
+    )
+    findings = _lint_nx021(src)
+    assert len(findings) == 1
+    assert "unknown pressure state 'melted'" in findings[0].message
+
+
+def test_nx021_keys_resolve_via_loadstats_constants():
+    # the tables may spell states through the imported PRESSURE_* names;
+    # the rule resolves them against the loadstats constants
+    src = ROUTER_OK.replace('"healthy": "prefer"', 'PRESSURE_HEALTHY: "prefer"')
+    assert _lint_nx021(src) == []
+
+
+def test_nx021_fails_closed_on_unresolvable_key():
+    src = ROUTER_OK.replace('"healthy": "prefer"', 'MYSTERY_STATE: "prefer"')
+    findings = _lint_nx021(src)
+    assert len(findings) == 1
+    assert "ROUTE_ELIGIBILITY" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx021_fails_closed_without_router_module():
+    findings = _lint_nx021(extra=[])
+    assert len(findings) == 1
+    assert "serving/router.py missing" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx021_fails_closed_on_unparseable_router():
+    # the engine's NX000 syntax finding rides along; NX021 must still
+    # fail closed with its own diagnosis rather than go silent
+    findings = [f for f in _lint_nx021("def (broken") if f.rule_id == "NX021"]
+    assert len(findings) == 1
+    assert "unparseable" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx021_fails_closed_without_table():
+    src = ROUTER_OK.replace("SCALE_DECISIONS = {", "NOT_THE_TABLE = {")
+    findings = _lint_nx021(src)
+    assert len(findings) == 1
+    assert "SCALE_DECISIONS missing" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx021_silent_when_loadstats_broken():
+    # a missing/unresolvable PRESSURE_STATES is NX016's finding — NX021
+    # must not pile a second diagnosis on the same root cause
+    src = LOADSTATS_OK.replace("PRESSURE_STATES = (", "OTHER_STATES = (")
+    assert _lint_nx021(loadstats_src=src) == []
+
+
+def test_nx021_repo_is_clean():
+    """The shipped router tables pass their own rule (repo gate covers
+    it; pinned so a drift failure names the rule)."""
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus")],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX021"],
+    )
+    assert findings == []
+
+
 # -- multi-line statement suppression (regression) ------------------------------
 
 

@@ -44,6 +44,7 @@ REQUIRED = [
     "tpu_nexus/serving/loadstats.py",           # pressure plane: snapshots + SLO monitor
     "tpu_nexus/serving/overlap.py",             # deferred-dispatch ledgers
     "tpu_nexus/serving/recovery.py",
+    "tpu_nexus/serving/router.py",              # fleet routing + autoscale decisions
     "tpu_nexus/serving/sharded.py",             # tensor-parallel executors + shard-aware swaps
     "tpu_nexus/serving/speculative.py",         # drafting + verify-k acceptance
     "tpu_nexus/serving/tracing.py",             # span timelines + flight recorder + profiler

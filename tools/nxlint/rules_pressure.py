@@ -19,6 +19,22 @@ NX016  pressure-taxonomy totality + snapshot/metric parity:
 
        Fails closed when the module, the states tuple, a table, a
        snapshot class, or the registry is missing/unparseable.
+
+NX021  router decision totality (ISSUE 19; the issue numbered it NX020,
+       which PR 14's flow-integrity rule already holds — renumbered):
+       the fleet router's decision tables in
+       ``tpu_nexus/serving/router.py`` (:data:`ROUTER_TABLES` —
+       ``ROUTE_ELIGIBILITY`` mapping a replica's pressure grade to its
+       admission eligibility, ``SCALE_DECISIONS`` mapping the fleet
+       grade to a capacity verdict) must be TOTAL over the SAME
+       ``PRESSURE_STATES`` NX016 governs: adding a pressure state
+       without declaring how the router treats it and whether it scales
+       the fleet is a static-analysis error, not a midnight KeyError on
+       the admission path.  Keys resolve against BOTH modules' string
+       constants (the tables may spell states literally or via the
+       loadstats constants).  Fails closed when the router module or a
+       table is missing/unresolvable; a broken loadstats side is NX016's
+       finding, not a second one here.
 """
 
 from __future__ import annotations
@@ -40,6 +56,13 @@ STATES_NAME = "PRESSURE_STATES"
 #: by pressure grades should be added here (the repo-clean gate's review
 #: is the backstop, as with NX015's receiver set).
 PRESSURE_TABLES = ("PRESSURE_SEVERITY", "PRESSURE_ACTIONS")
+
+ROUTER_PATH = "tpu_nexus/serving/router.py"
+
+#: the router decision tables that must be total over PRESSURE_STATES
+#: (NX021).  Same backstop as PRESSURE_TABLES: a new grade-keyed table
+#: in the router belongs in this tuple.
+ROUTER_TABLES = ("ROUTE_ELIGIBILITY", "SCALE_DECISIONS")
 
 #: snapshot class -> metric-name prefix its numeric fields mirror into
 SNAPSHOT_PREFIXES = (
@@ -274,4 +297,93 @@ class PressureContractRule(Rule):
                     f"{REGISTRY_NAME} documents '{row}' but {class_name} "
                     f"has no numeric field '{row[len(prefix):]}' — remove "
                     "the row or restore the field",
+                )
+
+
+@register
+class RouterContractRule(Rule):
+    """NX021 (module doc): the fleet router's decision tables must be
+    total over the pressure taxonomy."""
+
+    rule_id = "NX021"
+    description = (
+        "fleet router decision tables (ROUTE_ELIGIBILITY/SCALE_DECISIONS) "
+        "total over PRESSURE_STATES"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        loadstats = project.find_module(LOADSTATS_PATH)
+        if loadstats is None:
+            return  # project doesn't contain the serving tree (tools subtree)
+        if loadstats.tree is None or pressure_states(loadstats.tree) is None:
+            return  # NX016 owns the broken-loadstats finding
+        states = pressure_states(loadstats.tree)
+        module = project.find_module(ROUTER_PATH)
+        if module is None:
+            yield self.finding(
+                loadstats,
+                loadstats.tree,
+                f"{ROUTER_PATH} missing — the fleet's routing/scale "
+                "decision tables are unverifiable (rule fails closed; "
+                "restore the module or update ROUTER_PATH)",
+            )
+            return
+        if module.tree is None:
+            yield self.finding(
+                module,
+                ast.Module(body=[], type_ignores=[]),
+                f"{ROUTER_PATH} unparseable — routing/scale decision "
+                "totality unverifiable (rule fails closed)",
+            )
+            return
+        # the tables may spell states literally or via either module's
+        # constants (router imports the PRESSURE_* names from loadstats)
+        constants = {
+            **_module_string_constants(loadstats.tree),
+            **_module_string_constants(module.tree),
+        }
+        assert states is not None
+        for table_name in ROUTER_TABLES:
+            value = _module_assignment(module.tree, table_name)
+            if not isinstance(value, ast.Dict):
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"decision table {table_name} missing from "
+                    f"{module.rel_path} (or not a dict literal) — "
+                    "routing/scale totality unverifiable (rule fails "
+                    "closed)",
+                )
+                continue
+            keys: Set[str] = set()
+            unresolved = False
+            for key in value.keys:
+                resolved = _resolve_key(key, constants) if key is not None else None
+                if resolved is None:
+                    unresolved = True
+                    break
+                keys.add(resolved)
+            if unresolved:
+                yield self.finding(
+                    module,
+                    value,
+                    f"decision table {table_name} has a key that is neither "
+                    "a string literal nor a resolvable state constant — "
+                    "totality unverifiable (rule fails closed)",
+                )
+                continue
+            for missing in sorted(states - keys):
+                yield self.finding(
+                    module,
+                    value,
+                    f"{table_name} missing pressure state '{missing}' — "
+                    "every state must declare "
+                    f"{'its admission eligibility' if table_name == 'ROUTE_ELIGIBILITY' else 'whether it scales the fleet'}",
+                )
+            for extra in sorted(keys - states):
+                yield self.finding(
+                    module,
+                    value,
+                    f"{table_name} declares unknown pressure state "
+                    f"'{extra}' — not a member of {STATES_NAME}",
                 )

@@ -315,6 +315,10 @@ METRIC_NAMES: Dict[str, tuple] = {
     "fleet_escalations": ("count", "incidents escalated to an operator (recreate refused), tagged action:"),
     "fleet_recreates": ("count", "serving pods recreated by the controller, tagged action:"),
     "fleet_watchdog_recreates": ("count", "pods recreated by the missing-pod absence sweep"),
+    "fleet_autoscale": ("count", "supervisor autoscale decisions executed, tagged decision: (up/down)"),
+    # -- fleet router (tpu_nexus/serving/router.py, ISSUE 19) ------------------
+    "serving.router_retry": ("count", "per-replica admission refusals the router retried on the next-best replica, tagged replica:/cause:"),
+    "serving.fleet_shed": ("count", "requests every eligible replica refused (fleet-wide exhaustion; per-replica causes ride the QueueFull message)"),
     # -- pressure plane (tpu_nexus/serving/loadstats.py, ISSUE 15) -------------
     # load.<field> rows mirror LoadSnapshot's numeric fields 1:1 and
     # fleet.load.<field> rows FleetSnapshot's — nxlint NX016 enforces the

@@ -24,6 +24,11 @@ Layering (each module imports only downward):
                        total HEALTHY/PRESSURED/SATURATED/DOWN pressure
                        taxonomy, and the windowed burn-rate SloMonitor
                        the fleet controller consumes per reconcile
+* ``router``         — fleet admission + autoscale policy (ISSUE 19):
+                       pressure/affinity/load candidate ranking, the
+                       shed-and-retry-elsewhere submit path, and the
+                       NX021-total ROUTE_ELIGIBILITY / SCALE_DECISIONS
+                       tables the supervisor's autoscaler executes
 * ``speculative``    — drafting subsystem (ISSUE 11): Drafter interface,
                        prompt-lookup ngram + draft-model drafters, the
                        verify-k acceptance oracle (greedy token-identity)
@@ -96,6 +101,20 @@ from tpu_nexus.serving.loadstats import (
     worst_pressure,
 )
 from tpu_nexus.serving.metrics import RollingQuantile, ServingMetrics, percentile
+from tpu_nexus.serving.router import (
+    ELIGIBILITY_RANK,
+    ROUTE_ELIGIBILITY,
+    ROUTER_POLICIES,
+    ROUTER_PRESSURE,
+    ROUTER_ROUND_ROBIN,
+    SCALE_DECISIONS,
+    SCALE_DOWN_WHEN_IDLE,
+    SCALE_HOLD,
+    SCALE_UP,
+    AutoscaleConfig,
+    FleetRouter,
+    load_score,
+)
 from tpu_nexus.serving.sharded import (
     SERVING_PARAM_RULES,
     ShardedModelExecutor,
@@ -142,12 +161,15 @@ __all__ = [
     "DRAFTERS",
     "DeviceProfiler",
     "DeviceStateLost",
+    "AutoscaleConfig",
     "DispatchPipeline",
     "Drafter",
+    "ELIGIBILITY_RANK",
     "EngineReplica",
     "EngineTracer",
     "FifoScheduler",
     "FleetError",
+    "FleetRouter",
     "FleetSnapshot",
     "FleetSupervisor",
     "FlightRecorder",
@@ -173,10 +195,18 @@ __all__ = [
     "PrefixIndex",
     "QueueFull",
     "RETIREMENT_ACTIONS",
+    "ROUTE_ELIGIBILITY",
+    "ROUTER_POLICIES",
+    "ROUTER_PRESSURE",
+    "ROUTER_ROUND_ROBIN",
     "Request",
     "RollingQuantile",
     "RequestState",
     "RequestTrace",
+    "SCALE_DECISIONS",
+    "SCALE_DOWN_WHEN_IDLE",
+    "SCALE_HOLD",
+    "SCALE_UP",
     "SCRATCH_BLOCK",
     "SERVING_PARAM_RULES",
     "SchedulerConfig",
@@ -199,6 +229,7 @@ __all__ = [
     "emit_load_snapshot",
     "init_cache",
     "init_paged_cache",
+    "load_score",
     "parse_serve_mesh",
     "percentile",
     "worst_pressure",

@@ -612,9 +612,15 @@ class PrefixIndex:
     def _touch(self, node: _TrieNode) -> None:
         node.last_used = next(self._clock)
 
-    def lookup(self, prompt: Sequence[int]) -> PrefixProbe:
+    def lookup(self, prompt: Sequence[int], touch: bool = True) -> PrefixProbe:
         """Longest cached match for ``prompt`` (read-only apart from LRU
-        touches); see :class:`PrefixProbe` for the clamp contract."""
+        touches); see :class:`PrefixProbe` for the clamp contract.
+
+        ``touch=False`` makes the probe FULLY read-only: the fleet router
+        probes every replica per request to score prefix affinity, and an
+        affinity probe that refreshed LRU clocks would mark blocks recent
+        on replicas the request never lands on, distorting eviction order
+        exactly like the transient-leader touches the scan below avoids."""
         tokens = [int(t) for t in prompt]
         limit = len(tokens) - 1  # >= 1 tail token must re-prefill for logits
         ps = self.page_size
@@ -626,7 +632,8 @@ class PrefixIndex:
             if child is None:
                 break
             full.append(child.block)
-            self._touch(child)
+            if touch:
+                self._touch(child)
             node = child
             pos += ps
         partial: Optional[int] = None
@@ -644,7 +651,7 @@ class PrefixIndex:
                 n = min(n, cap)
                 if n > lcp:
                     lcp, partial, winner = n, child.block, child
-        if winner is not None:
+        if winner is not None and touch:
             # touch only the WINNING candidate: refreshing transient
             # leaders of the LCP scan would mark never-shared blocks
             # recent on every probe and distort the LRU eviction order

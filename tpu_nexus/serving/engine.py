@@ -1837,6 +1837,17 @@ class ServingEngine:
             **self.metrics.slo_window(),
         )
 
+    def prefix_shared_len(self, prompt: Any) -> int:
+        """Router affinity probe (serving/router.py): how many leading
+        prompt tokens THIS replica already holds as cached KV.  Strictly
+        read-only — the probe runs against every candidate replica per
+        routed request, so it must not refresh LRU clocks on replicas the
+        request never lands on (``PrefixIndex.lookup(touch=False)``).
+        0 on a non-paged engine (no prefix cache, no affinity signal)."""
+        if self.paged is None:
+            return 0
+        return int(self.paged.index.lookup(prompt, touch=False).shared_len)
+
     def dump_pressure(self, reason: str) -> Optional[Dict[str, Any]]:
         """SLO-saturation incident seam (ISSUE 15): serialize the flight
         recorder + every LIVE request's timeline when the pressure monitor

@@ -69,6 +69,14 @@ from tpu_nexus.serving.loadstats import (
     emit_fleet_snapshot,
 )
 from tpu_nexus.serving.request import Request
+from tpu_nexus.serving.router import (
+    ROUTER_PRESSURE,
+    SCALE_DECISIONS,
+    SCALE_DOWN_WHEN_IDLE,
+    SCALE_UP,
+    AutoscaleConfig,
+    FleetRouter,
+)
 from tpu_nexus.serving.scheduler import QueueFull
 from tpu_nexus.workload.durability import CheckpointError, VerifiedStepPoller
 
@@ -191,17 +199,30 @@ class ServingFleet:
     update state machine.  Pure host-side and clock-injectable: the chaos
     drills run hundreds of scenarios without a device or a wall clock.
 
-    Traffic: :meth:`submit` tries replicas round-robin and skips any that
-    is down, mid-reload, or sheds (``QueueFull``) — the router is what
-    turns one replica's pause into zero dropped requests fleet-wide.
+    Traffic: :meth:`submit` delegates to :class:`FleetRouter`
+    (serving/router.py) — pressure/affinity-ranked candidates with
+    shed-and-retry-elsewhere; a per-replica ``QueueFull`` (or a replica
+    dying between snapshot and submit) is a recorded hop, never a drop,
+    and only fleet-wide exhaustion sheds.  ``policy="round-robin"``
+    keeps the pre-ISSUE-19 rotation as the bench baseline.
     Progress: :meth:`tick` pumps every live engine one step and advances
     the rollout state machine."""
 
-    def __init__(self, clock: Callable[[], float] = time.monotonic) -> None:
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        policy: str = ROUTER_PRESSURE,
+        metrics: Optional[Any] = None,
+    ) -> None:
         self.replicas: Dict[str, EngineReplica] = {}
         self._clock = clock
-        self._rr = 0
+        self.router = FleetRouter(self, policy=policy, metrics=metrics)
         self._counter = itertools.count()
+        #: retirement logs of replicas REMOVED from the fleet (autoscale
+        #: scale-down): ``all_retired`` must stay total over every request
+        #: the fleet ever accepted, bounded like a replica's own history
+        self._graveyard: List[Request] = []
+        self._graveyard_limit = 10_000
         self._rollout: Optional[_Rollout] = None
         #: (step, error) of the last ABORTED rollout — the candidate failed
         #: its load-time deep verification (rotted between poll and load)
@@ -263,6 +284,21 @@ class ServingFleet:
         rep.down_cause = ""
         return rep
 
+    def remove_replica(self, name: str) -> EngineReplica:
+        """Take a replica OUT of the fleet (autoscale scale-down — the
+        caller already drained it; any stragglers were retired with
+        honest causes by ``drain``).  Its full retirement log folds into
+        the fleet graveyard so per-request accounting survives the
+        membership change, bounded front-trimmed like replica history."""
+        rep = self.replicas.pop(name, None)
+        if rep is None:
+            raise FleetError(f"unknown replica {name!r}")
+        rep.fold_history()
+        self._graveyard.extend(rep.history)
+        if len(self._graveyard) > self._graveyard_limit:
+            del self._graveyard[: len(self._graveyard) - self._graveyard_limit]
+        return rep
+
     # -- traffic ---------------------------------------------------------------
 
     def submit(
@@ -272,32 +308,19 @@ class ServingFleet:
         request_id: Optional[str] = None,
         deadline_s: Optional[float] = None,
     ) -> Request:
-        """Route one request to the next replica that accepts it (round-
-        robin over SERVING replicas).  Raises ``QueueFull`` when every
-        replica is down/reloading/at capacity — the client owns the retry,
-        exactly like a single engine's shed."""
+        """Route one request through :class:`FleetRouter` (serving/
+        router.py): candidates ranked by pressure grade, shared-prefix
+        affinity, and load; per-replica refusals retry the next-best
+        replica with the hop recorded.  Raises ``QueueFull`` only on
+        fleet-wide exhaustion — and THAT shed names every replica tried
+        and why each refused; the client owns the retry, exactly like a
+        single engine's shed."""
         rid = request_id if request_id is not None else f"flt-{next(self._counter)}"
-        names = list(self.replicas)
-        if not names:
+        if not self.replicas:
             raise FleetError("fleet has no replicas")
-        for offset in range(len(names)):
-            rep = self.replicas[names[(self._rr + offset) % len(names)]]
-            if rep.state != REPLICA_SERVING:
-                continue
-            try:
-                req = rep.engine.submit(
-                    prompt, max_new_tokens, request_id=rid, deadline_s=deadline_s
-                )
-            except QueueFull:  # noqa: BLE001 - routing IS the handled outcome: the replica's shed was counted on its serving.shed, and the router tries the next replica (that fan-out is what makes a rolling reload zero-drop)
-                continue
-            self._rr = (self._rr + offset + 1) % len(names)
-            self.submitted += 1
-            return req
-        raise QueueFull(
-            f"request {rid}: no serving replica accepted "
-            f"({sum(1 for r in self.replicas.values() if r.state == REPLICA_DOWN)} down, "
-            f"{sum(1 for r in self.replicas.values() if r.state == REPLICA_RELOADING)} reloading)"
-        )
+        req = self.router.submit(prompt, max_new_tokens, rid, deadline_s=deadline_s)
+        self.submitted += 1
+        return req
 
     @property
     def has_work(self) -> bool:
@@ -467,8 +490,9 @@ class ServingFleet:
     def all_retired(self) -> List[Request]:
         """Every retired request across all replicas AND engine
         incarnations — what the zero-drop drills audit for terminal
-        totality + honest causes."""
-        out: List[Request] = []
+        totality + honest causes.  Includes the graveyard: a replica
+        scaled AWAY takes its accounting into the fleet, not with it."""
+        out: List[Request] = list(self._graveyard)
         for rep in self.replicas.values():
             out.extend(rep.all_retired())
         return out
@@ -561,6 +585,7 @@ class FleetSupervisor:
         logger_: Optional[Any] = None,
         metrics: Optional[Any] = None,
         slo: Optional[SloMonitor] = None,
+        autoscale: Optional[AutoscaleConfig] = None,
     ) -> None:
         from tpu_nexus.core.telemetry import NullMetrics, get_logger
         from tpu_nexus.k8s.informer import SharedInformerFactory
@@ -608,9 +633,22 @@ class FleetSupervisor:
         #: monitor is configured; transitions land on the ledger row +
         #: tagged metrics, SATURATED dumps the replica's flight recorder
         self.slo = slo
+        if slo is not None:
+            # the router grades candidates off the SAME monitor the
+            # autoscaler consumes — one pressure truth per fleet
+            fleet.router.slo = slo
+        #: autoscaling (ISSUE 19): None disables — the pre-19 fixed fleet
+        self.autoscale = autoscale
+        self._scale_up_streak = 0
+        self._scale_down_streak = 0
+        self._last_scale_t: Optional[float] = None
+        self._scale_counter = itertools.count(1)
         # observability (tests + dashboards)
         self.recreated = 0
         self.escalated = 0
+        self.scaled_up = 0
+        self.scaled_down = 0
+        self.scale_events: List[Dict[str, Any]] = []
         self.incidents: List[Dict[str, Any]] = []
         #: bounded transition log (front-trimmed past
         #: _pressure_events_limit, the SloMonitor.transitions discipline):
@@ -737,7 +775,8 @@ class FleetSupervisor:
         await self._sweep_missing_pods(now)
         self._check_rollout(now)
         self.fleet.tick()
-        await self._observe_pressure()
+        snapshot = await self._observe_pressure()
+        await self._autoscale(now, snapshot)
 
     async def _sweep_missing_pods(self, now: float) -> None:
         """Absence-driven backstop (the ledger watchdog's discipline): a
@@ -813,7 +852,7 @@ class FleetSupervisor:
 
     # -- the pressure plane (ISSUE 15) -----------------------------------------
 
-    async def _observe_pressure(self) -> None:
+    async def _observe_pressure(self) -> Optional[FleetSnapshot]:
         """One pressure observation per reconcile (module doc): snapshot
         the fleet, emit the tagged load gauges, grade through the SLO
         monitor, and dispatch each transition through the TOTAL
@@ -822,9 +861,11 @@ class FleetSupervisor:
         ``fleet.pressure_transitions`` metric, ``pressure_events``), and
         a replica entering SATURATED additionally dumps its flight
         recorder at the saturation incident seam so the episode gets the
-        same drill-down a fault does."""
+        same drill-down a fault does.  Returns the snapshot it graded
+        (the autoscaler's idleness input — one snapshot per reconcile,
+        not one per consumer), None when no monitor is wired."""
         if self.slo is None:
-            return
+            return None
         snapshot = self.fleet.snapshot()
         emit_fleet_snapshot(self._metrics, snapshot)
         for transition in self.slo.observe(snapshot):
@@ -854,6 +895,193 @@ class FleetSupervisor:
                 to=transition["to"],
             )
             await self._record_pressure(record, snapshot)
+        return snapshot
+
+    # -- autoscaling (ISSUE 19) ------------------------------------------------
+
+    async def _autoscale(
+        self, now: float, snapshot: Optional[FleetSnapshot]
+    ) -> None:
+        """One autoscale observation per reconcile: map the SLO monitor's
+        FLEET grade through the TOTAL ``SCALE_DECISIONS`` table (nxlint
+        NX021), require the verdict to HOLD for a configured streak of
+        consecutive reconciles (scale-down additionally requires the
+        fleet idle — zero queued AND zero in-flight, which is what makes
+        the ``drain`` path zero-drop by construction), gate on the
+        cooldown, then act through the same pod create/delete seams as
+        failure recovery.  Every decision lands cause+details on the
+        ledger row like any other incident."""
+        if self.autoscale is None or self.slo is None or snapshot is None:
+            return
+        from tpu_nexus.serving.loadstats import PRESSURE_HEALTHY
+
+        grade = self.slo.grades.get(SloMonitor.FLEET, PRESSURE_HEALTHY)
+        decision = SCALE_DECISIONS[grade]
+        idle = snapshot.queue_depth == 0 and snapshot.live_requests == 0
+        if decision == SCALE_UP:
+            self._scale_up_streak += 1
+            self._scale_down_streak = 0
+        elif decision == SCALE_DOWN_WHEN_IDLE and idle:
+            self._scale_down_streak += 1
+            self._scale_up_streak = 0
+        else:
+            self._scale_up_streak = 0
+            self._scale_down_streak = 0
+        if (
+            self._last_scale_t is not None
+            and now - self._last_scale_t < self.autoscale.cooldown_s
+        ):
+            return
+        live = [
+            rep for rep in self.fleet.replicas.values()
+            if rep.state != REPLICA_DOWN
+        ]
+        if (
+            self._scale_up_streak >= self.autoscale.scale_up_after
+            and len(live) < self.autoscale.max_replicas
+        ):
+            await self._scale_up(now, grade, snapshot)
+        elif (
+            self._scale_down_streak >= self.autoscale.scale_down_after
+            and sum(1 for rep in live if rep.state == REPLICA_SERVING)
+            > self.autoscale.min_replicas
+        ):
+            await self._scale_down(now, grade, snapshot)
+
+    async def _scale_up(
+        self, now: float, grade: str, snapshot: FleetSnapshot
+    ) -> None:
+        """Add one replica: clone an existing pod manifest (fresh name +
+        uid, Pending, default KV budget — the recreate path's template
+        discipline), create it in the cluster, build its engine at the
+        newest verified step, and join it to the fleet."""
+        name = f"{self.jobset_name}-scale-{next(self._scale_counter)}"
+        template = next(iter(self._pod_templates.values()), None)
+        if template is None:
+            self._log.warning(
+                "autoscale: no pod manifest template to clone; skipping scale-up"
+            )
+            return
+        manifest = copy.deepcopy(template)
+        meta = manifest.setdefault("metadata", {})
+        meta["name"] = name
+        meta["uid"] = f"fleet-scale-{next(self._uid_counter)}"
+        manifest["status"] = {"phase": "Pending"}
+        await self._client.create_object("Pod", self.namespace, manifest)
+        self._pod_templates[name] = copy.deepcopy(manifest)
+        self._kv_blocks[name] = self._default_kv_blocks
+        step = self._target_step()
+        engine = self.replica_factory(name, step, self._default_kv_blocks)
+        self.fleet.add_replica(name, engine, step)
+        self.scaled_up += 1
+        self._scale_up_streak = 0
+        self._scale_down_streak = 0
+        self._last_scale_t = now
+        record = {
+            "action": "autoscale",
+            "decision": SCALE_UP,
+            "grade": grade,
+            "pod": name,
+            "step": step,
+            "replicas": len(self.fleet.replicas),
+        }
+        self.scale_events.append(record)
+        self._metrics.count("fleet_autoscale", tags={"decision": "up"})
+        self._log.info(
+            "fleet scaled up", pod=name, grade=grade, replicas=record["replicas"]
+        )
+        await self._record_scale(record, snapshot)
+
+    async def _scale_down(
+        self, now: float, grade: str, snapshot: FleetSnapshot
+    ) -> None:
+        """Remove one replica, zero-drop: pick the least-loaded SERVING
+        replica, ``drain(grace_s)`` it (the fleet is idle by the streak
+        precondition, so the drain retires nothing — stragglers past
+        grace would carry the drain's honest cause), fold its accounting
+        into the fleet graveyard, and delete its pod (an EXPECTED
+        deletion — the watch event must not classify as an incident)."""
+        from tpu_nexus.k8s.client import NotFoundError
+
+        serving = [
+            (name, rep)
+            for name, rep in self.fleet.replicas.items()
+            if rep.state == REPLICA_SERVING
+        ]
+        if not serving:
+            return
+        name, rep = min(
+            serving,
+            key=lambda item: (
+                item[1].engine.scheduler.pending + item[1].engine.in_flight,
+                item[0],
+            ),
+        )
+        drain = rep.engine.drain(self.grace_s)
+        self.fleet.remove_replica(name)
+        self._expected_deletions.add(name)
+        try:
+            await self._client.delete_object("Pod", self.namespace, name)
+        except NotFoundError:  # noqa: BLE001 - pod already gone; membership removal above is the part that matters
+            self._expected_deletions.discard(name)
+        self._pod_templates.pop(name, None)
+        self._kv_blocks.pop(name, None)
+        self._missing.forget(name)
+        self.scaled_down += 1
+        self._scale_up_streak = 0
+        self._scale_down_streak = 0
+        self._last_scale_t = now
+        record = {
+            "action": "autoscale",
+            "decision": "scale-down",
+            "grade": grade,
+            "pod": name,
+            "drain": drain,
+            "replicas": len(self.fleet.replicas),
+        }
+        self.scale_events.append(record)
+        self._metrics.count("fleet_autoscale", tags={"decision": "down"})
+        self._log.info(
+            "fleet scaled down", pod=name, grade=grade, replicas=record["replicas"]
+        )
+        await self._record_scale(record, snapshot)
+
+    async def _record_scale(
+        self, record: Dict[str, Any], snapshot: FleetSnapshot
+    ) -> None:
+        """Scale decisions on the ledger (the ``_record_cause``
+        discipline): the row stays RUNNING, cause names the decision,
+        details embed the record + the graded snapshot that justified
+        it — an operator reading the row sees WHY capacity changed."""
+        if self._store is None:
+            return
+        import asyncio
+
+        cause = (
+            f"fleet autoscale: {record['decision']} -> {record['pod']} "
+            f"(grade {record['grade']})"
+        )
+        details = json.dumps(
+            {"autoscale": record, "fleet": snapshot.to_dict()},
+            sort_keys=True,
+            default=str,
+        )
+
+        def _write():
+            cp = self._store.read_checkpoint(self.algorithm, self.jobset_name)
+            if cp is None or cp.is_finished():
+                return
+            self._store.update_fields(
+                self.algorithm,
+                self.jobset_name,
+                {
+                    "algorithm_failure_cause": cause,
+                    "algorithm_failure_details": details,
+                    "last_modified": datetime.now(timezone.utc),
+                },
+            )
+
+        await asyncio.to_thread(_write)
 
     async def _record_pressure(
         self, record: Dict[str, Any], snapshot: FleetSnapshot

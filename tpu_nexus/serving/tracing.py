@@ -64,6 +64,11 @@ EV_MATERIALIZE = "materialize"
 EV_SPEC_PROPOSE = "spec_propose"
 EV_SPEC_ACCEPT = "spec_accept"
 EV_FAULT = "fault"
+#: the fleet router retried this request on another replica after a
+#: per-replica refusal — attrs carry the ordered ``tried`` list of
+#: ``replica:cause`` hops, so a request's timeline shows its whole
+#: admission path, not just the replica that finally took it
+EV_ROUTER_RETRY = "router_retry"
 #: terminal event: retirement state/action/cause + the TTFT/TPOT summary
 #: (computed from the same Request timestamps ServingMetrics histograms)
 EV_RETIRED = "retired"

@@ -200,6 +200,28 @@ class ServeConfig:
     #: short <= long (NEXUS_SLO_SHORT_N / NEXUS_SLO_LONG_N)
     slo_short_window: int = 4
     slo_long_window: int = 12
+    #: fleet mode — how the fleet router ranks candidate replicas
+    #: (ISSUE 19, serving/router.py): "pressure" (SLO grade tier ->
+    #: shared-prefix affinity -> load score) or "round-robin" (the
+    #: pre-19 rotation, kept as the bench baseline).  Validated against
+    #: serving.router.ROUTER_POLICIES at parse (NEXUS_ROUTER_POLICY)
+    router_policy: str = "pressure"
+    #: fleet mode — supervisor-driven autoscaling bounds (ISSUE 19):
+    #: both 0 disables (the pre-19 fixed fleet); both > 0 enables with
+    #: min <= live replicas <= max.  Requires NEXUS_SLO_* targets — the
+    #: scale decisions are SloMonitor grades mapped through the NX021
+    #: SCALE_DECISIONS table, so without a monitor the autoscaler would
+    #: silently never act (an explicitly requested feature must run or
+    #: refuse).  (NEXUS_AUTOSCALE_MIN / NEXUS_AUTOSCALE_MAX)
+    autoscale_min: int = 0
+    autoscale_max: int = 0
+    #: fleet mode — autoscale hysteresis: consecutive reconciles the
+    #: scale verdict must hold before acting (scale-down additionally
+    #: requires the fleet idle), and the cooldown between actions
+    #: (NEXUS_SCALE_UP_N / NEXUS_SCALE_DOWN_N / NEXUS_SCALE_COOLDOWN_S)
+    scale_up_after: int = 3
+    scale_down_after: int = 12
+    scale_cooldown_s: float = 60.0
 
     def __post_init__(self) -> None:
         # value validation lives HERE, not in the run loops: a bad env
@@ -383,6 +405,29 @@ class ServeConfig:
         # owner of the window/burn/target invariants) — constructing one
         # at parse is the validation, so a bad NEXUS_SLO_* env dies here
         # in both the serve loop and the fleet controller
+        from tpu_nexus.serving.router import ROUTER_POLICIES
+
+        if self.router_policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router_policy (NEXUS_ROUTER_POLICY) "
+                f"{self.router_policy!r}; use one of {ROUTER_POLICIES}"
+            )
+        if (self.autoscale_min > 0) != (self.autoscale_max > 0):
+            raise ValueError(
+                "autoscale bounds come as a pair: set BOTH "
+                "NEXUS_AUTOSCALE_MIN and NEXUS_AUTOSCALE_MAX > 0 (enabled) "
+                f"or neither (disabled), got min={self.autoscale_min} "
+                f"max={self.autoscale_max}"
+            )
+        # AutoscaleConfig owns the bounds/streak/cooldown invariants —
+        # constructing one at parse IS the validation (the SloTargets
+        # discipline), so a bad NEXUS_AUTOSCALE_*/NEXUS_SCALE_* dies here
+        if self.autoscale_config() is not None and self.slo_targets() is None:
+            raise ValueError(
+                "autoscaling (NEXUS_AUTOSCALE_MIN/MAX) requires NEXUS_SLO_* "
+                "targets — scale decisions are SLO-monitor grades, and "
+                "without a monitor the autoscaler would never act"
+            )
         if self.slo_targets() is not None and not self.heartbeat_every:
             # the serve loop observes the monitor at heartbeat cadence —
             # targets with the cadence disabled would construct a monitor
@@ -407,6 +452,21 @@ class ServeConfig:
             shed_rate=self.slo_shed_rate,
             short_window=self.slo_short_window,
             long_window=self.slo_long_window,
+        )
+
+    def autoscale_config(self) -> "Optional[Any]":
+        """The parsed+validated :class:`~tpu_nexus.serving.router.
+        AutoscaleConfig`, or None when the bounds are 0 (disabled)."""
+        if not self.autoscale_min and not self.autoscale_max:
+            return None
+        from tpu_nexus.serving.router import AutoscaleConfig
+
+        return AutoscaleConfig(
+            min_replicas=self.autoscale_min,
+            max_replicas=self.autoscale_max,
+            scale_up_after=self.scale_up_after,
+            scale_down_after=self.scale_down_after,
+            cooldown_s=self.scale_cooldown_s,
         )
 
     @staticmethod
@@ -450,6 +510,12 @@ class ServeConfig:
             slo_shed_rate=float(e.get("NEXUS_SLO_SHED_RATE", "0")),
             slo_short_window=int(e.get("NEXUS_SLO_SHORT_N", "4")),
             slo_long_window=int(e.get("NEXUS_SLO_LONG_N", "12")),
+            router_policy=e.get("NEXUS_ROUTER_POLICY", "pressure"),
+            autoscale_min=int(e.get("NEXUS_AUTOSCALE_MIN", "0")),
+            autoscale_max=int(e.get("NEXUS_AUTOSCALE_MAX", "0")),
+            scale_up_after=int(e.get("NEXUS_SCALE_UP_N", "3")),
+            scale_down_after=int(e.get("NEXUS_SCALE_DOWN_N", "12")),
+            scale_cooldown_s=float(e.get("NEXUS_SCALE_COOLDOWN_S", "60")),
         )
 
 
