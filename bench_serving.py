@@ -67,6 +67,27 @@ BENCH_FLEET_r14.json.  Knobs: ``NEXUS_FLEET_REPLICAS`` /
 ``NEXUS_FLEET_WEAK_SLOTS`` / ``NEXUS_FLEET_REQUESTS`` /
 ``NEXUS_FLEET_TTFT_SLO_S`` / ``NEXUS_FLEET_TPOT_SLO_S``.
 
+``--disagg`` (ISSUE 20) benches DISAGGREGATED prefill/decode serving:
+the same mixed long-prefill/short-decode Poisson schedule through the
+same two-replica hardware budget — two FUSED paged replicas vs one
+PREFILL + one DECODE replica with the sealed KV-block handoff between
+them (serving/handoff.py).  The headline is TTFT p99: on the fused side
+every admission waits for ticks that interleave long prefills with the
+whole decode batch, while the prefill replica's tenancy is TRANSIENT
+(slot + blocks released the moment the payload is extracted), so
+admissions never queue behind decode work.  Arrivals are scheduled in
+TICK-space with the middle fifth compressed into one burst, so the
+contended regime is machine-speed independent; the burst peak overflows
+the decode pool by a few requests on purpose — the recorded
+degrade-to-fused path is priced into the disaggregated percentiles, not
+hidden.  Outputs are asserted token-identical across modes —
+disaggregation moves WHERE the KV lives, never WHAT gets decoded — and
+the artifact records the handoff/fallback accounting (every request
+either completes the handoff or is RECORDED degrading).  Artifact:
+``NEXUS_DISAGG_OUT``, default BENCH_DISAGG_r15.json.  Knobs:
+``NEXUS_DISAGG_BENCH_REQUESTS`` / ``NEXUS_DISAGG_BENCH_ARRIVAL_PER_TICK``
+/ ``NEXUS_DISAGG_BENCH_SLOTS``.
+
 ``--shared-prefix`` (ISSUE 6) instead benches the PAGED engine on the
 millions-of-users workload: one long system prompt, high fan-out, short
 unique tails.  Both engines get the SAME KV HBM budget (``slots ×
@@ -1446,6 +1467,242 @@ def main_fleet():
     print(json.dumps(result))
 
 
+# -- disaggregated prefill/decode workload (ISSUE 20) ---------------------------
+
+DISAGG_REQUESTS = int(os.environ.get("NEXUS_DISAGG_BENCH_REQUESTS", "64"))
+#: arrivals are scheduled in TICK-space (requests per engine tick), not
+#: wall-clock: the contended regime this bench prices — a burst landing on
+#: slots pinned by live decodes — depends on arrivals per unit of SERVICE,
+#: and a wall-clock schedule hits a different regime on every CI box.
+#: Latencies are still reported in wall seconds.
+DISAGG_ARRIVAL_PER_TICK = float(
+    os.environ.get("NEXUS_DISAGG_BENCH_ARRIVAL_PER_TICK", "0.12")
+)
+DISAGG_SLOTS = int(os.environ.get("NEXUS_DISAGG_BENCH_SLOTS", "8"))
+#: same TOTAL slot budget both ways (2 x DISAGG_SLOTS), split by ROLE on
+#: the disaggregated side: the prefill tenancy is transient (released at
+#: extract), so the prefill replica needs a fraction of the slots and the
+#: decode replica — which holds the live batch — takes the rest
+DISAGG_PREFILL_SLOTS = max(2, DISAGG_SLOTS // 4)
+DISAGG_DECODE_SLOTS = 2 * DISAGG_SLOTS - DISAGG_PREFILL_SLOTS
+DISAGG_PAGE = 4
+#: exactly two prompt buckets so both fleets warm the same prefill jits:
+#: LONG prompts with short decodes (the prefill-heavy half that stalls a
+#: fused replica's whole decode batch) and SHORT prompts with long
+#: decodes (the latency-sensitive half whose TTFT pays for it)
+DISAGG_LONG_PROMPT, DISAGG_LONG_GEN = 48, 4
+DISAGG_SHORT_PROMPT, DISAGG_SHORT_GEN = 8, 48
+DISAGG_MAX_LEN = DISAGG_LONG_PROMPT + DISAGG_SHORT_GEN
+
+
+def disagg_bench_model() -> LlamaConfig:
+    """:func:`bench_model` in f32: the two modes run DIFFERENT batch
+    shapes (role-split slot budgets), and XLA fuses bf16 differently per
+    batch size — resolving exact argmax ties differently (the --mesh
+    caveat).  f32 keeps the cross-mode identity assert exact."""
+    return LlamaConfig(
+        vocab_size=512, hidden=256, n_layers=4, n_heads=8, n_kv_heads=4,
+        head_dim=32, intermediate=512, max_seq_len=2 * DISAGG_MAX_LEN,
+        remat=False, dtype=jnp.float32, param_dtype=jnp.float32,
+    )
+
+
+def _disagg_offsets(rng, n):
+    """Cumulative tick-space arrival offsets with the middle third
+    COMPRESSED into one burst (the --fleet skew taken to its limit): the
+    burst lands while earlier short requests still pin decode slots,
+    which is exactly the moment fused admission queues behind decode
+    occupancy and a transient prefill tenancy does not.  The peak is
+    sized to overflow the decode pool by a FEW requests on purpose: the
+    recorded fused-degradation path is part of the price, and those
+    requests' queued TTFTs land in the disaggregated percentiles."""
+    offsets = np.cumsum(rng.exponential(1.0 / DISAGG_ARRIVAL_PER_TICK, size=n))
+    offsets[2 * n // 5 : 3 * n // 5] = offsets[2 * n // 5]
+    return offsets
+
+
+def make_disagg_requests(rng):
+    """Mixed traffic, 1/3 long-prefill: the mix where fused interleaving
+    hurts — each long prefill rides a tick every decoding request's next
+    token is waiting on.  Every prompt gets a UNIQUE first token (ids the
+    random body never uses), so no request prefix-hits another: a chance
+    1-token shared prefix would route through the COW-extend prefill jit
+    and the one-off compile would swamp the p99 this bench exists to
+    measure (prefix reuse is --shared-prefix's workload, not this one)."""
+    reqs = []
+    for i in range(DISAGG_REQUESTS):
+        if rng.random() < 1.0 / 3.0:
+            plen, gen = DISAGG_LONG_PROMPT, DISAGG_LONG_GEN
+        else:
+            plen, gen = DISAGG_SHORT_PROMPT, DISAGG_SHORT_GEN
+        body = rng.integers(1, 256, size=plen - 1).astype(np.int32)
+        head = np.array([260 + i], dtype=np.int32)
+        reqs.append({"prompt": np.concatenate([head, body]), "gen": gen})
+    return reqs
+
+
+def _disagg_replica(params, cfg, slots=None):
+    """One warmed-up paged engine (both prompt buckets prefilled once, so
+    neither side pays first-compile inside the measured pass)."""
+    executor = PagedModelExecutor(
+        params, cfg, num_slots=DISAGG_SLOTS if slots is None else slots,
+        max_len=DISAGG_MAX_LEN, page_size=DISAGG_PAGE, seed=SEED,
+    )
+    engine = ServingEngine(executor)
+    # DISJOINT warmup prompts: arange prompts would share a prefix, so the
+    # long one would warm only the tail_start>0 prefill bucket and the
+    # first fresh long prompt in the measured pass would pay the compile
+    for i, width in enumerate((DISAGG_SHORT_PROMPT, DISAGG_LONG_PROMPT)):
+        start = 1 + 100 * i
+        engine.submit(np.arange(start, start + width, dtype=np.int32), 2)
+    engine.run_until_drained()
+    engine.metrics = ServingMetrics()
+    return engine
+
+
+def run_disagg_poisson(params, cfg, requests, offsets, disagg):
+    """One open-loop pass of the mixed schedule through a fresh
+    two-replica fleet — role-split when ``disagg``, both fused otherwise.
+    Returns (summary row, per-request outputs) for the identity assert."""
+    from tpu_nexus.serving import DisaggConfig, ServingFleet, percentile
+    from tpu_nexus.serving.handoff import ROLE_DECODE, ROLE_PREFILL
+
+    fleet = ServingFleet(disagg=DisaggConfig(), handoff_sleep=lambda s: None)
+    roles = (
+        (("prefill-0", ROLE_PREFILL, DISAGG_PREFILL_SLOTS),
+         ("decode-0", ROLE_DECODE, DISAGG_DECODE_SLOTS))
+        if disagg
+        else (("fused-0", "fused", DISAGG_SLOTS), ("fused-1", "fused", DISAGG_SLOTS))
+    )
+    for name, role, slots in roles:
+        fleet.add_replica(
+            name, _disagg_replica(params, cfg, slots=slots), step=1, role=role
+        )
+    # warm the handoff path itself (extract/install dispatches) off-clock,
+    # same disjoint-prompt discipline as the per-replica warmup
+    for i, width in enumerate((DISAGG_SHORT_PROMPT, DISAGG_LONG_PROMPT)):
+        start = 1 + 100 * i
+        fleet.submit(np.arange(start, start + width, dtype=np.int32), 2)
+    fleet.run_until_drained()
+    warm_handoffs = fleet.handoffs_completed
+
+    t0 = time.perf_counter()
+    idx = 0
+    tick_no = 0.0
+    while idx < len(requests) or fleet.has_work:
+        while idx < len(requests) and offsets[idx] <= tick_no:
+            r = requests[idx]
+            fleet.submit(r["prompt"], r["gen"], request_id=f"dg-{idx}")
+            idx += 1
+        if fleet.has_work:
+            fleet.tick()
+        tick_no += 1.0
+    elapsed = time.perf_counter() - t0
+
+    done = [
+        r
+        for r in fleet.all_retired()
+        if r.request_id.startswith("dg-") and r.state == RequestState.FINISHED
+    ]
+    ttfts = [r.first_token_at - r.submitted_at for r in done]
+    tpots = [
+        (r.last_token_at - r.first_token_at) / (len(r.output_tokens) - 1)
+        for r in done
+        if len(r.output_tokens) > 1
+    ]
+    outputs = {r.request_id: list(r.output_tokens) for r in done}
+    row = {
+        "mode": "disaggregated" if disagg else "fused",
+        "replicas": {name: f"{role}:{slots}" for name, role, slots in roles},
+        "requests": len(requests),
+        "requests_finished": len(done),
+        "elapsed_s": round(elapsed, 4),
+        "ttft_p50_s": round(percentile(ttfts, 50.0), 5),
+        "ttft_p99_s": round(percentile(ttfts, 99.0), 5),
+        "tpot_p50_s": round(percentile(tpots, 50.0), 5),
+        "tpot_p99_s": round(percentile(tpots, 99.0), 5),
+        "handoffs_completed": fleet.handoffs_completed - warm_handoffs,
+        "disagg_fallbacks": fleet.disagg_fallbacks,
+        "handoff_log_entries": len(fleet.handoff_log),
+    }
+    return row, outputs
+
+
+def main_disagg():
+    """``--disagg``: ISSUE 20's split, priced.  The SAME mixed
+    long-prefill/short-decode Poisson schedule through the SAME
+    two-replica hardware budget, fused vs role-split; the headline is the
+    TTFT p99 ratio.  The structural win being measured: a fused replica
+    admits new work into ticks shared with the whole decode batch (and
+    every long prefill in that tick), while the prefill replica's
+    transient tenancy turns admission into prefill-only latency — the
+    decode pool's batch never gates a first token.  Outputs are asserted
+    token-identical: the handoff moves sealed KV blocks, never the
+    argmax."""
+    rng = np.random.default_rng(SEED)
+    requests = make_disagg_requests(rng)
+    offsets = _disagg_offsets(rng, len(requests))
+    cfg = disagg_bench_model()
+    params = llama_init(jax.random.PRNGKey(SEED), cfg)
+
+    rows = {}
+    outputs = {}
+    for disagg in (False, True):
+        row, outs = run_disagg_poisson(params, cfg, requests, offsets, disagg)
+        assert len(outs) == DISAGG_REQUESTS, (
+            f"{row['mode']}: {len(outs)}/{DISAGG_REQUESTS} requests finished "
+            "— the fleet dropped work"
+        )
+        rows[row["mode"]] = row
+        outputs[row["mode"]] = outs
+    assert outputs["fused"] == outputs["disaggregated"], (
+        "disaggregation changed token streams"
+    )
+    dg = rows["disaggregated"]
+    assert dg["handoffs_completed"] + dg["disagg_fallbacks"] == DISAGG_REQUESTS, (
+        "disaggregated accounting leak: every request must either complete "
+        "the handoff or be RECORDED degrading to fused"
+    )
+
+    fused_p99 = rows["fused"]["ttft_p99_s"]
+    disagg_p99 = rows["disaggregated"]["ttft_p99_s"]
+    result = {
+        "metric": "disagg_ttft_p99_speedup_vs_fused",
+        "value": round(fused_p99 / disagg_p99, 4) if disagg_p99 else 0.0,
+        "unit": "x_ttft_p99",
+        "traffic": {
+            "requests": DISAGG_REQUESTS,
+            "arrival_per_tick": DISAGG_ARRIVAL_PER_TICK,
+            "arrival_skew": "middle fifth arrives as one burst",
+            "long_prefill": {
+                "prompt": DISAGG_LONG_PROMPT, "gen": DISAGG_LONG_GEN,
+                "share": "1/3",
+            },
+            "short_decode": {
+                "prompt": DISAGG_SHORT_PROMPT, "gen": DISAGG_SHORT_GEN,
+                "share": "2/3",
+            },
+        },
+        "slots": {
+            "fused": [DISAGG_SLOTS, DISAGG_SLOTS],
+            "disaggregated": {
+                "prefill": DISAGG_PREFILL_SLOTS,
+                "decode": DISAGG_DECODE_SLOTS,
+            },
+        },
+        "page_size": DISAGG_PAGE,
+        "modes": rows,
+        "token_identical": True,  # asserted above
+        "seed": SEED,
+        "model": "llama-bench-4L-h256-f32",
+        "backend": jax.default_backend(),
+    }
+    out = os.environ.get("NEXUS_DISAGG_OUT", "BENCH_DISAGG_r15.json")
+    with open(out, "w") as fh:
+        json.dump(result, fh, indent=1)
+    print(json.dumps(result))
+
+
 def main():
     rng = np.random.default_rng(SEED)
     cfg = bench_model()
@@ -1505,5 +1762,7 @@ if __name__ == "__main__":
         main_slo()
     elif "--fleet" in sys.argv[1:]:
         main_fleet()
+    elif "--disagg" in sys.argv[1:]:
+        main_disagg()
     else:
         main()

@@ -1,0 +1,877 @@
+"""Disaggregated prefill/decode serving + fault-isolated KV handoff (ISSUE 20).
+
+The handoff's contract, drilled from cheapest to nastiest:
+
+* decision tables total over ``REPLICA_ROLES`` x ``HANDOFF_FAULT_CAUSES``
+  (NX022's runtime twin) and every cause's ``DecisionAction`` covered by
+  the supervisor's ``SERVING_POD_RECOVERY`` table (NX001's runtime twin);
+* receiver-side payload validation — shape/dtype/count/CRC rejects each
+  carry the exact field in the message, and an unsealed payload never
+  installs;
+* bounded transient retry — only ``TransferDropped`` retries, with the
+  injectable sleep/rng audit discipline of ``StepFaultPolicy``;
+* FaultyExecutor parity — ``extract_blocks``/``install_blocks`` count on
+  the SAME step counter as ``step``/``verify``, so ``NEXUS_FAULT_STEP``
+  targets the Nth dispatch identically in disaggregated and fused mode;
+* token identity — the disaggregated fleet's outputs are token-identical
+  to solo ``generate`` across bf16/int8-KV x xla/pallas-interpret, with
+  the prefill pool decoding nothing;
+* chaos — the three "handoff-drop" / "handoff-corrupt" /
+  "kill-mid-handoff" modes: in-place retry heals a drop, a dead decode
+  peer hops to the next decode replica, a dead prefill peer re-prefills
+  elsewhere, permanent corruption exhausts the hop budget and DEGRADES to
+  fused serving (never sheds), every hop recorded with cause on the
+  ledger and the request timeline;
+* multi-seed fuzz killing replicas mid-handoff with ``verify_consistent``
+  after EVERY fleet tick and zero silent drops;
+* supervisor role preservation — a segfaulting prefill pod is recreated
+  AS a prefill pod (the pool never silently shrinks to zero), and
+  scale-down never drains a role's last serving replica.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_nexus.core.telemetry import METRIC_NAMES, RecordingMetrics
+from tpu_nexus.models import LlamaConfig
+from tpu_nexus.models.generate import generate
+from tpu_nexus.models.llama import llama_init
+from tpu_nexus.serving import (
+    HANDOFF_CAUSE_ACTIONS,
+    HANDOFF_DECISIONS,
+    HANDOFF_FAULT_CAUSES,
+    REPLICA_ROLES,
+    ROLE_DECODE,
+    ROLE_FUSED,
+    ROLE_PREFILL,
+    DisaggConfig,
+    HandoffAction,
+    HandoffPolicy,
+    KVHandoffPayload,
+    PagedModelExecutor,
+    PayloadCorrupt,
+    PeerLost,
+    RequestState,
+    ServingEngine,
+    ServingFleet,
+    TransferDropped,
+    handoff_cause_action,
+    handoff_decision,
+    validate_payload,
+)
+from tpu_nexus.serving.fleet import REPLICA_DOWN, FleetError
+from tpu_nexus.serving.handoff import (
+    CAUSE_HANDOFF_CORRUPT,
+    CAUSE_HANDOFF_DROP,
+    CAUSE_HANDOFF_EXHAUSTED,
+    CAUSE_HANDOFF_PEER_LOST,
+)
+from tpu_nexus.serving.tracing import EV_DISAGG_FALLBACK, EV_HANDOFF_HOP
+from tpu_nexus.supervisor.taxonomy import (
+    ACTION_MESSAGES,
+    DECISION_STAGE,
+    SERVING_POD_RECOVERY,
+    DecisionAction,
+    classify_tpu_failure,
+)
+from tpu_nexus.workload.faults import (
+    HANDOFF_FAULT_MODES,
+    FaultPlan,
+    FaultyExecutor,
+    wrap_executor,
+)
+
+# -- tables + registry (NX022 / NX015 / NX001 runtime twins) --------------------
+
+
+class TestDecisionTables:
+    def test_decisions_total_over_roles_x_causes(self):
+        assert set(HANDOFF_DECISIONS) == set(REPLICA_ROLES)
+        known_actions = {
+            HandoffAction.RETRY_TRANSFER,
+            HandoffAction.NEXT_DECODE,
+            HandoffAction.RE_PREFILL,
+            HandoffAction.FUSED_FALLBACK,
+        }
+        for role in REPLICA_ROLES:
+            assert set(HANDOFF_DECISIONS[role]) == set(HANDOFF_FAULT_CAUSES)
+            for cause in HANDOFF_FAULT_CAUSES:
+                assert handoff_decision(role, cause) in known_actions
+
+    def test_cause_actions_total_and_pod_recoverable(self):
+        assert set(HANDOFF_CAUSE_ACTIONS) == set(HANDOFF_FAULT_CAUSES)
+        for cause in HANDOFF_FAULT_CAUSES:
+            action = handoff_cause_action(cause)
+            # every handoff action flows through the SAME classify->act->
+            # record pipeline: staged, messaged, and pod-recoverable
+            assert action in DECISION_STAGE
+            assert action in ACTION_MESSAGES
+            assert action in SERVING_POD_RECOVERY
+
+    def test_unknown_role_or_cause_raises_descriptively(self):
+        with pytest.raises(ValueError, match="HANDOFF_DECISIONS"):
+            handoff_decision("gpu", CAUSE_HANDOFF_DROP)
+        with pytest.raises(ValueError, match="HANDOFF_DECISIONS"):
+            handoff_decision(ROLE_DECODE, "melted")
+        with pytest.raises(ValueError, match="HANDOFF_CAUSE_ACTIONS"):
+            handoff_cause_action("melted")
+
+    def test_exhaustion_degrades_never_retries(self):
+        for role in REPLICA_ROLES:
+            assert (
+                handoff_decision(role, CAUSE_HANDOFF_EXHAUSTED)
+                == HandoffAction.FUSED_FALLBACK
+            )
+
+    def test_handoff_metrics_registered(self):
+        for name in (
+            "serving.handoff_complete",
+            "serving.handoff_retry",
+            "serving.handoff_hop",
+            "serving.disagg_fallback",
+        ):
+            assert name in METRIC_NAMES, name
+
+    def test_classifier_recognizes_handoff_wordings(self):
+        assert (
+            classify_tpu_failure(
+                "serving replica died mid kv-handoff at install (injected kill)"
+            )
+            == DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST
+        )
+        assert (
+            classify_tpu_failure(
+                "kv handoff payload for r1: leaf 'k' crc32 0x1 != sealed 0x2"
+            )
+            == DecisionAction.TO_FAIL_KV_HANDOFF_ABORT
+        )
+
+
+# -- payload validation ---------------------------------------------------------
+
+
+def _payload(prompt_len=6, page_size=4, leaves=("k", "v"), dtype=np.float32):
+    n_blocks = -(-prompt_len // page_size)
+    blocks = {
+        name: np.arange(2 * n_blocks * page_size * 3, dtype=dtype).reshape(
+            2, n_blocks, page_size, 3
+        )
+        for name in leaves
+    }
+    return KVHandoffPayload(
+        request_id="r1",
+        prompt=tuple(range(1, prompt_len + 1)),
+        first_token=7,
+        page_size=page_size,
+        n_blocks=n_blocks,
+        blocks=blocks,
+    ).seal()
+
+
+def _specs(page_size=4, leaves=("k", "v"), dtype=np.float32):
+    return {name: ((2, page_size, 3), dtype) for name in leaves}
+
+
+class TestValidatePayload:
+    def test_sealed_payload_validates(self):
+        validate_payload(_payload(), page_size=4, leaf_specs=_specs())
+
+    @pytest.mark.parametrize(
+        "mutate, field",
+        [
+            (lambda p: setattr(p, "page_size", 8), "page_size"),
+            (lambda p: setattr(p, "n_blocks", 3), "block count"),
+            (lambda p: p.blocks.pop("v"), "leaf set"),
+            (lambda p: setattr(p, "checksums", {}), "unsealed"),
+        ],
+    )
+    def test_field_mismatches_reject_with_the_field_named(self, mutate, field):
+        payload = _payload()
+        mutate(payload)
+        with pytest.raises(PayloadCorrupt, match=field):
+            validate_payload(payload, page_size=4, leaf_specs=_specs())
+
+    def test_shape_and_dtype_checked_per_leaf(self):
+        payload = _payload()
+        payload.blocks["k"] = payload.blocks["k"][:, :, :2]
+        with pytest.raises(PayloadCorrupt, match="leaf 'k' shape"):
+            validate_payload(payload, page_size=4, leaf_specs=_specs())
+        payload = _payload()
+        with pytest.raises(PayloadCorrupt, match="leaf 'k' dtype"):
+            validate_payload(
+                payload, page_size=4, leaf_specs=_specs(dtype=np.int8)
+            )
+
+    def test_single_byte_corruption_is_caught(self):
+        payload = _payload()
+        flat = payload.blocks["v"].view(np.uint8).reshape(-1)
+        flat[len(flat) // 2] ^= 0xFF
+        with pytest.raises(PayloadCorrupt, match="crc32"):
+            validate_payload(payload, page_size=4, leaf_specs=_specs())
+
+    def test_corrupt_cause_token_rides_the_error(self):
+        payload = _payload()
+        payload.checksums["k"] = 0
+        with pytest.raises(PayloadCorrupt) as err:
+            validate_payload(payload, page_size=4, leaf_specs=_specs())
+        assert err.value.cause == CAUSE_HANDOFF_CORRUPT
+
+
+# -- bounded transient retry -----------------------------------------------------
+
+
+class TestHandoffPolicy:
+    def test_drop_retries_then_reraises(self):
+        naps = []
+        policy = HandoffPolicy(max_retries=2, sleep=naps.append)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            raise TransferDropped("kv handoff transfer dropped in transit")
+
+        with pytest.raises(TransferDropped):
+            policy.run(flaky)
+        assert calls["n"] == 3  # initial + 2 retries
+        assert policy.retries_used == 2 and policy.faults_seen == 3
+        assert len(naps) == 2 and all(s >= 0 for s in naps)
+
+    def test_drop_heals_within_budget(self):
+        policy = HandoffPolicy(max_retries=2, sleep=lambda s: None)
+        calls = {"n": 0}
+
+        def heals():
+            calls["n"] += 1
+            if calls["n"] < 2:
+                raise TransferDropped("dropped")
+            return "payload"
+
+        assert policy.run(heals) == "payload"
+        assert policy.retries_used == 1
+
+    def test_corrupt_and_peer_lost_never_retry_in_place(self):
+        for exc in (PayloadCorrupt("bad"), PeerLost("gone")):
+            policy = HandoffPolicy(max_retries=5, sleep=lambda s: None)
+            with pytest.raises(type(exc)):
+                policy.run(lambda exc=exc: (_ for _ in ()).throw(exc))
+            assert policy.retries_used == 0
+
+    def test_disagg_config_env_and_validation(self):
+        cfg = DisaggConfig.from_env(
+            {
+                "NEXUS_DISAGG_TRANSFER_RETRIES": "5",
+                "NEXUS_DISAGG_MAX_HOPS": "1",
+                "NEXUS_DISAGG_BACKOFF_BASE_S": "0.001",
+                "NEXUS_DISAGG_BACKOFF_MAX_S": "0.002",
+            }
+        )
+        assert cfg.transfer_retries == 5 and cfg.max_hops == 1
+        assert cfg.policy(sleep=lambda s: None).max_retries == 5
+        with pytest.raises(ValueError, match="transfer_retries"):
+            DisaggConfig(transfer_retries=-1)
+        with pytest.raises(ValueError, match="max_hops"):
+            DisaggConfig(max_hops=-1)
+        with pytest.raises(ValueError, match="backoff"):
+            DisaggConfig(backoff_base_s=0.5, backoff_max_s=0.1)
+
+
+# -- FaultyExecutor step-counter parity (the NEXUS_FAULT_STEP contract) ---------
+
+
+class _DispatchRecorder:
+    """Inner-executor stand-in recording dispatch order — enough surface
+    for the wrapper's counting discipline to be pinned exactly."""
+
+    num_slots = 2
+    max_len = 16
+
+    def __init__(self):
+        self.dispatches = []
+
+    def begin(self, slot, prompt, **kwargs):
+        self.dispatches.append("begin")
+        return 1
+
+    def step(self, tokens, cursors, *args):
+        self.dispatches.append("step")
+        return tokens
+
+    def extract_blocks(self, block_ids):
+        self.dispatches.append("extract")
+        return {}
+
+    def install_blocks(self, payload, block_ids):
+        self.dispatches.append("install")
+        return 0
+
+
+class TestFaultStepParity:
+    def test_handoff_dispatches_share_the_step_counter(self):
+        """extract/install count on the SAME counter as step(), so
+        ``at_step=N`` names the Nth dispatch regardless of its kind —
+        the regression the fused/disagg env-contract parity hangs on."""
+        wrapped = FaultyExecutor(
+            _DispatchRecorder(), "handoff-drop", at_step=2, times=1
+        )
+        wrapped.extract_blocks([1])  # dispatch 0
+        wrapped.step([1], [1])  # dispatch 1
+        with pytest.raises(TransferDropped):
+            wrapped.extract_blocks([1])  # dispatch 2: fires
+        assert wrapped.step_calls == 3 and wrapped.injected == 1
+        # the same target in FUSED mode is the same Nth dispatch
+        fused = FaultyExecutor(
+            _DispatchRecorder(), "step-ici", at_step=2, times=1
+        )
+        fused.step([1], [1])
+        fused.step([1], [1])
+        with pytest.raises(RuntimeError, match="ICI"):
+            fused.step([1], [1])
+        assert fused.step_calls == wrapped.step_calls == 3
+
+    def test_install_counts_and_kill_fires_there(self):
+        wrapped = FaultyExecutor(
+            _DispatchRecorder(), "kill-mid-handoff", at_step=1, times=1
+        )
+        wrapped.step([1], [1])
+        with pytest.raises(PeerLost, match="mid kv-handoff at install"):
+            wrapped.install_blocks(_payload(), [1])
+        assert wrapped.step_calls == 2
+        # past the window the wrapper is transparent again
+        assert wrapped.install_blocks(_payload(), [1]) == 0
+        assert wrapped.inner.dispatches == ["step", "install"]
+
+    def test_executor_modes_fire_on_handoff_dispatches_too(self):
+        wrapped = FaultyExecutor(
+            _DispatchRecorder(), "step-hbm-oom", at_step=0, times=1
+        )
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            wrapped.extract_blocks([1])
+
+    def test_corrupt_mutates_payload_and_proceeds(self):
+        """handoff-corrupt flips one byte of a SEALED leaf then calls the
+        inner executor — the RECEIVER's CRC validation is what must catch
+        it (the product code under drill, not the wrapper)."""
+        wrapped = FaultyExecutor(
+            _DispatchRecorder(), "handoff-corrupt", at_step=0, times=1
+        )
+        payload = _payload()
+        assert wrapped.install_blocks(payload, [1]) == 0  # proceeded
+        assert wrapped.injected == 1
+        with pytest.raises(PayloadCorrupt, match="crc32"):
+            validate_payload(payload, page_size=4, leaf_specs=_specs())
+
+    def test_corrupt_at_extract_is_a_vacuous_drill(self):
+        wrapped = FaultyExecutor(
+            _DispatchRecorder(), "handoff-corrupt", at_step=0, times=1
+        )
+        with pytest.raises(ValueError, match="install seam"):
+            wrapped.extract_blocks([1])
+
+    def test_wrap_executor_routes_handoff_modes(self):
+        plan = FaultPlan.from_env(
+            {"NEXUS_FAULT_MODE": "handoff-drop", "NEXUS_FAULT_STEP": "3"}
+        )
+        wrapped = wrap_executor(plan, _DispatchRecorder())
+        assert isinstance(wrapped, FaultyExecutor)
+        assert wrapped.mode in HANDOFF_FAULT_MODES and wrapped.at_step == 3
+
+
+# -- real-engine fixtures --------------------------------------------------------
+
+
+def _interpret_works() -> bool:
+    from tpu_nexus.ops.decode_attention import decode_attention
+
+    try:
+        q = jnp.ones((1, 1, 2, 8), jnp.float32)
+        kv = jnp.ones((1, 16, 2, 8), jnp.float32)
+        decode_attention(q, kv, kv, jnp.asarray(4, jnp.int32), interpret=True)
+        return True
+    except Exception:  # noqa: BLE001 - any interpreter failure means "skip env"
+        return False
+
+
+_CAN_INTERPRET = _interpret_works()
+
+CFG = LlamaConfig.tiny()
+PARAMS = llama_init(jax.random.PRNGKey(0), CFG)
+# pallas parity runs in f32 for the same tie-break reason as
+# tests/test_paged_cache.py (per-page online-softmax reorder noise)
+CFG_F32 = dataclasses.replace(CFG, dtype=jnp.float32)
+S, T = 12, 5
+
+
+def _kernels():
+    yield "xla"
+    if _CAN_INTERPRET:
+        yield "pallas"
+
+
+def _cfg_for(kernel):
+    return CFG if kernel == "xla" else CFG_F32
+
+
+def _engine(slots=2, kv_quant="", kernel="xla", wrap=None):
+    executor = PagedModelExecutor(
+        PARAMS, _cfg_for(kernel), num_slots=slots, max_len=S + T, page_size=4,
+        kv_quant=kv_quant, decode_kernel=kernel,
+    )
+    if wrap is not None:
+        executor = wrap(executor)
+    return ServingEngine(executor)
+
+
+def _prompts(seed=7, lens=(5, 8, 3, 11, 6)):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(1, CFG.vocab_size, size=n).astype(np.int32) for n in lens
+    ]
+
+
+def _disagg_fleet(
+    n_prefill=2, n_decode=2, decode_slots=3, wrap=None, wrap_name=None, **kw
+):
+    """2x2 role-typed fleet; ``wrap`` wraps the named replica's executor
+    (the chaos drills' injection seam)."""
+    fleet = ServingFleet(
+        disagg=DisaggConfig(**kw), handoff_sleep=lambda s: None
+    )
+    for i in range(n_prefill):
+        name = f"pf-{i}"
+        fleet.add_replica(
+            name,
+            _engine(slots=2, wrap=wrap if name == wrap_name else None),
+            step=1,
+            role=ROLE_PREFILL,
+        )
+    for i in range(n_decode):
+        name = f"dc-{i}"
+        fleet.add_replica(
+            name,
+            _engine(slots=decode_slots, wrap=wrap if name == wrap_name else None),
+            step=1,
+            role=ROLE_DECODE,
+        )
+    return fleet
+
+
+def _drain_verifying(fleet, max_steps=3000):
+    """Drain with ``verify_consistent`` after EVERY tick (the fuzz
+    discipline: no mutation may leave the paged ledgers inconsistent,
+    even transiently)."""
+    steps = 0
+    while fleet.has_work:
+        assert steps < max_steps, "fleet failed to drain"
+        fleet.tick()
+        steps += 1
+        for rep in fleet.replicas.values():
+            if rep.state != REPLICA_DOWN:
+                rep.engine.paged.verify_consistent()
+
+
+# -- token identity: disagg vs fused ---------------------------------------------
+
+
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+@pytest.mark.parametrize("kernel", list(_kernels()))
+def test_disagg_token_identical_to_generate(kv_quant, kernel):
+    """The disaggregated path (prefill pool -> KV handoff -> decode pool)
+    is token-identical to solo ``generate`` across bf16/int8-KV and both
+    decode kernels, with the prefill pool decoding NOTHING (ISSUE 20
+    acceptance)."""
+    prompts = _prompts()
+    fleet = ServingFleet(disagg=DisaggConfig(), handoff_sleep=lambda s: None)
+    for i in range(2):
+        fleet.add_replica(
+            f"pf-{i}", _engine(2, kv_quant, kernel), step=1, role=ROLE_PREFILL
+        )
+        fleet.add_replica(
+            f"dc-{i}", _engine(3, kv_quant, kernel), step=1, role=ROLE_DECODE
+        )
+    reqs = [fleet.submit(p, T) for p in prompts]
+    fleet.run_until_drained(max_steps=3000)
+    for rep in fleet.replicas.values():
+        rep.engine.paged.verify_consistent()
+    assert fleet.handoffs_completed == len(prompts)
+    assert fleet.disagg_fallbacks == 0
+    cfg = _cfg_for(kernel)
+    for i, req in enumerate(reqs):
+        assert req.state == RequestState.FINISHED, (i, req.state, req.cause)
+        solo = np.asarray(
+            generate(
+                PARAMS, jnp.asarray(prompts[i][None]), cfg,
+                max_new_tokens=T, max_len=S + T,
+                kv_quant=kv_quant, decode_kernel=kernel,
+            )
+        )[0]
+        np.testing.assert_array_equal(
+            np.asarray(req.output_tokens), solo, err_msg=f"req {i}"
+        )
+    # role separation: every retirement happened on the decode pool
+    for name, rep in fleet.replicas.items():
+        if rep.role == ROLE_PREFILL:
+            assert not rep.engine.retired, f"{name} decoded"
+
+
+def _fused_expect(prompts):
+    """Fused-engine baseline tokens the disagg/chaos paths must match."""
+    eng = _engine(slots=4)
+    reqs = [eng.submit(p, T) for p in prompts]
+    eng.run_until_drained(max_steps=3000)
+    return [list(r.output_tokens) for r in reqs]
+
+
+# -- role plumbing ---------------------------------------------------------------
+
+
+class TestRolePlumbing:
+    def test_unknown_role_rejected(self):
+        fleet = ServingFleet()
+        with pytest.raises(FleetError, match="role"):
+            fleet.add_replica("r", _engine(), step=1, role="gpu")
+
+    def test_fused_replicas_bypass_the_handoff_path(self):
+        fleet = ServingFleet(disagg=DisaggConfig())
+        fleet.add_replica("f-0", _engine(slots=4), step=1, role=ROLE_FUSED)
+        prompts = _prompts(lens=(5, 8))
+        reqs = [fleet.submit(p, T) for p in prompts]
+        fleet.run_until_drained(max_steps=3000)
+        assert all(r.state == RequestState.FINISHED for r in reqs)
+        assert fleet.handoffs_completed == 0 and fleet.disagg_fallbacks == 0
+
+    def test_pool_down_degrades_to_fused_with_cause(self):
+        prompts = _prompts(lens=(5, 8))
+        expect = _fused_expect(prompts)
+        fleet = _disagg_fleet(n_prefill=1, n_decode=1)
+        fleet.kill_replica("pf-0", "replica-lost:test")
+        reqs = [fleet.submit(p, T) for p in prompts]
+        fleet.run_until_drained(max_steps=3000)
+        assert fleet.disagg_fallbacks == len(prompts)
+        assert [e["cause"] for e in fleet.handoff_log] == [
+            "prefill-pool-down"
+        ] * len(prompts)
+        for i, req in enumerate(reqs):
+            assert req.state == RequestState.FINISHED
+            assert list(req.output_tokens) == expect[i]
+            assert any(
+                ev[1] == EV_DISAGG_FALLBACK for ev in req.trace.events
+            ), "degradation missing from the request timeline"
+
+    def test_summary_reports_roles_and_handoffs(self):
+        fleet = _disagg_fleet(n_prefill=1, n_decode=1)
+        fleet.submit(_prompts(lens=(5,))[0], T)
+        fleet.run_until_drained(max_steps=3000)
+        summary = fleet.summary()
+        roles = {n: r["role"] for n, r in summary["replicas"].items()}
+        assert roles == {"pf-0": ROLE_PREFILL, "dc-0": ROLE_DECODE}
+        assert summary["handoffs_completed"] == 1
+        assert summary["disagg_fallbacks"] == 0
+
+
+# -- chaos: the three handoff fault modes ----------------------------------------
+
+
+class TestHandoffChaos:
+    def _drill(self, mode, faulty, prompts, at_step=0, times=1, **kw):
+        metrics = RecordingMetrics()
+        fleet = _disagg_fleet(
+            wrap=lambda ex: FaultyExecutor(ex, mode, at_step=at_step, times=times),
+            wrap_name=faulty,
+            **kw,
+        )
+        fleet._metrics = metrics  # recorded counters for the drill asserts
+        reqs = [fleet.submit(p, T) for p in prompts]
+        _drain_verifying(fleet)
+        return fleet, reqs, metrics
+
+    def test_transient_drop_heals_in_place(self):
+        """'handoff-drop' at the prefill extract: the HandoffPolicy
+        retries in place with backoff — no hop, no kill, no fallback."""
+        prompts = _prompts(seed=9, lens=(5, 8, 6))
+        expect = _fused_expect(prompts)
+        fleet, reqs, metrics = self._drill("handoff-drop", "pf-0", prompts)
+        for i, req in enumerate(reqs):
+            assert req.state == RequestState.FINISHED
+            assert list(req.output_tokens) == expect[i]
+        assert fleet.handoffs_completed == len(prompts)
+        assert fleet.disagg_fallbacks == 0 and not fleet.handoff_log
+        assert metrics.counters.get("serving.handoff_retry", 0) >= 1
+
+    def test_decode_death_mid_handoff_hops_to_next_decode(self):
+        """'kill-mid-handoff' on a decode replica: the peer is killed
+        with the taxonomy cause and the host-held payload installs on the
+        NEXT decode replica — every request finishes."""
+        prompts = _prompts(seed=9, lens=(5, 8, 6))
+        expect = _fused_expect(prompts)
+        fleet, reqs, _ = self._drill("kill-mid-handoff", "dc-0", prompts)
+        assert fleet.replicas["dc-0"].state == REPLICA_DOWN
+        assert (
+            fleet.replicas["dc-0"].down_cause
+            == f"replica-lost:{DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST}"
+        )
+        hop = fleet.handoff_log[0]
+        assert hop["stage"] == "decode" and hop["replica"] == "dc-0"
+        assert hop["cause"] == CAUSE_HANDOFF_PEER_LOST
+        assert hop["decision"] == HandoffAction.NEXT_DECODE
+        assert fleet.handoffs_completed == len(prompts)
+        for i, req in enumerate(reqs):
+            assert req.state == RequestState.FINISHED
+            assert list(req.output_tokens) == expect[i]
+        # the surviving hop rides the landed request's timeline
+        landed = next(r for r in reqs if any(
+            ev[1] == EV_HANDOFF_HOP for ev in r.trace.events
+        ))
+        ev = next(e for e in landed.trace.events if e[1] == EV_HANDOFF_HOP)
+        assert ev[2]["cause"] == CAUSE_HANDOFF_PEER_LOST
+
+    def test_prefill_death_mid_handoff_reprefills_elsewhere(self):
+        """'kill-mid-handoff' on a prefill replica: its device blocks died
+        with it, so the request re-prefills on the other prefill replica."""
+        prompts = _prompts(seed=9, lens=(5, 8, 6))
+        expect = _fused_expect(prompts)
+        fleet, reqs, _ = self._drill("kill-mid-handoff", "pf-0", prompts)
+        assert fleet.replicas["pf-0"].state == REPLICA_DOWN
+        hop = fleet.handoff_log[0]
+        assert hop["stage"] == "prefill"
+        assert hop["cause"] == CAUSE_HANDOFF_PEER_LOST
+        assert hop["decision"] == HandoffAction.RE_PREFILL
+        for i, req in enumerate(reqs):
+            assert req.state == RequestState.FINISHED
+            assert list(req.output_tokens) == expect[i]
+
+    def test_corruption_exhausts_hops_then_degrades_to_fused(self):
+        """'handoff-corrupt': the receiver's CRC catches the flipped byte
+        on EVERY decode peer (the corruption rides the payload), the hop
+        budget exhausts, and the request DEGRADES to fused serving with
+        the whole journey on the ledger — token-identical, never shed."""
+        prompts = _prompts(seed=9, lens=(5,))
+        expect = _fused_expect(prompts)
+        fleet, reqs, metrics = self._drill(
+            "handoff-corrupt", "dc-0", prompts, max_hops=1
+        )
+        assert fleet.disagg_fallbacks == 1
+        causes = [e["cause"] for e in fleet.handoff_log]
+        assert CAUSE_HANDOFF_CORRUPT in causes
+        assert fleet.handoff_log[-1]["stage"] == "fallback"
+        assert fleet.handoff_log[-1]["cause"] == CAUSE_HANDOFF_EXHAUSTED
+        assert metrics.counters.get("serving.disagg_fallback", 0) == 1
+        req = reqs[0]
+        assert req.state == RequestState.FINISHED
+        assert list(req.output_tokens) == expect[0]
+        fallback_ev = next(
+            e for e in req.trace.events if e[1] == EV_DISAGG_FALLBACK
+        )
+        assert fallback_ev[2]["cause"] == CAUSE_HANDOFF_EXHAUSTED
+        assert fallback_ev[2]["hops"]  # the journey rides the timeline
+
+
+# -- multi-seed fuzz -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+def test_fuzz_kills_mid_handoff_zero_silent_drops(seed):
+    """Randomized mid-handoff chaos: a random replica (either role) dies
+    or corrupts at a random dispatch; the paged ledgers stay consistent
+    after EVERY tick and every submitted request reaches FINISHED with
+    fused-identical tokens — zero silent drops (ISSUE 20 acceptance)."""
+    rng = np.random.default_rng(seed)
+    mode = str(rng.choice(sorted(HANDOFF_FAULT_MODES)))
+    faulty = str(rng.choice(["pf-0", "pf-1", "dc-0", "dc-1"]))
+    if mode == "handoff-corrupt" and faulty.startswith("pf"):
+        faulty = "dc-0"  # corrupt is an install-seam drill by contract
+    at_step = int(rng.integers(0, 3))
+    lens = [int(n) for n in rng.integers(3, S, size=4)]
+    prompts = [
+        rng.integers(1, CFG.vocab_size, size=n).astype(np.int32) for n in lens
+    ]
+    expect = _fused_expect(prompts)
+    fleet = _disagg_fleet(
+        wrap=lambda ex: FaultyExecutor(ex, mode, at_step=at_step, times=1),
+        wrap_name=faulty,
+    )
+    reqs = [fleet.submit(p, T) for p in prompts]
+    _drain_verifying(fleet)
+    assert fleet.handoffs_completed + fleet.disagg_fallbacks == len(prompts)
+    retired_ids = {r.request_id for r in fleet.all_retired()}
+    for i, req in enumerate(reqs):
+        # zero SILENT drops: every request is terminal and accounted.  A
+        # replica death can take down requests it was ALREADY decoding —
+        # those retire FAILED with the honest replica-lost cause (the
+        # standing fleet-death semantics); the request in transit is the
+        # one the handoff protocol keeps alive.
+        assert req.state in (RequestState.FINISHED, RequestState.FAILED), (
+            mode, faulty, at_step, i, req.state, req.cause,
+        )
+        assert req.request_id in retired_ids
+        if req.state == RequestState.FAILED:
+            assert req.cause.startswith("replica-lost:"), (req.cause, mode)
+        else:
+            assert list(req.output_tokens) == expect[i], (mode, faulty, at_step, i)
+    # every fault the drill injected is accounted on the ledger or was
+    # healed by the in-place retry budget — never silently swallowed
+    for entry in fleet.handoff_log:
+        assert entry["cause"] in HANDOFF_FAULT_CAUSES or entry["cause"].endswith(
+            ("-pool-down", "-pool-full")
+        )
+
+
+# -- supervisor role preservation ------------------------------------------------
+
+
+def _role_jobset(name=None, ns=None):
+    """Role-typed JobSet: a 2-replica prefill pool + a 1-replica decode
+    pool, roles declared through the ``NEXUS_REPLICA_ROLE`` container env
+    (the same manifest seam as ``NEXUS_KV_BLOCKS``)."""
+    import uuid
+
+    from tests.test_rollout_chaos import ALGO, FLEET_JS, NS
+    from tpu_nexus.checkpoint.models import (
+        JOB_LABEL_SERVING_FLEET,
+        JOB_TEMPLATE_NAME_KEY,
+        NEXUS_COMPONENT_LABEL,
+    )
+
+    def pool(rj_name, replicas, role):
+        return {
+            "name": rj_name,
+            "replicas": replicas,
+            "template": {
+                "spec": {
+                    "parallelism": 1,
+                    "template": {
+                        "spec": {
+                            "containers": [
+                                {
+                                    "name": "main",
+                                    "env": [
+                                        {"name": "NEXUS_KV_BLOCKS", "value": "64"},
+                                        {"name": "NEXUS_REPLICA_ROLE", "value": role},
+                                    ],
+                                }
+                            ]
+                        }
+                    },
+                }
+            },
+        }
+
+    return {
+        "kind": "JobSet",
+        "apiVersion": "jobset.x-k8s.io/v1alpha2",
+        "metadata": {
+            "name": name or FLEET_JS,
+            "namespace": ns or NS,
+            "uid": f"js-{uuid.uuid4()}",
+            "labels": {
+                NEXUS_COMPONENT_LABEL: JOB_LABEL_SERVING_FLEET,
+                JOB_TEMPLATE_NAME_KEY: ALGO,
+            },
+        },
+        "spec": {
+            "replicatedJobs": [
+                pool("prefill", 2, ROLE_PREFILL),
+                pool("decode", 1, ROLE_DECODE),
+            ]
+        },
+        "status": {},
+    }
+
+
+async def _role_fixture():
+    from datetime import timedelta
+
+    from tests.test_rollout_chaos import ALGO, FLEET_JS, NS, _Fixture
+    from tpu_nexus.checkpoint.store import InMemoryCheckpointStore
+    from tpu_nexus.core.signals import LifecycleContext
+    from tpu_nexus.k8s.fake import FakeKubeClient
+    from tpu_nexus.serving import FleetSupervisor
+
+    client = FakeKubeClient(jobset_controller=True, emit_pod_events=True)
+    client.inject("ADDED", "JobSet", _role_jobset())
+    store = InMemoryCheckpointStore()
+    fleet = ServingFleet(disagg=DisaggConfig(), handoff_sleep=lambda s: None)
+    made = []
+
+    def factory(name, step, kv_blocks):
+        made.append((name, step, kv_blocks))
+        # real paged engines: the handoff surface (extract/install/leaf
+        # specs) is the executor contract under test
+        return _engine(slots=2)
+
+    sup = FleetSupervisor(
+        client, store, NS, fleet, FLEET_JS, ALGO, factory,
+        grace_s=30.0, kv_blocks=64, resync_period=timedelta(0),
+    )
+    ctx = LifecycleContext()
+    sup._factory.start(ctx)
+    assert await sup._factory.wait_for_cache_sync(timeout=10.0)
+    await sup.adopt_pods(step=1)
+    return _Fixture(client, store, fleet, sup, ctx, made)
+
+
+class TestSupervisorRoles:
+    async def test_adoption_reads_roles_from_pod_env(self):
+        from tests.test_rollout_chaos import FLEET_JS
+
+        fx = await _role_fixture()
+        try:
+            roles = {n: r.role for n, r in fx.fleet.replicas.items()}
+            assert roles == {
+                f"{FLEET_JS}-prefill-0-0": ROLE_PREFILL,
+                f"{FLEET_JS}-prefill-1-0": ROLE_PREFILL,
+                f"{FLEET_JS}-decode-0-0": ROLE_DECODE,
+            }
+        finally:
+            await fx.close()
+
+    async def test_segfaulting_prefill_pod_recreated_as_prefill(self):
+        """The tentpole recovery claim: a segfaulting prefill pod is
+        recreated AS a prefill pod — the pool never silently shrinks to
+        zero while decode replicas idle — and the replacement manifest
+        carries the preserved ``NEXUS_REPLICA_ROLE`` env."""
+        from tests.test_rollout_chaos import FLEET_JS, NS, _settle
+
+        fx = await _role_fixture()
+        try:
+            pod = f"{FLEET_JS}-prefill-0-0"
+            fx.client.fail_pod(NS, pod, message="segfault", crash_loop=True)
+            await _settle()
+            await fx.sup.reconcile()
+            assert fx.sup.recreated == 1
+            rep = fx.fleet.replicas[pod]
+            assert rep.state == "serving" and rep.role == ROLE_PREFILL
+            manifest = fx.client._objects["Pod"][(NS, pod)]
+            env = manifest["spec"]["containers"][0]["env"]
+            assert {"name": "NEXUS_REPLICA_ROLE", "value": ROLE_PREFILL} in env
+            # the incident record names the preserved role
+            assert fx.sup.incidents[-1]["role"] == ROLE_PREFILL
+            # the recovered pool serves disaggregated traffic again
+            reqs = [fx.fleet.submit(np.array([1, 2, i + 3]), 3) for i in range(2)]
+            fx.fleet.run_until_drained()
+            assert all(r.state == RequestState.FINISHED for r in reqs)
+            assert fx.fleet.handoffs_completed == 2
+        finally:
+            await fx.close()
+
+    async def test_scale_down_never_drains_a_roles_last_replica(self):
+        from tests.test_rollout_chaos import FLEET_JS
+
+        fx = await _role_fixture()
+        try:
+            sup, fleet = fx.sup, fx.fleet
+            snapshot = fleet.snapshot()
+            await sup._scale_down(1.0, "healthy", snapshot)
+            assert sup.scaled_down == 1
+            # the decode pool's LAST replica survived; one prefill drained
+            assert f"{FLEET_JS}-decode-0-0" in fleet.replicas
+            roles = [r.role for r in fleet.replicas.values()]
+            assert roles.count(ROLE_PREFILL) == 1
+            # every surviving role is now at its floor: no further drain
+            await sup._scale_down(2.0, "healthy", fleet.snapshot())
+            assert sup.scaled_down == 1
+        finally:
+            await fx.close()

@@ -2651,3 +2651,141 @@ def test_nx018_out_of_scope_doc_rows_not_judged_stale(tmp_path):
         "| `NEXUS_GATE_MODEL` | str | `tools/int8_gate_1b.py` | gate preset |\n"
     )
     assert _lint_nx018(_env_project(tmp_path, rows, _READ_SRC)) == []
+
+
+# -- NX022 handoff decision totality --------------------------------------------
+
+HANDOFF_OK = """
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_FUSED = "fused"
+REPLICA_ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_FUSED)
+
+CAUSE_DROP = "handoff-drop"
+CAUSE_CORRUPT = "handoff-corrupt"
+HANDOFF_FAULT_CAUSES = (CAUSE_DROP, CAUSE_CORRUPT)
+
+HANDOFF_DECISIONS = {
+    ROLE_PREFILL: {CAUSE_DROP: "retry-transfer", CAUSE_CORRUPT: "re-prefill"},
+    ROLE_DECODE: {CAUSE_DROP: "retry-transfer", CAUSE_CORRUPT: "next-decode-replica"},
+    ROLE_FUSED: {CAUSE_DROP: "fused-fallback", CAUSE_CORRUPT: "fused-fallback"},
+}
+
+HANDOFF_CAUSE_ACTIONS = {
+    CAUSE_DROP: "ToFailKvHandoffAbort",
+    CAUSE_CORRUPT: "ToFailKvHandoffAbort",
+}
+"""
+
+HANDOFF_REL = "tpu_nexus/serving/handoff.py"
+
+
+def _lint_nx022(handoff_src=HANDOFF_OK):
+    return lint_source(handoff_src, "NX022", rel_path=HANDOFF_REL)
+
+
+def test_nx022_clean_when_tables_total():
+    assert _lint_nx022() == []
+
+
+def test_nx022_flags_missing_cause_in_a_role_row():
+    src = HANDOFF_OK.replace(''', CAUSE_CORRUPT: "next-decode-replica"''', "")
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "HANDOFF_DECISIONS['decode']" in findings[0].message
+    assert "'handoff-corrupt'" in findings[0].message
+    assert "re-placement decision" in findings[0].message
+
+
+def test_nx022_flags_missing_role_row():
+    src = HANDOFF_OK.replace(
+        '''    ROLE_FUSED: {CAUSE_DROP: "fused-fallback", CAUSE_CORRUPT: "fused-fallback"},\n''',
+        "",
+    )
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "missing replica role 'fused'" in findings[0].message
+
+
+def test_nx022_flags_unknown_role_and_cause():
+    src = HANDOFF_OK.replace(
+        '''    ROLE_FUSED: {CAUSE_DROP: "fused-fallback", CAUSE_CORRUPT: "fused-fallback"},''',
+        '''    ROLE_FUSED: {CAUSE_DROP: "fused-fallback", CAUSE_CORRUPT: "fused-fallback"},
+    "gpu": {CAUSE_DROP: "x", CAUSE_CORRUPT: "y"},''',
+    )
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "unknown replica role 'gpu'" in findings[0].message
+    src = HANDOFF_OK.replace(
+        '''    CAUSE_CORRUPT: "ToFailKvHandoffAbort",''',
+        '''    CAUSE_CORRUPT: "ToFailKvHandoffAbort",\n    "melted": "ToFailFatalError",''',
+    )
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "unknown handoff fault cause 'melted'" in findings[0].message
+
+
+def test_nx022_flags_flat_table_missing_cause():
+    src = HANDOFF_OK.replace('''    CAUSE_CORRUPT: "ToFailKvHandoffAbort",\n''', "")
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "HANDOFF_CAUSE_ACTIONS" in findings[0].message
+    assert "classify to a taxonomy action" in findings[0].message
+
+
+def test_nx022_fails_closed_on_unresolvable_key():
+    src = HANDOFF_OK.replace("    ROLE_PREFILL: {CAUSE_DROP", "    MYSTERY: {CAUSE_DROP")
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "fails closed" in findings[0].message
+
+
+def test_nx022_fails_closed_on_missing_roles_tuple():
+    src = HANDOFF_OK.replace("REPLICA_ROLES = (", "OTHER_ROLES = (")
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "REPLICA_ROLES" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx022_fails_closed_on_non_dict_inner():
+    src = HANDOFF_OK.replace(
+        '''    ROLE_FUSED: {CAUSE_DROP: "fused-fallback", CAUSE_CORRUPT: "fused-fallback"},''',
+        "    ROLE_FUSED: build_fused_row(),",
+    )
+    findings = _lint_nx022(src)
+    assert len(findings) == 1
+    assert "HANDOFF_DECISIONS['fused'] is not a dict literal" in findings[0].message
+
+
+def test_nx022_fails_closed_without_handoff_module():
+    # serving tree present (engine.py) but handoff.py gone: the decision
+    # surface is unverifiable — a finding, anchored where the tree is
+    findings = lint_source(
+        "x = 1", "NX022", rel_path="tpu_nexus/serving/engine.py"
+    )
+    assert len(findings) == 1
+    assert "handoff.py missing" in findings[0].message
+    assert "fails closed" in findings[0].message
+
+
+def test_nx022_silent_outside_the_serving_tree():
+    # linting the tools subtree alone must not false-positive
+    assert lint_source("x = 1", "NX022", rel_path="tools/nxlint/engine.py") == []
+
+
+def test_nx022_fails_closed_on_unparseable_handoff():
+    findings = [f for f in _lint_nx022("def (broken") if f.rule_id == "NX022"]
+    assert len(findings) == 1
+    assert "unparseable" in findings[0].message
+
+
+def test_nx022_repo_is_clean():
+    """The shipped handoff tables pass their own rule (repo gate covers
+    it; pinned so a drift failure names the rule)."""
+    findings = lint_paths(
+        [os.path.join(REPO_ROOT, "tpu_nexus")],
+        root=REPO_ROOT,
+        rules=[r for r in all_rules() if r.rule_id == "NX022"],
+    )
+    assert findings == []

@@ -41,6 +41,7 @@ REQUIRED = [
     "tpu_nexus/serving/cache_manager.py",       # paged KV: blocks/prefix/COW
     "tpu_nexus/serving/engine.py",              # paged + contiguous executors
     "tpu_nexus/serving/fleet.py",               # fleet controller + rolling updates
+    "tpu_nexus/serving/handoff.py",             # disaggregated KV handoff protocol
     "tpu_nexus/serving/loadstats.py",           # pressure plane: snapshots + SLO monitor
     "tpu_nexus/serving/overlap.py",             # deferred-dispatch ledgers
     "tpu_nexus/serving/recovery.py",

@@ -30,6 +30,7 @@ from tools.nxlint import rules_donation  # noqa: F401
 from tools.nxlint import rules_durability  # noqa: F401
 from tools.nxlint import rules_envdocs  # noqa: F401
 from tools.nxlint import rules_faults  # noqa: F401
+from tools.nxlint import rules_handoff  # noqa: F401
 from tools.nxlint import rules_pressure  # noqa: F401
 from tools.nxlint import rules_serving  # noqa: F401
 from tools.nxlint import rules_telemetry  # noqa: F401

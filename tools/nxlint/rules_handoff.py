@@ -1,0 +1,250 @@
+"""Disaggregated-serving handoff rules (ISSUE 20): the role/decision
+contracts behind ``serving/handoff.py``.
+
+NX022  handoff decision totality: the KV-handoff decision tables in
+       ``tpu_nexus/serving/handoff.py`` must be TOTAL over the declared
+       role and fault-cause spaces — the NX001/NX021 taxonomy pattern
+       carried into the disaggregation layer:
+
+       (a) ``HANDOFF_DECISIONS`` (nested ``{role: {cause: action}}``)
+       must have an outer key for EVERY member of ``REPLICA_ROLES`` and,
+       under each role, an inner key for EVERY member of
+       ``HANDOFF_FAULT_CAUSES`` — a new replica role or transfer-fault
+       cause without a declared re-placement decision is a static-
+       analysis error, not a midnight KeyError halfway through a KV
+       handoff;
+
+       (b) ``HANDOFF_CAUSE_ACTIONS`` (``{cause: DecisionAction}``) must
+       be total over ``HANDOFF_FAULT_CAUSES`` the same way, so every
+       transfer fault classifies to a taxonomy action the supervisor's
+       ``SERVING_POD_RECOVERY`` table already covers (NX001 holds the
+       other end).
+
+       Keys resolve against the module's string constants or spell the
+       strings literally.  Fails CLOSED: a missing or unparseable
+       ``handoff.py``, a missing/unresolvable roles or causes tuple, or
+       a table that is not a dict literal each yield a finding — an
+       unverifiable decision surface is treated as a broken one.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, Optional, Set
+
+from tools.nxlint.engine import Finding, Module, Project, Rule, register
+from tools.nxlint.rules_pressure import (
+    _module_assignment,
+    _module_string_constants,
+    _resolve_key,
+)
+
+HANDOFF_PATH = "tpu_nexus/serving/handoff.py"
+ROLES_NAME = "REPLICA_ROLES"
+CAUSES_NAME = "HANDOFF_FAULT_CAUSES"
+
+#: the decision tables NX022 governs.  ``nested`` marks the role×cause
+#: table; flat tables are total over the causes tuple alone.  A new
+#: role- or cause-keyed table in handoff.py belongs in this tuple (the
+#: repo-clean gate's review is the backstop, as with NX015/NX021).
+HANDOFF_TABLES = (
+    ("HANDOFF_DECISIONS", True),
+    ("HANDOFF_CAUSE_ACTIONS", False),
+)
+
+
+def resolved_tuple(
+    tree: ast.Module, name: str, constants: Dict[str, str]
+) -> Optional[Set[str]]:
+    """The declared string space of one module-level tuple; None when the
+    tuple is missing or any element fails to resolve (fails closed)."""
+    value = _module_assignment(tree, name)
+    if not isinstance(value, (ast.Tuple, ast.List)):
+        return None
+    out: Set[str] = set()
+    for element in value.elts:
+        resolved = _resolve_key(element, constants)
+        if resolved is None:
+            return None
+        out.add(resolved)
+    return out or None
+
+
+@register
+class HandoffContractRule(Rule):
+    """NX022 (module doc): handoff decision tables total over
+    REPLICA_ROLES x HANDOFF_FAULT_CAUSES."""
+
+    rule_id = "NX022"
+    description = (
+        "KV-handoff decision tables (HANDOFF_DECISIONS/"
+        "HANDOFF_CAUSE_ACTIONS) total over REPLICA_ROLES x "
+        "HANDOFF_FAULT_CAUSES"
+    )
+
+    def check_project(self, project: Project) -> Iterator[Finding]:
+        module = project.find_module(HANDOFF_PATH)
+        if module is None:
+            anchor = project.find_module("tpu_nexus/serving/engine.py")
+            if anchor is None:
+                return  # project doesn't contain the serving tree (tools subtree)
+            yield self.finding(
+                anchor,
+                anchor.tree or ast.Module(body=[], type_ignores=[]),
+                f"{HANDOFF_PATH} missing — the disaggregated-serving "
+                "handoff decision tables are unverifiable (rule fails "
+                "closed; restore the module or update HANDOFF_PATH)",
+            )
+            return
+        if module.tree is None:
+            yield self.finding(
+                module,
+                ast.Module(body=[], type_ignores=[]),
+                f"{HANDOFF_PATH} unparseable — handoff decision totality "
+                "unverifiable (rule fails closed)",
+            )
+            return
+        constants = _module_string_constants(module.tree)
+        roles = resolved_tuple(module.tree, ROLES_NAME, constants)
+        causes = resolved_tuple(module.tree, CAUSES_NAME, constants)
+        for name, space in ((ROLES_NAME, roles), (CAUSES_NAME, causes)):
+            if space is None:
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"{name} tuple of resolvable string constants not "
+                    f"found in {module.rel_path} — handoff decision "
+                    "totality unverifiable (rule fails closed)",
+                )
+        if roles is None or causes is None:
+            return
+        for table_name, nested in HANDOFF_TABLES:
+            value = _module_assignment(module.tree, table_name)
+            if not isinstance(value, ast.Dict):
+                yield self.finding(
+                    module,
+                    module.tree,
+                    f"decision table {table_name} missing from "
+                    f"{module.rel_path} (or not a dict literal) — handoff "
+                    "decision totality unverifiable (rule fails closed)",
+                )
+                continue
+            if nested:
+                yield from self._check_nested(module, table_name, value, roles, causes, constants)
+            else:
+                yield from self._check_flat(module, table_name, value, causes, constants)
+
+    def _resolve_keys(
+        self, keys, constants: Dict[str, str]
+    ) -> Optional[Set[str]]:
+        out: Set[str] = set()
+        for key in keys:
+            resolved = _resolve_key(key, constants) if key is not None else None
+            if resolved is None:
+                return None
+            out.add(resolved)
+        return out
+
+    def _check_flat(
+        self,
+        module: Module,
+        table_name: str,
+        value: ast.Dict,
+        causes: Set[str],
+        constants: Dict[str, str],
+    ) -> Iterator[Finding]:
+        keys = self._resolve_keys(value.keys, constants)
+        if keys is None:
+            yield self.finding(
+                module,
+                value,
+                f"decision table {table_name} has a key that is neither a "
+                "string literal nor a resolvable constant — totality "
+                "unverifiable (rule fails closed)",
+            )
+            return
+        for missing in sorted(causes - keys):
+            yield self.finding(
+                module,
+                value,
+                f"{table_name} missing handoff fault cause '{missing}' — "
+                "every transfer fault must classify to a taxonomy action",
+            )
+        for extra in sorted(keys - causes):
+            yield self.finding(
+                module,
+                value,
+                f"{table_name} declares unknown handoff fault cause "
+                f"'{extra}' — not a member of {CAUSES_NAME}",
+            )
+
+    def _check_nested(
+        self,
+        module: Module,
+        table_name: str,
+        value: ast.Dict,
+        roles: Set[str],
+        causes: Set[str],
+        constants: Dict[str, str],
+    ) -> Iterator[Finding]:
+        outer = self._resolve_keys(value.keys, constants)
+        if outer is None:
+            yield self.finding(
+                module,
+                value,
+                f"decision table {table_name} has a role key that is "
+                "neither a string literal nor a resolvable constant — "
+                "totality unverifiable (rule fails closed)",
+            )
+            return
+        for missing in sorted(roles - outer):
+            yield self.finding(
+                module,
+                value,
+                f"{table_name} missing replica role '{missing}' — every "
+                "role must declare its per-cause handoff decisions",
+            )
+        for extra in sorted(outer - roles):
+            yield self.finding(
+                module,
+                value,
+                f"{table_name} declares unknown replica role '{extra}' — "
+                f"not a member of {ROLES_NAME}",
+            )
+        for key_node, inner_value in zip(value.keys, value.values):
+            role = _resolve_key(key_node, constants) if key_node is not None else None
+            if role is None or role not in roles:
+                continue  # already reported above
+            if not isinstance(inner_value, ast.Dict):
+                yield self.finding(
+                    module,
+                    inner_value,
+                    f"{table_name}['{role}'] is not a dict literal — "
+                    "per-cause totality unverifiable (rule fails closed)",
+                )
+                continue
+            inner = self._resolve_keys(inner_value.keys, constants)
+            if inner is None:
+                yield self.finding(
+                    module,
+                    inner_value,
+                    f"{table_name}['{role}'] has a cause key that is "
+                    "neither a string literal nor a resolvable constant — "
+                    "totality unverifiable (rule fails closed)",
+                )
+                continue
+            for missing in sorted(causes - inner):
+                yield self.finding(
+                    module,
+                    inner_value,
+                    f"{table_name}['{role}'] missing handoff fault cause "
+                    f"'{missing}' — every role x cause pair must declare "
+                    "its re-placement decision",
+                )
+            for extra in sorted(inner - causes):
+                yield self.finding(
+                    module,
+                    inner_value,
+                    f"{table_name}['{role}'] declares unknown handoff "
+                    f"fault cause '{extra}' — not a member of {CAUSES_NAME}",
+                )

@@ -319,6 +319,11 @@ METRIC_NAMES: Dict[str, tuple] = {
     # -- fleet router (tpu_nexus/serving/router.py, ISSUE 19) ------------------
     "serving.router_retry": ("count", "per-replica admission refusals the router retried on the next-best replica, tagged replica:/cause:"),
     "serving.fleet_shed": ("count", "requests every eligible replica refused (fleet-wide exhaustion; per-replica causes ride the QueueFull message)"),
+    # -- disaggregated prefill/decode (tpu_nexus/serving/handoff.py, ISSUE 20) -
+    "serving.handoff_complete": ("count", "prefill->decode KV handoffs that installed and admitted successfully"),
+    "serving.handoff_retry": ("count", "in-place transient transfer retries spent (bounded by NEXUS_DISAGG_TRANSFER_RETRIES)"),
+    "serving.handoff_hop": ("count", "fault-driven handoff re-placements (re-prefill / next decode replica), tagged stage:/cause:/decision:"),
+    "serving.disagg_fallback": ("count", "disaggregated requests degraded to fused serving instead of shed, tagged cause:"),
     # -- pressure plane (tpu_nexus/serving/loadstats.py, ISSUE 15) -------------
     # load.<field> rows mirror LoadSnapshot's numeric fields 1:1 and
     # fleet.load.<field> rows FleetSnapshot's — nxlint NX016 enforces the

@@ -32,6 +32,11 @@ Layering (each module imports only downward):
 * ``speculative``    — drafting subsystem (ISSUE 11): Drafter interface,
                        prompt-lookup ngram + draft-model drafters, the
                        verify-k acceptance oracle (greedy token-identity)
+* ``handoff``        — disaggregated prefill/decode KV handoff (ISSUE 20):
+                       replica roles, the sealed checksum-validated
+                       KVHandoffPayload transfer protocol, bounded
+                       transient-retry policy, and the NX022-total
+                       HANDOFF_DECISIONS role x cause tables
 * ``recovery``       — taxonomy-classified step-fault retry/retire policy
 * ``tracing``        — observability layer (ISSUE 14): per-request span
                        timelines, the engine flight recorder (ring of
@@ -83,6 +88,27 @@ from tpu_nexus.serving.fleet import (
     FleetError,
     FleetSupervisor,
     ServingFleet,
+)
+from tpu_nexus.serving.handoff import (
+    HANDOFF_CAUSE_ACTIONS,
+    HANDOFF_DECISIONS,
+    HANDOFF_FAULT_CAUSES,
+    REPLICA_ROLES,
+    ROLE_DECODE,
+    ROLE_FUSED,
+    ROLE_PREFILL,
+    DisaggConfig,
+    HandoffAction,
+    HandoffError,
+    HandoffExhausted,
+    HandoffPolicy,
+    KVHandoffPayload,
+    PayloadCorrupt,
+    PeerLost,
+    TransferDropped,
+    handoff_cause_action,
+    handoff_decision,
+    validate_payload,
 )
 from tpu_nexus.serving.loadstats import (
     PRESSURE_ACTIONS,
@@ -162,9 +188,18 @@ __all__ = [
     "DeviceProfiler",
     "DeviceStateLost",
     "AutoscaleConfig",
+    "DisaggConfig",
     "DispatchPipeline",
     "Drafter",
     "ELIGIBILITY_RANK",
+    "HANDOFF_CAUSE_ACTIONS",
+    "HANDOFF_DECISIONS",
+    "HANDOFF_FAULT_CAUSES",
+    "HandoffAction",
+    "HandoffError",
+    "HandoffExhausted",
+    "HandoffPolicy",
+    "KVHandoffPayload",
     "EngineReplica",
     "EngineTracer",
     "FifoScheduler",
@@ -190,11 +225,17 @@ __all__ = [
     "PRESSURE_STATES",
     "PagedCacheManager",
     "PagedModelExecutor",
+    "PayloadCorrupt",
+    "PeerLost",
     "PendingStep",
     "PipelineError",
     "PrefixIndex",
     "QueueFull",
+    "REPLICA_ROLES",
     "RETIREMENT_ACTIONS",
+    "ROLE_DECODE",
+    "ROLE_FUSED",
+    "ROLE_PREFILL",
     "ROUTE_ELIGIBILITY",
     "ROUTER_POLICIES",
     "ROUTER_PRESSURE",
@@ -223,15 +264,19 @@ __all__ = [
     "StepFaultPolicy",
     "TERMINAL_STATES",
     "TRANSITIONS",
+    "TransferDropped",
     "accept_tokens",
     "build_serve_mesh",
     "emit_fleet_snapshot",
     "emit_load_snapshot",
+    "handoff_cause_action",
+    "handoff_decision",
     "init_cache",
     "init_paged_cache",
     "load_score",
     "parse_serve_mesh",
     "percentile",
+    "validate_payload",
     "worst_pressure",
     "serving_param_shardings",
     "shard_serving_params",

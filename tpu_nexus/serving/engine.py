@@ -82,12 +82,19 @@ from tpu_nexus.serving.request import (
     Request,
     RequestState,
 )
+from tpu_nexus.serving.handoff import (
+    HandoffError,
+    KVHandoffPayload,
+    PayloadCorrupt,
+    validate_payload,
+)
 from tpu_nexus.serving.scheduler import FifoScheduler, QueueFull, SchedulerConfig
 from tpu_nexus.serving.speculative import accept_tokens
 from tpu_nexus.serving.tracing import (
     EV_ADMITTED,
     EV_DECODE_DISPATCH,
     EV_FAULT,
+    EV_HANDOFF_INSTALL,
     EV_MATERIALIZE,
     EV_PREFILL_COMPLETE,
     EV_PREFILL_DISPATCH,
@@ -731,6 +738,32 @@ class PagedModelExecutor(_ExecutorCommon):
             out=("cache",), params_arg=None, cache_arg=0,
         )
 
+        def _extract(cache, idx):
+            # KV handoff gather (ISSUE 20): the request's physical blocks,
+            # block-table order — same whole-block addressing as _cow.  The
+            # cache is NOT donated: the prefill replica's pool must survive
+            # the read (the request may be re-extracted after a dropped
+            # transfer).
+            return {name: arr[:, idx] for name, arr in cache.items()}
+
+        self._extract = self._make_jit(
+            _extract, nargs=2, out=("cache",), params_arg=None, cache_arg=0,
+        )
+
+        def _install(cache, blocks, idx):
+            # KV handoff scatter: whole handed-off blocks land at the
+            # receiver's freshly-allocated physical ids (the _cow write
+            # mechanics, sourced from the payload instead of a peer block)
+            return {
+                name: arr.at[:, idx].set(blocks[name])
+                for name, arr in cache.items()
+            }
+
+        self._install = self._make_jit(
+            _install, donate=(0,) if self._donate else (), nargs=3,
+            out=("cache",), params_arg=None, cache_arg=0,
+        )
+
     def _fresh_cache(self):
         return init_paged_cache(
             self.cfg, self.num_blocks, self.page_size, self.kv_quant
@@ -862,6 +895,77 @@ class PagedModelExecutor(_ExecutorCommon):
         except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
             self._guard_cache(exc)
         return np.asarray(greedy)
+
+    # -- KV handoff (ISSUE 20, serving/handoff.py) -----------------------------
+
+    def kv_leaf_specs(self) -> Dict[str, Tuple[Tuple[int, ...], Any]]:
+        """Per-BLOCK slice geometry of this executor's cache, the receiver
+        side of :func:`~tpu_nexus.serving.handoff.validate_payload`: leaf
+        name -> ``((layers, page_size, *trailing), dtype)``."""
+        return {
+            name: (
+                (int(arr.shape[0]), int(arr.shape[2]), *map(int, arr.shape[3:])),
+                arr.dtype,
+            )
+            for name, arr in self.cache.items()
+        }
+
+    def extract_blocks(self, block_ids: Sequence[int]) -> Dict[str, np.ndarray]:
+        """Gather the physical blocks of one prefilled request to HOST, in
+        block-table order — the sender half of a KV handoff.  The id vector
+        is padded to a bucketed width with the scratch block (bounds the
+        retrace count exactly like the prefill buckets) and the pad blocks
+        are sliced back off before returning."""
+        jnp = self._jax.numpy
+        ids = np.asarray(block_ids, np.int32).reshape(-1)
+        n = int(ids.shape[0])
+        if n < 1:
+            raise ValueError("extract_blocks requires at least one block id")
+        width = self._bucket(n)
+        padded = np.full(width, SCRATCH_BLOCK, np.int32)
+        padded[:n] = ids
+        try:
+            blocks = self._extract(self.cache, jnp.asarray(padded))
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
+        return {name: np.asarray(arr)[:, :n] for name, arr in blocks.items()}
+
+    def install_blocks(
+        self, payload: "KVHandoffPayload", block_ids: Sequence[int]
+    ) -> None:
+        """Scatter a handed-off payload's blocks into freshly-allocated
+        physical ids — the receiver half of a KV handoff.  VALIDATES first
+        (per-block shape/dtype/count against THIS executor's geometry, then
+        the sealed CRCs): a corrupted payload raises
+        :class:`~tpu_nexus.serving.handoff.PayloadCorrupt` before any
+        device write, so bad bytes can never land in the pool.  Pad ids
+        divert to the scratch block (the frozen-row write idiom)."""
+        jnp = self._jax.numpy
+        ids = np.asarray(block_ids, np.int32).reshape(-1)
+        n = int(ids.shape[0])
+        validate_payload(
+            payload, page_size=self.page_size, leaf_specs=self.kv_leaf_specs()
+        )
+        if n != payload.n_blocks:
+            raise PayloadCorrupt(
+                f"kv handoff payload for {payload.request_id}: receiver "
+                f"allocated {n} blocks != payload n_blocks {payload.n_blocks}"
+            )
+        width = self._bucket(n)
+        padded_ids = np.full(width, SCRATCH_BLOCK, np.int32)
+        padded_ids[:n] = ids
+        leaves = {}
+        for name, arr in payload.blocks.items():
+            host = np.asarray(arr)
+            pad = np.zeros((host.shape[0], width, *host.shape[2:]), host.dtype)
+            pad[:, :n] = host
+            leaves[name] = jnp.asarray(pad)
+        try:
+            self.cache = self._install(
+                self.cache, leaves, jnp.asarray(padded_ids)
+            )
+        except RuntimeError as exc:  # noqa: BLE001 - _guard_cache ALWAYS raises: the original (classified downstream) or DeviceStateLost
+            self._guard_cache(exc)
 
 
 class ServingEngine:
@@ -1106,6 +1210,267 @@ class ServingEngine:
         self.scheduler.submit(req)
         self.tracer.begin(req)
         return req
+
+    # -- disaggregated serving (ISSUE 20, serving/handoff.py) ------------------
+
+    def prefill_remote(
+        self,
+        prompt: np.ndarray,
+        request_id: str,
+        *,
+        source_replica: str = "",
+    ) -> "KVHandoffPayload":
+        """PREFILL-role entry point: run the fused prefill+insert jit for
+        ``prompt`` in a TRANSIENT tenancy (slot + blocks sized to the
+        prompt only — no decode budget, no queue, no Request lifecycle),
+        gather the written KV blocks to host, and return them as a sealed
+        :class:`~tpu_nexus.serving.handoff.KVHandoffPayload` for a decode
+        replica to install.  The tenancy is released before returning on
+        EVERY path — success hands the bytes off, failure re-raises for
+        the fleet's handoff decision tables; either way this engine holds
+        nothing for the request afterwards (its prefix index keeps the
+        prompt's full blocks cached, so a re-prefill of a shared prefix
+        here is a block reference, not recompute).
+
+        Sheds with :class:`QueueFull` when draining/paused or out of
+        slot/block capacity — the fleet tries the next prefill replica."""
+        if self.paged is None:
+            raise ValueError(
+                "prefill_remote requires a paged executor (KV handoff is "
+                "block-addressed)"
+            )
+        rid = request_id
+        prompt = np.array(prompt, np.int32).reshape(-1)
+        prompt_len = int(prompt.shape[0])
+        if prompt_len < 1:
+            raise ValueError(f"request {rid}: empty prompt")
+        if not self.slots.fits(prompt_len) or not self.paged.fits(prompt_len):
+            raise ValueError(
+                f"request {rid}: prompt {prompt_len} exceeds this replica's "
+                f"cache geometry (max_len {self.slots.max_len})"
+            )
+        if self.draining:
+            self.metrics.shed("draining")
+            raise QueueFull(f"request {rid} shed: prefill replica is draining")
+        if self.admission_paused:
+            self.metrics.shed("reloading")
+            raise QueueFull(
+                f"request {rid} shed: prefill replica paused for weight reload"
+            )
+        slot = self.slots.allocate(rid)
+        if slot is None:
+            self.metrics.shed("no-slot")
+            raise QueueFull(f"request {rid} shed: no free prefill slot")
+        probe = self.paged.index.lookup(prompt)
+        if not self.paged.can_admit(prompt, prompt_len, probe=probe):
+            self.slots.free(slot)
+            self.metrics.shed("no-blocks")
+            raise QueueFull(
+                f"request {rid} shed: prefill replica lacks free KV blocks"
+            )
+        plan = self.paged.admit(rid, prompt, prompt_len, probe=probe)
+        copies = self.paged.prepare_write(
+            rid,
+            plan.block_row,
+            range(plan.tail_start // self.paged.page_size, plan.n_blocks),
+        )
+        self._tables[slot] = plan.block_row
+        self._pending_stats[rid] = (len(copies), plan.shared_tokens)
+        row = plan.block_row
+        try:
+            first_token = self._dispatch(
+                lambda: self.executor.begin(
+                    slot, prompt,
+                    table_row=row, tail_start=plan.tail_start, copies=copies,
+                )
+            )
+            # cache the prompt for future prefills on THIS replica (only
+            # after success — the _admit discipline), count reuse telemetry
+            self.paged.register_prompt(rid, prompt, self._tables[slot])
+            n_cow, shared = self._pending_stats.pop(rid, (0, 0))
+            if n_cow:
+                self.metrics.blocks_cow(n_cow)
+            if shared:
+                self.metrics.prefix_hit(shared)
+            # gather BEFORE releasing the tenancy: the blocks stay pinned
+            # (and their device content live) until the host copy lands
+            blocks = self.executor.extract_blocks(row[: plan.n_blocks])
+        except DeviceStateLost as lost:
+            self._release_handoff(rid, slot)
+            self._fail_batch(lost)
+            raise
+        except (StepFault, HandoffError):
+            self._release_handoff(rid, slot)
+            raise
+        self._release_handoff(rid, slot)
+        return KVHandoffPayload(
+            request_id=rid,
+            prompt=tuple(int(t) for t in prompt),
+            first_token=int(first_token),
+            page_size=self.paged.page_size,
+            n_blocks=plan.n_blocks,
+            blocks=blocks,
+            source_replica=source_replica,
+        ).seal()
+
+    def admit_prefilled(
+        self,
+        payload: "KVHandoffPayload",
+        max_new_tokens: int,
+        *,
+        stream: Optional[Callable[[Request, int], None]] = None,
+        deadline_s: Optional[float] = None,
+        submitted_at: Optional[float] = None,
+    ) -> Request:
+        """DECODE-role entry point: validate + install a handed-off
+        payload's KV blocks into this replica's pool and take OWNERSHIP of
+        the request (lifecycle, decode, retirement — from here on it is
+        indistinguishable from a locally-prefilled request).  The payload's
+        first token is emitted here, so TTFT spans the whole disaggregated
+        path when the caller threads the ORIGINAL ``submitted_at`` through.
+
+        Failure semantics: a :class:`~tpu_nexus.serving.handoff.
+        HandoffError` (validation reject, injected transfer fault) releases
+        the tenancy and re-raises with nothing admitted — the fleet's
+        decision tables pick the next hop; capacity refusals shed with
+        :class:`QueueFull` exactly like :meth:`submit`."""
+        if self.paged is None:
+            raise ValueError(
+                "admit_prefilled requires a paged executor (KV handoff is "
+                "block-addressed)"
+            )
+        rid = payload.request_id
+        if rid in self.requests:
+            raise ValueError(f"duplicate request id {rid!r}")
+        prompt = np.array(payload.prompt, np.int32)
+        req = Request(
+            request_id=rid,
+            prompt=prompt,
+            max_new_tokens=max_new_tokens,
+            stream=stream,
+            deadline_s=deadline_s,
+            submitted_at=self._clock() if submitted_at is None else submitted_at,
+        )
+        if not self.slots.fits(req.total_len):
+            raise ValueError(
+                f"request {rid}: prompt {req.prompt_len} + max_new_tokens "
+                f"{max_new_tokens} exceeds cache max_len {self.slots.max_len}"
+            )
+        if not self.paged.fits(req.total_len):
+            raise ValueError(
+                f"request {rid}: {self.paged.blocks_needed(req.total_len)} KV "
+                f"blocks needed exceeds the pool's {self.paged.usable_blocks} "
+                "usable blocks — it could never be installed"
+            )
+        if self.draining:
+            self.metrics.shed("draining")
+            raise QueueFull(f"request {rid} shed: decode replica is draining")
+        if self.admission_paused:
+            self.metrics.shed("reloading")
+            raise QueueFull(
+                f"request {rid} shed: decode replica paused for weight reload"
+            )
+        slot = self.slots.allocate(rid)
+        if slot is None:
+            self.metrics.shed("no-slot")
+            raise QueueFull(f"request {rid} shed: no free decode slot")
+        probe = self.paged.index.lookup(prompt)
+        if not self.paged.can_admit(prompt, req.total_len, probe=probe):
+            self.slots.free(slot)
+            self.metrics.shed("no-blocks")
+            raise QueueFull(
+                f"request {rid} shed: decode replica lacks free KV blocks"
+            )
+        plan = self.paged.admit(rid, prompt, req.total_len, probe=probe)
+        # the install overwrites every payload block WHOLESALE, so any
+        # index-shared block in the written span is swapped for a fresh
+        # exclusive one (COW sweep) — but the device-side content copies
+        # are skipped: there is nothing to preserve under a full overwrite
+        self.paged.prepare_write(rid, plan.block_row, range(plan.n_blocks))
+        row = plan.block_row
+        self._tables[slot] = row
+        req.slot = slot
+        req.transition(RequestState.PREFILLING)
+        self.tracer.begin(req)
+        self.tracer.event(
+            req, EV_ADMITTED,
+            {"step": self.steps, "slot": slot, "handoff": True,
+             "source": payload.source_replica},
+        )
+        try:
+            self._dispatch(
+                lambda: self.executor.install_blocks(
+                    payload, row[: payload.n_blocks]
+                )
+            )
+        except DeviceStateLost as lost:
+            self._release_handoff(rid, slot)
+            self._fail_batch(lost)
+            raise
+        except HandoffError as exc:
+            self.tracer.event(
+                req, EV_FAULT,
+                {"cause": exc.cause, "phase": "handoff-install"},
+            )
+            self._release_handoff(rid, slot)
+            raise
+        except StepFault:
+            self._release_handoff(rid, slot)
+            raise
+        self.requests[rid] = req
+        # the payload's blocks now ARE this prompt's KV: cache them for
+        # future admissions here (fused-fallback reuse included)
+        self.paged.register_prompt(rid, prompt, self._tables[slot])
+        self.tracer.event(
+            req, EV_HANDOFF_INSTALL,
+            {"step": self.steps, "n_blocks": payload.n_blocks,
+             "source": payload.source_replica, "hops": list(payload.hops)},
+        )
+        if self.drafter is not None:
+            # drafter parity with _admit: a draft-side failure degrades
+            # this slot to no-draft proposals, never the admission
+            try:
+                self.drafter.begin(slot, req.prompt)
+                self.drafter.observe(slot, [payload.first_token])
+            except (RuntimeError, DeviceStateLost) as exc:  # noqa: BLE001 - drafts are hints: a failed draft prefill degrades that slot to no-draft proposals (counted + logged), the installed admission proceeds untouched
+                logger.warning(
+                    "drafter %s failed to begin slot %d (%s); the "
+                    "request decodes with degraded drafts",
+                    getattr(self.drafter, "name", "?"), slot, exc,
+                )
+                self.metrics.draft_fault()
+        req.emit(payload.first_token, self._clock())
+        self.metrics.first_token(req)
+        if req.done or (
+            self.stop_token is not None
+            and payload.first_token == self.stop_token
+        ):
+            self._retire(req, RequestState.FINISHED)
+            return req
+        req.transition(RequestState.DECODING)
+        self._active[slot] = req
+        self._cursors[slot] = req.prompt_len
+        self._tokens[slot] = req.output_tokens[-1]
+        self._pipeline.note_override(slot)
+        if self.spec_k:
+            self.slots.set_length(slot, req.prompt_len)
+        return req
+
+    def _release_handoff(self, rid: str, slot: int) -> None:
+        """Tear down a handoff tenancy (prefill-side always; decode-side
+        on install failure): free the slot, scrub the table row, drop the
+        block references.  The request was never in ``self.requests`` /
+        ``_active`` at these seams, so there is nothing to retire — the
+        FLEET owns the request's fate and its cause accounting."""
+        if self.slots.owner(slot) == rid:
+            self.slots.free(slot)
+            self._tokens[slot] = 0
+            self._cursors[slot] = 0
+            if self._tables is not None:
+                self._tables[slot] = SCRATCH_BLOCK
+        self._pending_stats.pop(rid, None)
+        if self.paged is not None and self.paged.owns(rid):
+            self.paged.release(rid)
 
     def cancel(self, request_id: str) -> bool:
         """Flag a request for cancellation; honored at the next step

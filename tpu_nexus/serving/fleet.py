@@ -62,12 +62,26 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from tpu_nexus.serving.engine import CAUSE_RELOAD_GRACE, ServingEngine
+from tpu_nexus.serving.handoff import (
+    CAUSE_HANDOFF_EXHAUSTED,
+    CAUSE_HANDOFF_PEER_LOST,
+    ROLE_DECODE,
+    ROLE_FUSED,
+    ROLE_PREFILL,
+    DisaggConfig,
+    HandoffAction,
+    HandoffError,
+    PeerLost,
+    handoff_cause_action,
+    handoff_decision,
+)
 from tpu_nexus.serving.loadstats import (
     FleetSnapshot,
     LoadSnapshot,
     SloMonitor,
     emit_fleet_snapshot,
 )
+from tpu_nexus.serving.recovery import DeviceStateLost, StepFault
 from tpu_nexus.serving.request import Request
 from tpu_nexus.serving.router import (
     ROUTER_PRESSURE,
@@ -78,6 +92,7 @@ from tpu_nexus.serving.router import (
     FleetRouter,
 )
 from tpu_nexus.serving.scheduler import QueueFull
+from tpu_nexus.serving.tracing import EV_DISAGG_FALLBACK, EV_HANDOFF_HOP
 from tpu_nexus.workload.durability import CheckpointError, VerifiedStepPoller
 
 logger = logging.getLogger(__name__)
@@ -116,6 +131,12 @@ class EngineReplica:
     engine: ServingEngine
     deployed_step: Optional[int] = None
     state: str = REPLICA_SERVING
+    #: disaggregation role (ISSUE 20, serving/handoff.py): ``prefill``
+    #: replicas run the fused prefill+insert jit and hand their KV blocks
+    #: off; ``decode`` replicas install handed-off blocks and decode;
+    #: ``fused`` (the default) is the pre-disaggregation engine serving
+    #: both phases — and the degradation target when handoff exhausts
+    role: str = ROLE_FUSED
     down_cause: str = ""
     history: List[Request] = field(default_factory=list)
     history_limit: int = 10_000
@@ -213,11 +234,31 @@ class ServingFleet:
         clock: Callable[[], float] = time.monotonic,
         policy: str = ROUTER_PRESSURE,
         metrics: Optional[Any] = None,
+        disagg: Optional[DisaggConfig] = None,
+        handoff_sleep: Callable[[float], None] = time.sleep,
+        handoff_rng: Optional[Any] = None,
     ) -> None:
+        from tpu_nexus.core.telemetry import NullMetrics
+
         self.replicas: Dict[str, EngineReplica] = {}
         self._clock = clock
         self.router = FleetRouter(self, policy=policy, metrics=metrics)
         self._counter = itertools.count()
+        self._metrics = metrics or NullMetrics()
+        #: disaggregated prefill/decode serving (ISSUE 20): the transfer
+        #: retry/hop budgets.  Always present — a fleet with no role-typed
+        #: replicas simply never reaches the disagg path
+        self.disagg = disagg if disagg is not None else DisaggConfig()
+        #: injectable sleep/rng so chaos drills pay no wall-clock backoff
+        self._handoff_sleep = handoff_sleep
+        self._handoff_rng = handoff_rng
+        #: every handoff hop/degradation, bounded front-trimmed (the
+        #: replica-history discipline): {request_id, stage, replica,
+        #: cause, action} — the fleet-side handoff ledger the drills audit
+        self.handoff_log: List[Dict[str, Any]] = []
+        self._handoff_log_limit = 10_000
+        self.handoffs_completed = 0
+        self.disagg_fallbacks = 0
         #: retirement logs of replicas REMOVED from the fleet (autoscale
         #: scale-down): ``all_retired`` must stay total over every request
         #: the fleet ever accepted, bounded like a replica's own history
@@ -233,11 +274,17 @@ class ServingFleet:
     # -- membership ------------------------------------------------------------
 
     def add_replica(
-        self, name: str, engine: ServingEngine, step: Optional[int] = None
+        self,
+        name: str,
+        engine: ServingEngine,
+        step: Optional[int] = None,
+        role: str = ROLE_FUSED,
     ) -> EngineReplica:
         if name in self.replicas:
             raise FleetError(f"duplicate replica {name!r}")
-        rep = EngineReplica(name=name, engine=engine, deployed_step=step)
+        if role not in (ROLE_PREFILL, ROLE_DECODE, ROLE_FUSED):
+            raise FleetError(f"unknown replica role {role!r} for {name!r}")
+        rep = EngineReplica(name=name, engine=engine, deployed_step=step, role=role)
         self.replicas[name] = rep
         return rep
 
@@ -318,9 +365,247 @@ class ServingFleet:
         rid = request_id if request_id is not None else f"flt-{next(self._counter)}"
         if not self.replicas:
             raise FleetError("fleet has no replicas")
-        req = self.router.submit(prompt, max_new_tokens, rid, deadline_s=deadline_s)
+        if any(rep.role != ROLE_FUSED for rep in self.replicas.values()):
+            req = self._submit_disagg(prompt, max_new_tokens, rid, deadline_s)
+        else:
+            req = self.router.submit(prompt, max_new_tokens, rid, deadline_s=deadline_s)
         self.submitted += 1
         return req
+
+    # -- disaggregated prefill/decode (ISSUE 20, serving/handoff.py) -----------
+
+    def _role_live(self, role: str) -> List[str]:
+        return [
+            name
+            for name, rep in self.replicas.items()
+            if rep.state == REPLICA_SERVING and rep.role == role
+        ]
+
+    def _log_handoff(self, entry: Dict[str, Any]) -> None:
+        self.handoff_log.append(entry)
+        if len(self.handoff_log) > self._handoff_log_limit:
+            del self.handoff_log[: len(self.handoff_log) - self._handoff_log_limit]
+
+    def _count_retries(self, n: int) -> None:
+        if n > 0:
+            self._metrics.count("serving.handoff_retry", n)
+
+    def _record_hop(
+        self,
+        trail: List[Dict[str, Any]],
+        rid: str,
+        stage: str,
+        replica: str,
+        exc: BaseException,
+        payload: Optional[Any] = None,
+    ) -> None:
+        """One fault-driven handoff hop: classify through the TOTAL
+        ``HANDOFF_DECISIONS`` table (nxlint NX022), record it on the fleet
+        handoff ledger + the payload's hop trail + tagged metrics, and —
+        when the peer SIGNALLED death mid-handoff — stop routing to it
+        (the supervisor's recreate path revives it per role).  Step faults
+        and device loss during a handoff dispatch classify as the
+        peer-lost cause: the peer's device state is suspect, the request
+        moves on."""
+        role = ROLE_PREFILL if stage == "prefill" else ROLE_DECODE
+        cause = exc.cause if isinstance(exc, HandoffError) else CAUSE_HANDOFF_PEER_LOST
+        action = handoff_cause_action(cause)
+        entry = {
+            "request_id": rid,
+            "stage": stage,
+            "replica": replica,
+            "cause": cause,
+            "action": action,
+            "decision": handoff_decision(role, cause),
+            "detail": str(exc),
+        }
+        trail.append(entry)
+        self._log_handoff(entry)
+        if payload is not None:
+            payload.hops.append(f"{stage}:{replica}:{cause}")
+        self._metrics.count(
+            "serving.handoff_hop",
+            tags={"stage": stage, "cause": cause, "decision": entry["decision"]},
+        )
+        logger.warning(
+            "kv handoff hop for %s: %s replica %s faulted (%s) -> %s",
+            rid, stage, replica, cause, entry["decision"],
+        )
+        if isinstance(exc, PeerLost):
+            self.kill_replica(replica, f"{CAUSE_REPLICA_LOST}:{action}")
+
+    def _submit_disagg(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        rid: str,
+        deadline_s: Optional[float],
+    ) -> Request:
+        """Disaggregated admission: prefill on the least-loaded PREFILL
+        replica (fused prefill+insert jit, KV blocks extracted into a
+        sealed :class:`KVHandoffPayload`), install on the DECODE replica
+        with the most free blocks, decode there.  Every transfer fault is
+        a recorded decision, never a drop:
+
+        * transient transfer drops retry in place (``HandoffPolicy``,
+          bounded by ``NEXUS_DISAGG_TRANSFER_RETRIES``);
+        * a prefill replica faulting mid-handoff re-prefills on the next
+          prefill replica; a decode replica faulting mid-install retries
+          the next decode replica — both bounded by
+          ``NEXUS_DISAGG_MAX_HOPS``;
+        * hop exhaustion, or a whole pool down/full, DEGRADES the request
+          to fused serving on a decode replica (prefill locally) rather
+          than shedding — ``QueueFull`` only when even that is exhausted.
+        """
+        submitted_at = self._clock()
+        trail: List[Dict[str, Any]] = []
+        policy = self.disagg.policy(sleep=self._handoff_sleep, rng=self._handoff_rng)
+        hops = 0
+
+        def fallback(cause: str) -> Request:
+            return self._fused_fallback(
+                prompt, max_new_tokens, rid, deadline_s, submitted_at, trail, cause
+            )
+
+        if not self._role_live(ROLE_PREFILL):
+            return fallback("prefill-pool-down")
+        if not self._role_live(ROLE_DECODE):
+            return fallback("decode-pool-down")
+
+        # -- prefill stage: load-ranked candidates; faults hop (re-prefill)
+        payload = None
+        for name in self.router.plan(prompt, role=ROLE_PREFILL):
+            rep = self.replicas.get(name)
+            if rep is None or rep.state != REPLICA_SERVING:
+                continue
+            before = policy.retries_used
+            try:
+                payload = policy.run(
+                    lambda _rep=rep, _name=name: _rep.engine.prefill_remote(
+                        prompt, rid, source_replica=_name
+                    )
+                )
+            except QueueFull:  # noqa: BLE001 - capacity refusal, not a fault: the router discipline retries the next prefill candidate; total exhaustion degrades to fused below
+                self._count_retries(policy.retries_used - before)
+                continue
+            except (HandoffError, StepFault, DeviceStateLost) as exc:  # noqa: BLE001 - classified through HANDOFF_DECISIONS via _record_hop (hop recorded on ledger + timeline, PeerLost kills the replica); bounded by max_hops then degrades to fused
+                self._count_retries(policy.retries_used - before)
+                self._record_hop(trail, rid, "prefill", name, exc)
+                hops += 1
+                if hops > self.disagg.max_hops:
+                    return fallback(CAUSE_HANDOFF_EXHAUSTED)
+                continue
+            self._count_retries(policy.retries_used - before)
+            break
+        if payload is None:
+            return fallback(
+                CAUSE_HANDOFF_EXHAUSTED if trail else "prefill-pool-full"
+            )
+
+        # -- decode stage: block-availability-ranked candidates; faults hop
+        for name in self.router.plan(prompt, role=ROLE_DECODE, by_blocks=True):
+            rep = self.replicas.get(name)
+            if rep is None or rep.state != REPLICA_SERVING:
+                continue
+            before = policy.retries_used
+            try:
+                req = policy.run(
+                    lambda _rep=rep: _rep.engine.admit_prefilled(
+                        payload,
+                        max_new_tokens,
+                        deadline_s=deadline_s,
+                        submitted_at=submitted_at,
+                    )
+                )
+            except QueueFull:  # noqa: BLE001 - capacity refusal, not a fault: the next decode candidate is tried; total exhaustion degrades to fused below
+                self._count_retries(policy.retries_used - before)
+                continue
+            except (HandoffError, StepFault, DeviceStateLost) as exc:  # noqa: BLE001 - classified through HANDOFF_DECISIONS via _record_hop (hop recorded on ledger + timeline, PeerLost kills the replica); bounded by max_hops then degrades to fused
+                self._count_retries(policy.retries_used - before)
+                self._record_hop(trail, rid, "decode", name, exc, payload=payload)
+                hops += 1
+                if hops > self.disagg.max_hops:
+                    return fallback(CAUSE_HANDOFF_EXHAUSTED)
+                continue
+            self._count_retries(policy.retries_used - before)
+            self.handoffs_completed += 1
+            self._metrics.count("serving.handoff_complete")
+            # the landed request's timeline shows every hop it survived
+            for entry in trail:
+                rep.engine.tracer.event(req, EV_HANDOFF_HOP, dict(entry))
+            return req
+        return fallback(CAUSE_HANDOFF_EXHAUSTED if trail else "decode-pool-full")
+
+    def _fused_fallback(
+        self,
+        prompt: np.ndarray,
+        max_new_tokens: int,
+        rid: str,
+        deadline_s: Optional[float],
+        submitted_at: Optional[float],
+        trail: List[Dict[str, Any]],
+        cause: str,
+    ) -> Request:
+        """Degrade a disaggregated request to FUSED serving (the landing
+        replica prefills locally) rather than shedding it: decode replicas
+        first (they hold the KV capacity), any serving replica as the
+        keep-alive last resort.  The degradation is recorded with its
+        cause on the request trace timeline, the fleet handoff ledger, and
+        the ``serving.disagg_fallback`` counter; ``QueueFull`` only when
+        every live replica refused."""
+        order = self.router.plan(prompt, role=ROLE_DECODE)
+        if not order:
+            order = self.router.plan(prompt)
+        refusals: List[Tuple[str, str]] = []
+        for name in order:
+            rep = self.replicas.get(name)
+            if rep is None or rep.state != REPLICA_SERVING:
+                continue
+            try:
+                req = rep.engine.submit(
+                    prompt, max_new_tokens, request_id=rid, deadline_s=deadline_s
+                )
+            except QueueFull as exc:  # noqa: BLE001 - refusal recorded and the next replica tried; if ALL refuse the aggregate QueueFull below re-raises with every refusal listed
+                refusals.append((name, str(exc)))
+                continue
+            if submitted_at is not None:
+                # TTFT spans the WHOLE disaggregated attempt, hops included
+                req.submitted_at = submitted_at
+            self.disagg_fallbacks += 1
+            self._metrics.count("serving.disagg_fallback", tags={"cause": cause})
+            entry = {
+                "request_id": rid,
+                "stage": "fallback",
+                "replica": name,
+                "cause": cause,
+                "action": handoff_cause_action(CAUSE_HANDOFF_EXHAUSTED),
+                "decision": HandoffAction.FUSED_FALLBACK,
+            }
+            self._log_handoff(entry)
+            rep.engine.tracer.event(
+                req,
+                EV_DISAGG_FALLBACK,
+                {
+                    "cause": cause,
+                    "replica": name,
+                    "hops": [
+                        f"{e['stage']}:{e['replica']}:{e['cause']}" for e in trail
+                    ],
+                },
+            )
+            for e in trail:
+                rep.engine.tracer.event(req, EV_HANDOFF_HOP, dict(e))
+            logger.warning(
+                "request %s degraded to fused serving on %s (%s)", rid, name, cause
+            )
+            return req
+        self.router.fleet_sheds += 1
+        self._metrics.count("serving.fleet_shed")
+        tried = "; ".join(f"{n}: {c}" for n, c in refusals) or "no live replicas"
+        raise QueueFull(
+            f"request {rid} exhausted kv handoff AND fused fallback "
+            f"({cause}) — refused by: {tried}"
+        )
 
     @property
     def has_work(self) -> bool:
@@ -527,10 +812,17 @@ class ServingFleet:
                 causes[req.cause] = causes.get(req.cause, 0) + 1
         return {
             "replicas": {
-                name: {"state": rep.state, "deployed_step": rep.deployed_step}
+                name: {
+                    "state": rep.state,
+                    "role": rep.role,
+                    "deployed_step": rep.deployed_step,
+                }
                 for name, rep in self.replicas.items()
             },
             "submitted": self.submitted,
+            "handoffs_completed": self.handoffs_completed,
+            "disagg_fallbacks": self.disagg_fallbacks,
+            "handoff_log_entries": len(self.handoff_log),
             "retired_states": states,
             "retired_causes": causes,
             "rollouts_completed": self.rollouts_completed,
@@ -623,6 +915,11 @@ class FleetSupervisor:
         #: per-replica KV block budget (reduced on HBM OOM recreates)
         self._kv_blocks: Dict[str, Optional[int]] = {}
         self._default_kv_blocks = kv_blocks
+        #: per-pod disaggregation role (ISSUE 20), read from the pod
+        #: template's ``NEXUS_REPLICA_ROLE`` env at adoption and PRESERVED
+        #: across recreates — a segfaulting prefill pool recreates as
+        #: prefill, never silently shrinking to zero while decode idles
+        self._roles: Dict[str, str] = {}
         self._uid_counter = itertools.count(1)
         self._row_ensured = False
         self._reconciles = 0
@@ -756,10 +1053,27 @@ class FleetSupervisor:
                 continue
             self._pod_templates[name] = copy.deepcopy(raw)
             self._kv_blocks[name] = self._default_kv_blocks
+            self._roles[name] = self._template_role(raw)
             engine = self.replica_factory(name, step, self._default_kv_blocks)
-            self.fleet.add_replica(name, engine, step)
+            self.fleet.add_replica(name, engine, step, role=self._roles[name])
             adopted.append(name)
         return sorted(adopted)
+
+    @staticmethod
+    def _template_role(manifest: Dict[str, Any]) -> str:
+        """The pod's disaggregation role from its ``NEXUS_REPLICA_ROLE``
+        container env (the same manifest seam as ``NEXUS_KV_BLOCKS``);
+        absent or unrecognized values serve fused — a typo'd role must
+        degrade to the engine that can serve ANY request, not wedge the
+        pod out of both pools."""
+        for container in (manifest.get("spec") or {}).get("containers", []) or []:
+            for entry in container.get("env", []) or []:
+                if entry.get("name") == "NEXUS_REPLICA_ROLE":
+                    value = str(entry.get("value", "")).strip().lower()
+                    if value in (ROLE_PREFILL, ROLE_DECODE, ROLE_FUSED):
+                        return value
+                    return ROLE_FUSED
+        return ROLE_FUSED
 
     # -- the control loop ------------------------------------------------------
 
@@ -956,7 +1270,8 @@ class FleetSupervisor:
         discipline), create it in the cluster, build its engine at the
         newest verified step, and join it to the fleet."""
         name = f"{self.jobset_name}-scale-{next(self._scale_counter)}"
-        template = next(iter(self._pod_templates.values()), None)
+        role = self._scale_role(snapshot)
+        template = self._template_for_role(role)
         if template is None:
             self._log.warning(
                 "autoscale: no pod manifest template to clone; skipping scale-up"
@@ -970,9 +1285,10 @@ class FleetSupervisor:
         await self._client.create_object("Pod", self.namespace, manifest)
         self._pod_templates[name] = copy.deepcopy(manifest)
         self._kv_blocks[name] = self._default_kv_blocks
+        self._roles[name] = role
         step = self._target_step()
         engine = self.replica_factory(name, step, self._default_kv_blocks)
-        self.fleet.add_replica(name, engine, step)
+        self.fleet.add_replica(name, engine, step, role=role)
         self.scaled_up += 1
         self._scale_up_streak = 0
         self._scale_down_streak = 0
@@ -982,6 +1298,7 @@ class FleetSupervisor:
             "decision": SCALE_UP,
             "grade": grade,
             "pod": name,
+            "role": role,
             "step": step,
             "replicas": len(self.fleet.replicas),
         }
@@ -991,6 +1308,35 @@ class FleetSupervisor:
             "fleet scaled up", pod=name, grade=grade, replicas=record["replicas"]
         )
         await self._record_scale(record, snapshot)
+
+    def _scale_role(self, snapshot: FleetSnapshot) -> str:
+        """Which pool should grow: the role whose live replicas carry the
+        highest mean queued+live load.  A fleet with no role-typed
+        replicas scales fused, unchanged from ISSUE 19."""
+        loads: Dict[str, List[float]] = {}
+        for name, rep in self.fleet.replicas.items():
+            if rep.state == REPLICA_DOWN:
+                continue
+            snap = snapshot.replicas.get(name)
+            if snap is None:
+                continue
+            loads.setdefault(rep.role, []).append(
+                float(snap.queue_depth + snap.live_requests)
+            )
+        if not loads:
+            return ROLE_FUSED
+        return max(
+            sorted(loads), key=lambda role: sum(loads[role]) / len(loads[role])
+        )
+
+    def _template_for_role(self, role: str) -> Optional[Dict[str, Any]]:
+        """A pod manifest template carrying ``role`` (recorded at adoption
+        or readable from the manifest env); any template as the fallback
+        so a role with no surviving template still scales SOMETHING."""
+        for pod, manifest in self._pod_templates.items():
+            if self._roles.get(pod, self._template_role(manifest)) == role:
+                return manifest
+        return next(iter(self._pod_templates.values()), None)
 
     async def _scale_down(
         self, now: float, grade: str, snapshot: FleetSnapshot
@@ -1008,6 +1354,17 @@ class FleetSupervisor:
             for name, rep in self.fleet.replicas.items()
             if rep.state == REPLICA_SERVING
         ]
+        # role-pool floor (ISSUE 20): in a role-typed fleet, never drain a
+        # role's LAST serving replica — scaling the prefill pool to zero
+        # would force every admission through the fused fallback while
+        # decode replicas idle
+        role_counts: Dict[str, int] = {}
+        for _, rep in serving:
+            role_counts[rep.role] = role_counts.get(rep.role, 0) + 1
+        if len(role_counts) > 1:
+            serving = [
+                (name, rep) for name, rep in serving if role_counts[rep.role] > 1
+            ]
         if not serving:
             return
         name, rep = min(
@@ -1026,6 +1383,7 @@ class FleetSupervisor:
             self._expected_deletions.discard(name)
         self._pod_templates.pop(name, None)
         self._kv_blocks.pop(name, None)
+        self._roles.pop(name, None)
         self._missing.forget(name)
         self.scaled_down += 1
         self._scale_up_streak = 0
@@ -1198,18 +1556,29 @@ class FleetSupervisor:
                 kv = max(self.min_kv_blocks, kv // 2)
         self._kv_blocks[incident.pod] = kv
         record["kv_blocks"] = kv
+        # recreate PER ROLE (ISSUE 20): the replacement pod keeps the dead
+        # pod's disaggregation role — a crash-looping prefill replica comes
+        # back as prefill, so a faulting pool recovers instead of shrinking
+        # to zero while the other pool idles
+        role = self._roles.get(incident.pod)
+        if role is None:
+            template = self._pod_templates.get(incident.pod)
+            role = self._template_role(template) if template else ROLE_FUSED
+        self._roles[incident.pod] = role
+        record["role"] = role
         if incident.pod in self.fleet.replicas:
             self.fleet.kill_replica(
                 incident.pod, f"{CAUSE_REPLICA_LOST}:{incident.action}"
             )
             self._attach_dump(record, incident.pod)
         step = self._target_step()
-        await self._recreate_pod(incident.pod, kv)
+        await self._recreate_pod(incident.pod, kv, role=role)
         engine = self.replica_factory(incident.pod, step, kv)
         if incident.pod in self.fleet.replicas:
-            self.fleet.revive_replica(incident.pod, engine, step)
+            rep = self.fleet.revive_replica(incident.pod, engine, step)
+            rep.role = role
         else:
-            self.fleet.add_replica(incident.pod, engine, step)
+            self.fleet.add_replica(incident.pod, engine, step, role=role)
         self.recreated += 1
         record["step"] = step
         self.incidents.append(record)
@@ -1239,11 +1608,14 @@ class FleetSupervisor:
         ]
         return max(deployed) if deployed else None
 
-    async def _recreate_pod(self, name: str, kv_blocks: Optional[int]) -> None:
+    async def _recreate_pod(
+        self, name: str, kv_blocks: Optional[int], role: Optional[str] = None
+    ) -> None:
         """Replace the pod object in the cluster: delete the dead husk if
         it still exists (expected deletion — not an incident), then create
         a fresh-uid replacement from the remembered template with the
-        (possibly reduced) ``NEXUS_KV_BLOCKS`` env applied."""
+        (possibly reduced) ``NEXUS_KV_BLOCKS`` and the preserved
+        ``NEXUS_REPLICA_ROLE`` envs applied."""
         from tpu_nexus.k8s.client import NotFoundError
 
         template = self._pod_templates.get(name)
@@ -1259,15 +1631,21 @@ class FleetSupervisor:
         meta = manifest.setdefault("metadata", {})
         meta["uid"] = f"fleet-recreate-{next(self._uid_counter)}"
         manifest["status"] = {"phase": "Pending"}
+        patches = {}
         if kv_blocks is not None:
+            patches["NEXUS_KV_BLOCKS"] = str(kv_blocks)
+        if role is not None:
+            patches["NEXUS_REPLICA_ROLE"] = role
+        if patches:
             for container in (manifest.get("spec") or {}).get("containers", []) or []:
                 env = container.setdefault("env", [])
-                for entry in env:
-                    if entry.get("name") == "NEXUS_KV_BLOCKS":
-                        entry["value"] = str(kv_blocks)
-                        break
-                else:
-                    env.append({"name": "NEXUS_KV_BLOCKS", "value": str(kv_blocks)})
+                for key, value in patches.items():
+                    for entry in env:
+                        if entry.get("name") == key:
+                            entry["value"] = value
+                            break
+                    else:
+                        env.append({"name": key, "value": value})
         await self._client.create_object("Pod", self.namespace, manifest)
         self._pod_templates[name] = copy.deepcopy(manifest)
 

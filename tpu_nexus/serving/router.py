@@ -237,18 +237,37 @@ class FleetRouter:
                 return grade
         return PRESSURE_DOWN if snap.state == PRESSURE_DOWN else "healthy"
 
-    def plan(self, prompt: Any) -> List[str]:
+    def plan(
+        self,
+        prompt: Any,
+        role: Optional[str] = None,
+        by_blocks: bool = False,
+    ) -> List[str]:
         """Candidate replicas in try-order.  Pressure policy: eligibility
         tier (ROUTE_ELIGIBILITY via the grade), then longest shared
         prefix, then :func:`load_score`, then name (determinism).  The
         fuzz drills call this directly to check the invariants (a DOWN or
-        non-serving replica never appears)."""
+        non-serving replica never appears).
+
+        Disaggregated serving (ISSUE 20): ``role`` restricts candidates to
+        one pool (``EngineReplica.role``) — admissions go to the PREFILL
+        pool by load, and ``by_blocks=True`` ranks a migrated request's
+        DECODE candidates by free KV blocks (most free first) instead of
+        prefix affinity: the handed-off payload brings its own blocks, so
+        block headroom, not cached prefixes, decides where it fits."""
         snapshot: FleetSnapshot = self.fleet.snapshot()
+
+        def in_role(name: str) -> bool:
+            if role is None:
+                return True
+            rep = self.fleet.replicas.get(name)
+            return rep is not None and getattr(rep, "role", "fused") == role
+
         if self.policy == ROUTER_ROUND_ROBIN:
             names = [
                 name
                 for name, snap in snapshot.replicas.items()
-                if snap.state == "serving"
+                if snap.state == "serving" and in_role(name)
             ]
             if not names:
                 return []
@@ -258,10 +277,15 @@ class FleetRouter:
         ranked: List[Tuple[int, float, float, str]] = []
         ps = self._page_size()
         for name, snap in snapshot.replicas.items():
-            if snap.state != "serving":
+            if snap.state != "serving" or not in_role(name):
                 continue
             tier = ELIGIBILITY_RANK.get(ROUTE_ELIGIBILITY[self._grade(name, snap)])
             if tier is None:  # "never"
+                continue
+            if by_blocks:
+                ranked.append(
+                    (tier, -float(snap.blocks_free), load_score(snap), name)
+                )
                 continue
             rep = self.fleet.replicas.get(name)
             affinity = rep.engine.prefix_shared_len(prompt) if rep is not None else 0

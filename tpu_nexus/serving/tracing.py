@@ -69,6 +69,17 @@ EV_FAULT = "fault"
 #: ``replica:cause`` hops, so a request's timeline shows its whole
 #: admission path, not just the replica that finally took it
 EV_ROUTER_RETRY = "router_retry"
+#: disaggregated serving (ISSUE 20): prefilled KV blocks installed on the
+#: DECODE replica — attrs carry block count, the source replica, and the
+#: payload's accumulated ``stage:replica:cause`` hop log, so the landing
+#: replica's timeline shows the request's whole cross-replica journey
+EV_HANDOFF_INSTALL = "handoff_install"
+#: one recorded handoff hop (retry / next-decode / re-prefill) — attrs
+#: name the faulted stage, replica, cause token, and the decision taken
+EV_HANDOFF_HOP = "handoff_hop"
+#: handoff budgets spent (or no live prefill pool): the request degraded
+#: to FUSED serving on this replica — recorded, never silently shed
+EV_DISAGG_FALLBACK = "disagg_fallback"
 #: terminal event: retirement state/action/cause + the TTFT/TPOT summary
 #: (computed from the same Request timestamps ServingMetrics histograms)
 EV_RETIRED = "retired"

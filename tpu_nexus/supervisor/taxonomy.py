@@ -64,6 +64,18 @@ class DecisionAction:
     #: a training step exceeded its wall-clock deadline (wedged collective);
     #: the in-process step-hang watchdog saved what it could and exited
     TO_FAIL_STEP_HANG = "ToFailStepHang"
+    # -- disaggregated-serving extensions (serving/handoff.py, ISSUE 20):
+    # faults in the prefill->decode KV block transfer.  The fleet dispatch
+    # layer retries/degrades in-process; these decisions are the POD-level
+    # verdicts when a handoff fault escalates past the request --
+    #: a KV handoff transfer aborted (dropped in transit or rejected by
+    #: payload validation) past the in-process retry budget
+    TO_FAIL_KV_HANDOFF_ABORT = "ToFailKvHandoffAbort"
+    #: a replica died MID-handoff (the peer held half the conversation)
+    TO_FAIL_KV_HANDOFF_REPLICA_LOST = "ToFailKvHandoffReplicaLost"
+    #: handoff retry + hop budgets spent — requests are degrading to fused
+    #: serving; the disaggregated topology itself is unhealthy
+    TO_FAIL_KV_HANDOFF_EXHAUSTED = "ToFailKvHandoffExhausted"
 
 
 #: decision -> resulting lifecycle stage (SURVEY §2.2 classification table +
@@ -82,6 +94,9 @@ DECISION_STAGE: Dict[str, str] = {
     DecisionAction.TO_FAIL_NUMERIC_NAN: LifecycleStage.FAILED,
     DecisionAction.TO_FAIL_LOSS_SPIKE: LifecycleStage.FAILED,
     DecisionAction.TO_FAIL_STEP_HANG: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_KV_HANDOFF_ABORT: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST: LifecycleStage.FAILED,
+    DecisionAction.TO_FAIL_KV_HANDOFF_EXHAUSTED: LifecycleStage.FAILED,
 }
 
 #: decisions that delete the k8s Job (all reference fail paths delete with
@@ -99,6 +114,9 @@ DELETES_JOB = frozenset(
         DecisionAction.TO_FAIL_NUMERIC_NAN,
         DecisionAction.TO_FAIL_LOSS_SPIKE,
         DecisionAction.TO_FAIL_STEP_HANG,
+        DecisionAction.TO_FAIL_KV_HANDOFF_ABORT,
+        DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST,
+        DecisionAction.TO_FAIL_KV_HANDOFF_EXHAUSTED,
     }
 )
 
@@ -131,6 +149,19 @@ MSG_LOSS_SPIKE = (
 )
 MSG_STEP_HANG = (
     "A training step exceeded its watchdog deadline - the run appeared wedged mid-step and was terminated."
+)
+# Disaggregated-serving handoff messages.  Wordings deliberately avoid every
+# existing classifier signature (no "collective", "interconnect", "allocate",
+# "compile", "preempt", "watchdog"...) so a round-trip through k8s event text
+# re-classifies to the same decision instead of being shadowed.
+MSG_KV_HANDOFF_ABORT = (
+    "KV block handoff transfer was dropped or rejected by payload validation past the retry budget."
+)
+MSG_KV_HANDOFF_REPLICA_LOST = (
+    "A serving replica died mid KV-handoff - the request was re-routed to a surviving peer."
+)
+MSG_KV_HANDOFF_EXHAUSTED = (
+    "KV handoff retry and hop budgets were spent - requests are degrading to fused serving."
 )
 
 #: decisions that do NOT delete the k8s Job — the explicit complement of
@@ -207,6 +238,15 @@ SERVING_POD_RECOVERY: Dict[str, str] = {
     DecisionAction.TO_FAIL_LOSS_SPIKE: FleetRecovery.ESCALATE,
     #: a hung step is slice-local wedging — a fresh pod may land healthy
     DecisionAction.TO_FAIL_STEP_HANG: FleetRecovery.RECREATE,
+    #: a transfer path that keeps aborting is replica-local (NIC/DMA-class
+    #: wedging) — a replacement pod gets a fresh transfer path
+    DecisionAction.TO_FAIL_KV_HANDOFF_ABORT: FleetRecovery.RECREATE,
+    #: the peer died — the classic recreate case, per role (the fleet
+    #: controller recreates into the SAME role pool, serving/fleet.py)
+    DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST: FleetRecovery.RECREATE,
+    #: budgets spent across multiple peers: a topology/config fact —
+    #: recreating one pod replays it; an operator owns the pool shape
+    DecisionAction.TO_FAIL_KV_HANDOFF_EXHAUSTED: FleetRecovery.ESCALATE,
 }
 
 #: decision -> human run-status message, TOTAL over DecisionAction (nxlint
@@ -226,6 +266,9 @@ ACTION_MESSAGES: Dict[str, str] = {
     DecisionAction.TO_FAIL_NUMERIC_NAN: MSG_NUMERIC_NAN,
     DecisionAction.TO_FAIL_LOSS_SPIKE: MSG_LOSS_SPIKE,
     DecisionAction.TO_FAIL_STEP_HANG: MSG_STEP_HANG,
+    DecisionAction.TO_FAIL_KV_HANDOFF_ABORT: MSG_KV_HANDOFF_ABORT,
+    DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST: MSG_KV_HANDOFF_REPLICA_LOST,
+    DecisionAction.TO_FAIL_KV_HANDOFF_EXHAUSTED: MSG_KV_HANDOFF_EXHAUSTED,
 }
 
 
@@ -301,6 +344,24 @@ _LOSS_SPIKE_RE = re.compile(
     r"loss spike|grad(ient)?s? (norm )?spike|spiked past the health",
     re.IGNORECASE,
 )
+# Disaggregated-serving handoff signatures (serving/handoff.py wordings +
+# the MSG_KV_HANDOFF_* round-trips).  Checked LAST so they can never shadow
+# an infrastructure or training-health classification — and phrased around
+# the "kv handoff" vocabulary none of the older regexes contains.
+_KV_HANDOFF_REPLICA_LOST_RE = re.compile(
+    r"kv[- ]?handoff.*(replica|peer).*(lost|died|gone|unreachable)|"
+    r"(died|lost) mid[- ]kv[- ]handoff|mid[- ]handoff.*(replica|peer).*(lost|died)",
+    re.IGNORECASE,
+)
+_KV_HANDOFF_EXHAUSTED_RE = re.compile(
+    r"kv[- ]?handoff.*(budget|hop)s?.*(spent|exhaust)|handoff[- ]exhausted",
+    re.IGNORECASE,
+)
+_KV_HANDOFF_ABORT_RE = re.compile(
+    r"kv[- ](block )?handoff.*(drop|reject|corrupt|abort|mismatch|crc)|"
+    r"kv handoff payload|handoff[- ](drop|corrupt)",
+    re.IGNORECASE,
+)
 
 # longest alternatives first: with `pb` before `pbtxt`, a `.pbtxt` ref would
 # truncate to `.pb` (the regex never backtracks to the longer suffix)
@@ -334,6 +395,17 @@ def classify_tpu_failure(text: str) -> Optional[str]:
         return DecisionAction.TO_FAIL_NUMERIC_NAN
     if _LOSS_SPIKE_RE.search(text):
         return DecisionAction.TO_FAIL_LOSS_SPIKE
+    # handoff signatures rank below everything: they are self-reported by
+    # the fleet dispatch layer, and a trace carrying both a hardware cause
+    # and the handoff symptom it produced names the hardware cause.
+    # Within the class: replica-lost > exhausted > abort (most specific
+    # verdict first — an exhaustion trace usually quotes the drops too).
+    if _KV_HANDOFF_REPLICA_LOST_RE.search(text):
+        return DecisionAction.TO_FAIL_KV_HANDOFF_REPLICA_LOST
+    if _KV_HANDOFF_EXHAUSTED_RE.search(text):
+        return DecisionAction.TO_FAIL_KV_HANDOFF_EXHAUSTED
+    if _KV_HANDOFF_ABORT_RE.search(text):
+        return DecisionAction.TO_FAIL_KV_HANDOFF_ABORT
     return None
 
 

@@ -46,6 +46,32 @@ mode             effect / expected engine behavior
                  ledger lands PREEMPTED with per-cause retirement counts
 ===============  ==============================================================
 
+Disaggregated-serving handoff modes (ISSUE 20 chaos harness) inject at the
+same executor boundary, targeting the KV handoff entry points
+(``extract_blocks`` on a prefill replica / ``install_blocks`` on a decode
+replica).  Both count on the SAME step counter as ``step``/``verify``, so
+``NEXUS_FAULT_STEP`` targets the Nth dispatch in disaggregated mode exactly
+like fused mode:
+
+===================  ==========================================================
+mode                 effect / expected fleet behavior
+===================  ==========================================================
+``handoff-drop``     the targeted handoff dispatch raises ``TransferDropped``
+                     (transient) → the fleet's HandoffPolicy retries in place
+                     with backoff; past the budget the hop layer takes over
+``handoff-corrupt``  one byte of a SEALED payload leaf is flipped before the
+                     install — the RECEIVER's CRC validation must catch it
+                     (``PayloadCorrupt``); the decision tables hop the request
+                     (next decode replica / re-prefill) and exhaustion
+                     degrades to fused serving.  Install-seam only: a
+                     pre-seal extract corruption would be CRC-blessed — the
+                     exact silent-corruption class the drill exists to catch.
+``kill-mid-handoff`` the targeted handoff dispatch raises ``PeerLost`` — a
+                     replica died mid-transfer; a dead decode peer retries
+                     the next decode replica, a dead prefill peer re-prefills
+                     elsewhere, every hop recorded with cause
+===================  ==========================================================
+
 ``NEXUS_FAULT_STEP`` counts executor *step* calls (or engine iterations for
 ``drain-sigterm``), ``NEXUS_FAULT_REQUEST`` counts ``begin`` calls — so a
 fault can target iteration N or the Nth admitted request.
@@ -128,6 +154,15 @@ ENV_FAULT_SLOW_S = "NEXUS_FAULT_SLOW_S"
 #: so the engine's recovery layer, not the loop, sees the fault
 EXECUTOR_FAULT_MODES = frozenset({"step-hbm-oom", "step-ici", "slow-step"})
 
+#: KV-handoff modes (ISSUE 20), injected by :class:`FaultyExecutor` at the
+#: disaggregated entry points (``extract_blocks``/``install_blocks``) on the
+#: SAME step counter as the decode dispatches — same ownership contract as
+#: :data:`EXECUTOR_FAULT_MODES` (the loop's :func:`maybe_inject` stays
+#: silent when the executor is wrapped)
+HANDOFF_FAULT_MODES = frozenset(
+    {"handoff-drop", "handoff-corrupt", "kill-mid-handoff"}
+)
+
 #: modes injected inside the CHECKPOINT commit protocol by
 #: :func:`checkpoint_fault_hook` (train harness) — same ownership contract
 #: as the executor modes: the loop's :func:`maybe_inject` stays silent when
@@ -206,7 +241,7 @@ def maybe_inject(
     pre-existing ``hang`` drill, not this one."""
     if plan.mode is None or step != plan.step:
         return
-    if plan.mode in EXECUTOR_FAULT_MODES:
+    if plan.mode in EXECUTOR_FAULT_MODES or plan.mode in HANDOFF_FAULT_MODES:
         if executor_faults_handled:
             return
         raise ValueError(
@@ -302,10 +337,10 @@ class FaultyExecutor:
         slow_s: float = 0.05,
         sleep=time.sleep,
     ) -> None:
-        if mode not in EXECUTOR_FAULT_MODES:
+        if mode not in EXECUTOR_FAULT_MODES and mode not in HANDOFF_FAULT_MODES:
             raise ValueError(
                 f"unknown executor fault mode {mode!r}; use one of "
-                f"{sorted(EXECUTOR_FAULT_MODES)}"
+                f"{sorted(EXECUTOR_FAULT_MODES | HANDOFF_FAULT_MODES)}"
             )
         self.inner = inner
         self.mode = mode
@@ -394,6 +429,75 @@ class FaultyExecutor:
         if self._in_window(count, self.at_step):
             self._fire()
         return self.inner.verify(tokens, cursors, drafts, *args, **kwargs)
+
+    def _fire_handoff(self, point: str, payload=None) -> None:
+        """Inject one handoff fault at ``point`` (``extract``/``install``).
+        Drop and peer-loss raise the typed handoff faults with the
+        classifier's wordings; corruption flips one byte of the SEALED
+        payload and lets the receiver's CRC validation — the product code
+        under drill — do the catching."""
+        from tpu_nexus.serving.handoff import PeerLost, TransferDropped
+
+        if self.mode == "handoff-drop":
+            self.injected += 1
+            raise TransferDropped(
+                "kv handoff transfer dropped in transit (injected)"
+            )
+        if self.mode == "kill-mid-handoff":
+            self.injected += 1
+            raise PeerLost(
+                f"serving replica died mid kv-handoff at {point} "
+                "(injected kill)"
+            )
+        # handoff-corrupt
+        if point != "install" or payload is None:
+            raise ValueError(
+                "fault mode 'handoff-corrupt' corrupts a SEALED payload at "
+                "the install seam; an extract-side corruption would happen "
+                "before seal() and be blessed by the CRC — a silent-"
+                "corruption drill that can never fire.  Target an install "
+                "dispatch (the decode replica's NEXUS_FAULT_STEP)."
+            )
+        import numpy as np
+
+        self.injected += 1
+        name = sorted(payload.blocks)[0]
+        arr = np.ascontiguousarray(np.asarray(payload.blocks[name]))
+        flat = arr.view(np.uint8).reshape(-1)
+        flat[flat.shape[0] // 2] ^= 0xFF
+        payload.blocks[name] = arr
+        logger.warning(
+            "injecting handoff-corrupt: flipped one byte of sealed leaf %r "
+            "for request %s", name, payload.request_id,
+        )
+
+    def extract_blocks(self, block_ids):
+        # disaggregated prefill-side handoff dispatch (ISSUE 20): counts on
+        # the SAME step counter as step()/verify(), so NEXUS_FAULT_STEP
+        # targets the Nth dispatch in disaggregated mode exactly like
+        # fused mode.  Executor modes (_fire) and handoff modes
+        # (_fire_handoff) share the window discipline.
+        count = self.step_calls
+        self.step_calls += 1
+        if self._in_window(count, self.at_step):
+            if self.mode in HANDOFF_FAULT_MODES:
+                self._fire_handoff("extract")
+            else:
+                self._fire()
+        return self.inner.extract_blocks(block_ids)
+
+    def install_blocks(self, payload, block_ids):
+        # disaggregated decode-side handoff dispatch: same shared step
+        # counter.  handoff-corrupt mutates the payload then PROCEEDS —
+        # the inner executor's validate_payload is what must catch it.
+        count = self.step_calls
+        self.step_calls += 1
+        if self._in_window(count, self.at_step):
+            if self.mode in HANDOFF_FAULT_MODES:
+                self._fire_handoff("install", payload)
+            else:
+                self._fire()
+        return self.inner.install_blocks(payload, block_ids)
 
 
 def flip_committed_leaf(step_dir: str) -> str:
@@ -564,7 +668,7 @@ def wrap_executor(plan: FaultPlan, executor):
     """Wrap ``executor`` per the fault plan; pass-through for non-executor
     modes (including no fault).  ``NEXUS_FAULT_REQUEST`` targets the Nth
     prefill, otherwise ``NEXUS_FAULT_STEP`` targets the Nth decode step."""
-    if plan.mode not in EXECUTOR_FAULT_MODES:
+    if plan.mode not in EXECUTOR_FAULT_MODES and plan.mode not in HANDOFF_FAULT_MODES:
         return executor
     logger.warning(
         "serving chaos: wrapping executor with %r (step=%s request=%s times=%d)",
